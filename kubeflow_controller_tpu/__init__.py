@@ -1,0 +1,24 @@
+"""kubeflow_controller_tpu — a TPU-native training-job framework.
+
+A ground-up rebuild of the capabilities of gaocegege/kubeflow-controller
+(a Go Kubernetes controller reconciling TFJob custom resources into
+parameter-server/worker pods, see /root/reference/pkg/controller/controller.go)
+re-designed TPU-first:
+
+- Declarative ``TPUJob`` API (descendant of the TFJob CRD,
+  reference ``vendor/.../apis/kubeflow/v1alpha1/types.go:30-174``) with
+  TPU slice topology instead of PS/worker host lists.
+- A level-triggered reconcile core (keyed rate-limited workqueue +
+  expectations cache, reference ``pkg/controller/controller.go:158-243``)
+  that gang-schedules whole TPU slices all-or-nothing — the reference's
+  incremental pod creation (``controller.go:374-425``) is deliberately
+  not reproduced.
+- ``jax.distributed`` coordinator env injection replacing the reference's
+  ``--worker_hosts/--ps_hosts`` CLI-arg cluster-spec generation
+  (``pkg/tensorflow/distributed.go:127-159``).
+- A JAX/Flax/pallas data plane: SPMD train steps over a
+  ``jax.sharding.Mesh`` with dp/fsdp/tp/sp axes; XLA collectives over
+  ICI/DCN replace the reference's gRPC parameter-server protocol.
+"""
+
+__version__ = "0.1.0"

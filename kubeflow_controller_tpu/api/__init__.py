@@ -1,0 +1,43 @@
+"""Job API layer: TPUJob spec/status types, core object model, topology catalog."""
+
+from kubeflow_controller_tpu.api.core import (
+    Container,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    PodTemplateSpec,
+    Service,
+    ServicePort,
+    ServiceSpec,
+)
+from kubeflow_controller_tpu.api.topology import (
+    SliceShape,
+    TPU_SLICE_CATALOG,
+    slice_shape,
+)
+from kubeflow_controller_tpu.api.types import (
+    ChiefSpec,
+    Condition,
+    ConditionStatus,
+    ConditionType,
+    JobPhase,
+    ReplicaSpec,
+    ReplicaState,
+    ReplicaStatus,
+    ReplicaType,
+    TerminationPolicySpec,
+    TPUJob,
+    TPUJobSpec,
+    TPUJobStatus,
+    TPUSliceSpec,
+)
+from kubeflow_controller_tpu.api.serialization import (
+    job_from_dict,
+    job_to_dict,
+    load_job_yaml,
+    dump_job_yaml,
+)
+from kubeflow_controller_tpu.api.validation import ValidationError, validate_job

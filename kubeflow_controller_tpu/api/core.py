@@ -1,0 +1,178 @@
+"""Core cluster object model: the minimal Kubernetes-shaped primitives the
+controller reconciles against.
+
+The reference consumes these from ``k8s.io/api/core/v1`` (vendored); here they
+are first-party dataclasses because the framework ships its own in-process
+cluster (see ``kubeflow_controller_tpu.cluster``) for hermetic development and
+testing, with a real-cluster adapter as a thin swap-in at the effector seam
+(mirroring how ``HelperInterface`` isolates the apiserver in the reference,
+``pkg/controller/helper.go:42-47``).
+
+Only the fields the control plane actually reads/writes exist — this is an
+object model, not a Kubernetes client.
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class PodPhase(str, enum.Enum):
+    """Pod lifecycle phase (mirror of k8s core/v1 PodPhase semantics)."""
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+@dataclass
+class OwnerReference:
+    """Ownership link from a dependent object to its controller.
+
+    Same contract the reference builds in ``newControllerRef``
+    (``pkg/controller/util.go:44-55``): apiVersion/kind/name/uid plus
+    ``controller=True`` so adopt/release logic can find the managing job.
+    """
+
+    api_version: str
+    kind: str
+    name: str
+    uid: str
+    controller: bool = True
+    block_owner_deletion: bool = True
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    generate_name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    resource_version: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+
+    def controller_ref(self) -> Optional[OwnerReference]:
+        """Return the managing controller's OwnerReference, if any.
+
+        Mirrors ``metav1.GetControllerOf`` as used by ``resolveControllerRef``
+        (reference ``pkg/controller/controller.go:595-611``).
+        """
+        for ref in self.owner_references:
+            if ref.controller:
+                return ref
+        return None
+
+
+@dataclass
+class Container:
+    name: str
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    ports: List[int] = field(default_factory=list)
+    # Resource requests, e.g. {"google.com/tpu": 4, "cpu": 8}.
+    resources: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    restart_policy: str = "OnFailure"
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    # Gang-scheduling group: pods sharing a scheduling_group are admitted
+    # all-or-nothing by the (fake or real) scheduler. No analog in the
+    # reference — it creates pods incrementally (controller.go:396-421),
+    # which SURVEY.md flags as exactly wrong for TPU slices.
+    scheduling_group: str = ""
+    # Name of the TPU slice this pod is pinned to once scheduled.
+    assigned_slice: str = ""
+
+    def main_container(self) -> Container:
+        return self.containers[0]
+
+
+@dataclass
+class PodStatus:
+    phase: PodPhase = PodPhase.PENDING
+    reason: str = ""
+    message: str = ""
+    pod_ip: str = ""
+    host_ip: str = ""
+    start_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    exit_code: Optional[int] = None
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    kind: str = "Pod"
+    api_version: str = "v1"
+
+    def deepcopy(self) -> "Pod":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class PodTemplateSpec:
+    """Template stamped out (deep-copied — the reference's in-place template
+    mutation at ``pkg/tensorflow/distributed.go:117-125`` is a known cache
+    corruption bug, SURVEY.md §8) for each replica pod."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+    def deepcopy(self) -> "PodTemplateSpec":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class ServicePort:
+    port: int
+    name: str = ""
+    target_port: Optional[int] = None
+
+
+@dataclass
+class ServiceSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+    cluster_ip: str = ""
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+    kind: str = "Service"
+    api_version: str = "v1"
+
+    def deepcopy(self) -> "Service":
+        return copy.deepcopy(self)
+
+    def dns_name(self) -> str:
+        return f"{self.metadata.name}.{self.metadata.namespace}.svc"
+
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid(prefix: str = "uid") -> str:
+    """Monotonic process-unique uid (fake-cluster stand-in for k8s UIDs)."""
+    return f"{prefix}-{next(_uid_counter):08d}-{int(time.time()) & 0xFFFF:04x}"

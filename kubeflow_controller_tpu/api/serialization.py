@@ -1,0 +1,136 @@
+"""YAML/dict (de)serialization for TPUJob manifests.
+
+The reference registers a CRD and lets the apiserver+client-gen do this
+(``examples/crd/crd.yml``, vendored deepcopy/scheme); here the manifest format
+is first-party. Field names are camelCase on the wire to keep kubectl-style
+manifests familiar (compare ``examples/tfjob/dist.yml`` in the reference).
+"""
+
+from __future__ import annotations
+
+from dataclasses import fields, is_dataclass
+from typing import Any, Dict, IO, Union
+
+import yaml
+
+from kubeflow_controller_tpu.api import core, types
+
+
+def _camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(w.capitalize() for w in rest)
+
+
+_SNAKE_CACHE: Dict[type, Dict[str, str]] = {}
+
+
+def _field_map(cls: type) -> Dict[str, str]:
+    """camelCase wire name -> snake_case attr name for a dataclass."""
+    if cls not in _SNAKE_CACHE:
+        _SNAKE_CACHE[cls] = {_camel(f.name): f.name for f in fields(cls)}
+    return _SNAKE_CACHE[cls]
+
+
+def _to_wire(obj: Any) -> Any:
+    if is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in fields(obj):
+            val = getattr(obj, f.name)
+            # Elide empties and scalar defaults so dumped manifests stay as
+            # terse as what a user would write by hand.
+            if val is None or val == [] or val == {} or val == "":
+                continue
+            if isinstance(val, (int, float, bool)) and val == f.default:
+                continue
+            if f.name in ("kind", "api_version"):
+                continue
+            out[_camel(f.name)] = _to_wire(val)
+        return out
+    if isinstance(obj, dict):
+        return {
+            (k.value if hasattr(k, "value") else k): _to_wire(v)
+            for k, v in obj.items()
+        }
+    if isinstance(obj, (list, tuple)):
+        return [_to_wire(v) for v in obj]
+    if hasattr(obj, "value") and isinstance(obj, object) and hasattr(type(obj), "__members__"):
+        return obj.value  # Enum
+    return obj
+
+
+def job_to_dict(job: types.TPUJob) -> Dict[str, Any]:
+    out = {"apiVersion": job.api_version, "kind": job.kind}
+    out.update(_to_wire(job))
+    return out
+
+
+def _build(cls: type, data: Dict[str, Any]) -> Any:
+    fmap = _field_map(cls)
+    kwargs: Dict[str, Any] = {}
+    type_hints = {f.name: f.type for f in fields(cls)}
+    for wire_key, val in data.items():
+        attr = fmap.get(wire_key)
+        if attr is None:
+            continue  # tolerate unknown fields, like the apiserver's pruning
+        kwargs[attr] = _coerce(cls, attr, val, type_hints[attr])
+    return cls(**kwargs)
+
+
+# Nested dataclass/enum field types, by (owner class, attr name).
+_NESTED = {
+    (types.TPUJob, "metadata"): core.ObjectMeta,
+    (types.TPUJob, "spec"): types.TPUJobSpec,
+    (types.TPUJob, "status"): types.TPUJobStatus,
+    (types.TPUJobSpec, "replica_specs"): types.ReplicaSpec,
+    (types.ReplicaSpec, "template"): core.PodTemplateSpec,
+    (types.ReplicaSpec, "tpu"): types.TPUSliceSpec,
+    (types.ReplicaSpec, "termination_policy"): types.TerminationPolicySpec,
+    (types.ReplicaSpec, "replica_type"): types.ReplicaType,
+    (types.TerminationPolicySpec, "chief"): types.ChiefSpec,
+    (types.TPUJobStatus, "phase"): types.JobPhase,
+    (types.TPUJobStatus, "conditions"): types.Condition,
+    (types.TPUJobStatus, "replica_statuses"): types.ReplicaStatus,
+    (types.Condition, "type"): types.ConditionType,
+    (types.Condition, "status"): types.ConditionStatus,
+    (types.ReplicaStatus, "type"): types.ReplicaType,
+    (types.ReplicaStatus, "state"): types.ReplicaState,
+    (core.PodTemplateSpec, "metadata"): core.ObjectMeta,
+    (core.PodTemplateSpec, "spec"): core.PodSpec,
+    (core.PodSpec, "containers"): core.Container,
+    (core.ObjectMeta, "owner_references"): core.OwnerReference,
+}
+
+
+def _coerce(owner: type, attr: str, val: Any, hint: Any) -> Any:
+    target = _NESTED.get((owner, attr))
+    if target is None:
+        if owner is types.ReplicaStatus and attr == "states" and isinstance(val, dict):
+            return {types.ReplicaState(k): v for k, v in val.items()}
+        return val
+    if isinstance(val, list):
+        return [
+            _build(target, v) if isinstance(v, dict) else target(v) for v in val
+        ]
+    if isinstance(val, dict):
+        return _build(target, val)
+    return target(val)  # enum scalar
+
+
+def job_from_dict(data: Dict[str, Any]) -> types.TPUJob:
+    kind = data.get("kind", types.KIND)
+    if kind != types.KIND:
+        raise ValueError(f"expected kind {types.KIND}, got {kind!r}")
+    job = _build(types.TPUJob, data)
+    return job
+
+
+def load_job_yaml(src: Union[str, IO[str]]) -> types.TPUJob:
+    """Load a TPUJob from a YAML string or open file."""
+    data = yaml.safe_load(src)
+    if not isinstance(data, dict):
+        raise ValueError("manifest did not parse to a mapping")
+    return job_from_dict(data)
+
+
+def dump_job_yaml(job: types.TPUJob) -> str:
+    return yaml.safe_dump(job_to_dict(job), sort_keys=False)

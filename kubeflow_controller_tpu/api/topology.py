@@ -1,0 +1,104 @@
+"""TPU slice topology catalog.
+
+The reference's job geometry is ``N workers x M parameter servers`` chosen
+freely per job (``pkg/tensorflow/distributed.go:56-114``). TPU geometry is not
+free: an accelerator type names a pod-slice with a fixed chip count, a fixed
+ICI topology, and a fixed number of host VMs (= JAX processes). The controller
+must therefore derive process count / chips-per-host from the accelerator type
+rather than letting the user pick replica counts that cannot exist.
+
+This catalog is the single source of truth for that derivation; the fake
+cluster's node pools and the gang scheduler both consume it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+
+@dataclass(frozen=True)
+class SliceShape:
+    """Physical shape of one TPU pod-slice."""
+
+    accelerator_type: str  # e.g. "v5e-16"
+    generation: str        # "v5e" | "v5p" | "v4" | "v6e"
+    num_chips: int         # total chips in the slice
+    topology: Tuple[int, ...]  # ICI mesh topology, e.g. (4, 4)
+    chips_per_host: int    # chips attached to each host VM
+    # Per-chip core count: v4/v5p chips expose 1 megacore; v5e/v6e 1 core.
+    cores_per_chip: int = 1
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_chips // self.chips_per_host)
+
+    @property
+    def topology_str(self) -> str:
+        return "x".join(str(d) for d in self.topology)
+
+    @property
+    def devices_per_host(self) -> int:
+        return self.chips_per_host * self.cores_per_chip
+
+
+def _v5e(chips: int, topo: Tuple[int, ...]) -> SliceShape:
+    # v5e ("v5 lite") hosts carry up to 8 chips; sub-host slices exist.
+    return SliceShape(f"v5e-{chips}", "v5e", chips, topo, min(chips, 8))
+
+
+def _v5p(chips: int, topo: Tuple[int, ...]) -> SliceShape:
+    # v5p hosts carry 4 chips.
+    return SliceShape(f"v5p-{chips}", "v5p", chips, topo, min(chips, 4))
+
+
+def _v4(chips: int, topo: Tuple[int, ...]) -> SliceShape:
+    return SliceShape(f"v4-{chips}", "v4", chips, topo, min(chips, 4))
+
+
+def _v6e(chips: int, topo: Tuple[int, ...]) -> SliceShape:
+    return SliceShape(f"v6e-{chips}", "v6e", chips, topo, min(chips, 8))
+
+
+TPU_SLICE_CATALOG: Dict[str, SliceShape] = {
+    s.accelerator_type: s
+    for s in [
+        _v5e(1, (1, 1)),
+        _v5e(4, (2, 2)),
+        _v5e(8, (2, 4)),
+        _v5e(16, (4, 4)),
+        _v5e(32, (4, 8)),
+        _v5e(64, (8, 8)),
+        _v5e(128, (8, 16)),
+        _v5e(256, (16, 16)),
+        _v5p(4, (2, 2, 1)),
+        _v5p(8, (2, 2, 2)),
+        _v5p(16, (2, 2, 4)),
+        _v5p(32, (2, 4, 4)),
+        _v5p(64, (4, 4, 4)),
+        _v5p(128, (4, 4, 8)),
+        _v5p(256, (4, 8, 8)),
+        _v4(8, (2, 2, 2)),
+        _v4(16, (2, 2, 4)),
+        _v4(32, (2, 4, 4)),
+        _v4(64, (4, 4, 4)),
+        _v6e(1, (1, 1)),
+        _v6e(4, (2, 2)),
+        _v6e(8, (2, 4)),
+        _v6e(16, (4, 4)),
+        _v6e(32, (4, 8)),
+        _v6e(64, (8, 8)),
+        _v6e(256, (16, 16)),
+    ]
+}
+
+
+def slice_shape(accelerator_type: str) -> SliceShape:
+    """Look up a slice shape; raises KeyError with the known set on miss."""
+    try:
+        return TPU_SLICE_CATALOG[accelerator_type]
+    except KeyError:
+        known = ", ".join(sorted(TPU_SLICE_CATALOG))
+        raise KeyError(
+            f"unknown accelerator type {accelerator_type!r}; known: {known}"
+        ) from None

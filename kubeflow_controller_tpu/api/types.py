@@ -1,0 +1,238 @@
+"""TPUJob API types — the declarative job contract.
+
+Descendant of the reference's TFJob CRD schema
+(``vendor/github.com/caicloud/kubeflow-clientset/apis/kubeflow/v1alpha1/types.go:30-174``)
+with the PS role deleted (XLA collectives over ICI absorb the parameter-server
+function, SURVEY.md §2.5-2.6) and TPU slice geometry added. Unlike the
+reference, the declared-but-inert surface is real here:
+
+- ``Failed`` phase is reachable (reference never sets it, SURVEY.md §8).
+- Conditions are populated (reference TODO at ``updater/distributed.go:49-50``).
+- ``TerminationPolicy``/chief semantics are enforced (reference declares them
+  at ``types.go:81-89`` and never reads them).
+- ``data_dir``/``model_dir``/``log_dir``/``export_dir`` are consumed by the
+  data plane (env injection + orbax checkpoint root).
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubeflow_controller_tpu.api.core import ObjectMeta, PodTemplateSpec
+
+API_GROUP = "tpu.kubeflow.dev"
+API_VERSION = "v1alpha1"
+KIND = "TPUJob"
+
+# How many of the most recent conditions a status retains
+# (reference comment "keeps ten most recent", types.go:97).
+MAX_CONDITIONS = 10
+
+
+class ReplicaType(str, enum.Enum):
+    """Replica roles. The reference's PS role (``types.go:72-79``) is gone:
+    there is no parameter-server protocol on TPU — gradients all-reduce over
+    ICI inside the compiled program."""
+
+    WORKER = "Worker"
+    LOCAL = "Local"
+
+
+class JobPhase(str, enum.Enum):
+    # Mirrors reference TFJobPhase (types.go:106-133) plus Recovering:
+    # slice preemption puts a job into Recovering until it re-gangs and
+    # resumes from checkpoint (SURVEY.md §7.5).
+    NONE = ""
+    UNKNOWN = "Unknown"
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    RECOVERING = "Recovering"
+
+
+class ConditionType(str, enum.Enum):
+    # Reference condition types (types.go:149-156) plus GangScheduled:
+    # the all-or-nothing admission event unique to slice scheduling.
+    SCHEDULED = "Scheduled"
+    GANG_SCHEDULED = "GangScheduled"
+    READY = "Ready"
+    RECOVERING = "Recovering"
+    RECYCLING = "Recycling"
+
+
+class ConditionStatus(str, enum.Enum):
+    TRUE = "True"
+    FALSE = "False"
+    UNKNOWN = "Unknown"
+
+
+class ReplicaState(str, enum.Enum):
+    # Mirrors reference TFReplicaState (types.go:167-174).
+    UNKNOWN = "Unknown"
+    WAITING = "Waiting"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class TPUSliceSpec:
+    """TPU geometry for a worker replica group — the new surface that replaces
+    the reference's free-form replica counts with physical slice shapes."""
+
+    # Accelerator type names the pod-slice, e.g. "v5e-16" (16 chips, 4 hosts).
+    accelerator_type: str = "v5e-8"
+    # Number of identical slices ganged into one job (multi-slice over DCN).
+    num_slices: int = 1
+    # Optional explicit topology override, e.g. "4x4"; normally derived
+    # from the catalog (api/topology.py).
+    topology: str = ""
+    # Reserved / spot / on-demand; spot slices are preemptible and drive the
+    # checker's preemption-recovery path.
+    provisioning: str = "on-demand"
+
+
+@dataclass
+class ChiefSpec:
+    # Reference ChiefSpec (types.go:86-89): which replica's exit decides
+    # job completion.
+    replica_name: str = "Worker"
+    replica_index: int = 0
+
+
+@dataclass
+class TerminationPolicySpec:
+    chief: Optional[ChiefSpec] = None
+
+
+@dataclass
+class ReplicaSpec:
+    """One replica group. For WORKER the effective pod count is derived from
+    slice geometry (hosts-per-slice x num_slices), not from ``replicas`` —
+    TPU hosts are not free-form. For LOCAL, ``replicas`` must be 1."""
+
+    replica_type: ReplicaType = ReplicaType.WORKER
+    replicas: Optional[int] = None
+    template: Optional[PodTemplateSpec] = None
+    tpu: TPUSliceSpec = field(default_factory=TPUSliceSpec)
+    termination_policy: Optional[TerminationPolicySpec] = None
+    # Job-level restart budget for failed pods before the job goes Failed
+    # (reference has only pod-level restartPolicy, SURVEY.md §5.3).
+    max_restarts: int = 3
+
+
+@dataclass
+class TPUJobSpec:
+    # RuntimeID: stamped once at first reconcile, then immutable — the
+    # reference regenerates it per sync, orphaning prior resources
+    # (distributed.go:208-209, SURVEY.md §8).
+    runtime_id: str = ""
+    data_dir: str = ""
+    model_dir: str = ""
+    log_dir: str = ""
+    export_dir: str = ""
+    replica_specs: List[ReplicaSpec] = field(default_factory=list)
+
+
+@dataclass
+class Condition:
+    type: ConditionType = ConditionType.SCHEDULED
+    status: ConditionStatus = ConditionStatus.UNKNOWN
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class ReplicaStatus:
+    type: ReplicaType = ReplicaType.WORKER
+    state: ReplicaState = ReplicaState.UNKNOWN
+    # Histogram of pod states, mirror of TFReplicasStates (types.go:163-165).
+    states: Dict[ReplicaState, int] = field(default_factory=dict)
+
+
+@dataclass
+class TPUJobStatus:
+    phase: JobPhase = JobPhase.NONE
+    reason: str = ""
+    conditions: List[Condition] = field(default_factory=list)
+    replica_statuses: List[ReplicaStatus] = field(default_factory=list)
+    # Observability for the submit->all-running north-star metric
+    # (BASELINE.md): stamped by the status updater.
+    submit_time: float = 0.0
+    all_running_time: float = 0.0
+    completion_time: float = 0.0
+    # Count of gang restarts consumed (preemption recovery).
+    restarts: int = 0
+
+    def set_condition(
+        self,
+        ctype: ConditionType,
+        status: ConditionStatus,
+        reason: str = "",
+        message: str = "",
+        now: Optional[float] = None,
+    ) -> bool:
+        """Upsert a condition; returns True if anything changed. Keeps at most
+        MAX_CONDITIONS entries, newest last."""
+        now = time.time() if now is None else now
+        changed = True
+        for cond in self.conditions:
+            if cond.type == ctype:
+                if cond.status == status and cond.reason == reason:
+                    changed = False
+                else:
+                    cond.status = status
+                    cond.reason = reason
+                    cond.message = message
+                    cond.last_transition_time = now
+                break
+        else:
+            self.conditions.append(
+                Condition(ctype, status, reason, message, last_transition_time=now)
+            )
+        del self.conditions[:-MAX_CONDITIONS]
+        return changed
+
+    def get_condition(self, ctype: ConditionType) -> Optional[Condition]:
+        for cond in self.conditions:
+            if cond.type == ctype:
+                return cond
+        return None
+
+
+@dataclass
+class TPUJob:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TPUJobSpec = field(default_factory=TPUJobSpec)
+    status: TPUJobStatus = field(default_factory=TPUJobStatus)
+
+    kind: str = KIND
+    api_version: str = f"{API_GROUP}/{API_VERSION}"
+
+    def deepcopy(self) -> "TPUJob":
+        return copy.deepcopy(self)
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def worker_spec(self) -> Optional[ReplicaSpec]:
+        for rs in self.spec.replica_specs:
+            if rs.replica_type == ReplicaType.WORKER:
+                return rs
+        return None
+
+    def local_spec(self) -> Optional[ReplicaSpec]:
+        for rs in self.spec.replica_specs:
+            if rs.replica_type == ReplicaType.LOCAL:
+                return rs
+        return None
+
+    def is_done(self) -> bool:
+        return self.status.phase in (JobPhase.SUCCEEDED, JobPhase.FAILED)

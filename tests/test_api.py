@@ -1,0 +1,224 @@
+"""Job API layer tests: types, topology catalog, YAML round-trip, validation.
+
+Models the reference's table-driven style (``pkg/checker/checker_test.go``)
+but covers the full API surface the reference left untested (SURVEY.md §4).
+"""
+
+import pytest
+
+from kubeflow_controller_tpu.api import (
+    Condition,
+    ConditionStatus,
+    ConditionType,
+    Container,
+    JobPhase,
+    ObjectMeta,
+    PodSpec,
+    PodTemplateSpec,
+    ReplicaSpec,
+    ReplicaState,
+    ReplicaType,
+    TPUJob,
+    TPUJobSpec,
+    TPUSliceSpec,
+    TPU_SLICE_CATALOG,
+    ValidationError,
+    dump_job_yaml,
+    load_job_yaml,
+    slice_shape,
+    validate_job,
+)
+from kubeflow_controller_tpu.api.validation import expected_worker_pods
+
+
+def make_template():
+    return PodTemplateSpec(
+        spec=PodSpec(containers=[Container(name="trainer", image="jax:latest")])
+    )
+
+
+def make_worker_job(name="bert", accel="v5e-16", num_slices=1):
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=TPUJobSpec(
+            replica_specs=[
+                ReplicaSpec(
+                    replica_type=ReplicaType.WORKER,
+                    template=make_template(),
+                    tpu=TPUSliceSpec(accelerator_type=accel, num_slices=num_slices),
+                )
+            ]
+        ),
+    )
+
+
+def make_local_job(name="mnist-local"):
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=TPUJobSpec(
+            replica_specs=[
+                ReplicaSpec(replica_type=ReplicaType.LOCAL, template=make_template())
+            ]
+        ),
+    )
+
+
+class TestTopology:
+    def test_catalog_shapes_consistent(self):
+        for name, shape in TPU_SLICE_CATALOG.items():
+            prod = 1
+            for d in shape.topology:
+                prod *= d
+            assert prod == shape.num_chips, name
+            assert shape.num_hosts * shape.chips_per_host == shape.num_chips or (
+                shape.num_chips < shape.chips_per_host
+            ), name
+
+    def test_known_geometry(self):
+        s = slice_shape("v5e-16")
+        assert s.num_hosts == 2  # 16 chips / 8 per host
+        assert s.topology_str == "4x4"
+        s = slice_shape("v5p-64")
+        assert s.num_hosts == 16  # 64 chips / 4 per host
+        assert s.topology == (4, 4, 4)
+
+    def test_unknown_raises(self):
+        with pytest.raises(KeyError, match="v9x-3"):
+            slice_shape("v9x-3")
+
+
+class TestValidation:
+    def test_valid_worker_job(self):
+        validate_job(make_worker_job())
+
+    def test_valid_local_job(self):
+        validate_job(make_local_job())
+
+    def test_collects_all_errors(self):
+        job = TPUJob()
+        job.metadata.name = ""
+        with pytest.raises(ValidationError) as ei:
+            validate_job(job)
+        assert len(ei.value.errors) >= 2
+
+    def test_rejects_mixed_roles(self):
+        job = make_worker_job()
+        job.spec.replica_specs.append(
+            ReplicaSpec(replica_type=ReplicaType.LOCAL, template=make_template())
+        )
+        with pytest.raises(ValidationError, match="mix"):
+            validate_job(job)
+
+    def test_rejects_unknown_accelerator(self):
+        job = make_worker_job(accel="v5e-16")
+        job.spec.replica_specs[0].tpu.accelerator_type = "gpu-8"
+        with pytest.raises(ValidationError, match="gpu-8"):
+            validate_job(job)
+
+    def test_rejects_missing_template(self):
+        job = make_worker_job()
+        job.spec.replica_specs[0].template = None
+        with pytest.raises(ValidationError, match="container"):
+            validate_job(job)
+
+    def test_rejects_bad_topology_override(self):
+        job = make_worker_job(accel="v5e-16")
+        job.spec.replica_specs[0].tpu.topology = "2x8"
+        with pytest.raises(ValidationError, match="topology"):
+            validate_job(job)
+
+    def test_expected_worker_pods(self):
+        job = make_worker_job(accel="v5p-32", num_slices=2)
+        # v5p-32: 8 hosts/slice x 2 slices
+        assert expected_worker_pods(job.spec.replica_specs[0]) == 16
+
+
+class TestSerialization:
+    def test_yaml_round_trip(self):
+        job = make_worker_job(accel="v5p-32", num_slices=2)
+        job.spec.model_dir = "/ckpt/bert"
+        text = dump_job_yaml(job)
+        back = load_job_yaml(text)
+        assert back.metadata.name == "bert"
+        assert back.spec.model_dir == "/ckpt/bert"
+        rs = back.spec.replica_specs[0]
+        assert rs.replica_type == ReplicaType.WORKER
+        assert rs.tpu.accelerator_type == "v5p-32"
+        assert rs.tpu.num_slices == 2
+        assert rs.template.spec.containers[0].image == "jax:latest"
+        validate_job(back)
+
+    def test_manifest_from_scratch(self):
+        text = """
+apiVersion: tpu.kubeflow.dev/v1alpha1
+kind: TPUJob
+metadata:
+  name: resnet50
+  namespace: ml
+spec:
+  modelDir: /ckpt/resnet
+  replicaSpecs:
+    - replicaType: Worker
+      tpu:
+        acceleratorType: v5e-16
+        numSlices: 1
+      template:
+        spec:
+          containers:
+            - name: trainer
+              image: jax:latest
+              args: ["--model=resnet50"]
+"""
+        job = load_job_yaml(text)
+        validate_job(job)
+        assert job.key == "ml/resnet50"
+        assert job.spec.replica_specs[0].template.spec.containers[0].args == [
+            "--model=resnet50"
+        ]
+
+    def test_wrong_kind_rejected(self):
+        with pytest.raises(ValueError, match="kind"):
+            load_job_yaml("kind: TFJob\nmetadata: {name: x}\n")
+
+    def test_unknown_fields_tolerated(self):
+        job = load_job_yaml(
+            "kind: TPUJob\nmetadata: {name: x, bogus: 1}\nspec: {futureField: 2}\n"
+        )
+        assert job.metadata.name == "x"
+
+
+class TestStatus:
+    def test_condition_upsert(self):
+        job = make_worker_job()
+        st = job.status
+        assert st.set_condition(ConditionType.SCHEDULED, ConditionStatus.TRUE, "ok", now=1.0)
+        # idempotent re-set: no change
+        assert not st.set_condition(ConditionType.SCHEDULED, ConditionStatus.TRUE, "ok", now=2.0)
+        assert st.get_condition(ConditionType.SCHEDULED).last_transition_time == 1.0
+        # flip flips
+        assert st.set_condition(ConditionType.SCHEDULED, ConditionStatus.FALSE, "lost", now=3.0)
+        assert st.get_condition(ConditionType.SCHEDULED).status == ConditionStatus.FALSE
+
+    def test_condition_cap(self):
+        job = make_worker_job()
+        for i in range(30):
+            ct = list(ConditionType)[i % len(ConditionType)]
+            job.status.conditions.append(Condition(ct, ConditionStatus.TRUE, str(i)))
+        job.status.set_condition(ConditionType.READY, ConditionStatus.TRUE, "r", now=1.0)
+        assert len(job.status.conditions) <= 10
+
+    def test_phases_and_helpers(self):
+        job = make_worker_job()
+        assert not job.is_done()
+        job.status.phase = JobPhase.FAILED
+        assert job.is_done()
+        assert job.worker_spec() is not None
+        assert job.local_spec() is None
+
+    def test_deepcopy_isolates(self):
+        job = make_worker_job()
+        cp = job.deepcopy()
+        cp.status.phase = JobPhase.RUNNING
+        cp.spec.replica_specs[0].tpu.num_slices = 9
+        assert job.status.phase == JobPhase.NONE
+        assert job.spec.replica_specs[0].tpu.num_slices == 1
