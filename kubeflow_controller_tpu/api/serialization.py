@@ -4,16 +4,22 @@ The reference registers a CRD and lets the apiserver+client-gen do this
 (``examples/crd/crd.yml``, vendored deepcopy/scheme); here the manifest format
 is first-party. Field names are camelCase on the wire to keep kubectl-style
 manifests familiar (compare ``examples/tfjob/dist.yml`` in the reference).
+
+Malformed manifests fail with ``ValidationError`` carrying *every* problem
+found, each prefixed with its manifest path — the same contract as admission
+validation (``api/validation.py``).
 """
 
 from __future__ import annotations
 
+import enum
 from dataclasses import fields, is_dataclass
-from typing import Any, Dict, IO, Union
+from typing import Any, Dict, IO, List, Union
 
 import yaml
 
 from kubeflow_controller_tpu.api import core, types
+from kubeflow_controller_tpu.api.validation import ValidationError
 
 
 def _camel(name: str) -> str:
@@ -48,13 +54,13 @@ def _to_wire(obj: Any) -> Any:
         return out
     if isinstance(obj, dict):
         return {
-            (k.value if hasattr(k, "value") else k): _to_wire(v)
+            (k.value if isinstance(k, enum.Enum) else k): _to_wire(v)
             for k, v in obj.items()
         }
     if isinstance(obj, (list, tuple)):
         return [_to_wire(v) for v in obj]
-    if hasattr(obj, "value") and isinstance(obj, object) and hasattr(type(obj), "__members__"):
-        return obj.value  # Enum
+    if isinstance(obj, enum.Enum):
+        return obj.value
     return obj
 
 
@@ -62,18 +68,6 @@ def job_to_dict(job: types.TPUJob) -> Dict[str, Any]:
     out = {"apiVersion": job.api_version, "kind": job.kind}
     out.update(_to_wire(job))
     return out
-
-
-def _build(cls: type, data: Dict[str, Any]) -> Any:
-    fmap = _field_map(cls)
-    kwargs: Dict[str, Any] = {}
-    type_hints = {f.name: f.type for f in fields(cls)}
-    for wire_key, val in data.items():
-        attr = fmap.get(wire_key)
-        if attr is None:
-            continue  # tolerate unknown fields, like the apiserver's pruning
-        kwargs[attr] = _coerce(cls, attr, val, type_hints[attr])
-    return cls(**kwargs)
 
 
 # Nested dataclass/enum field types, by (owner class, attr name).
@@ -101,34 +95,79 @@ _NESTED = {
 }
 
 
-def _coerce(owner: type, attr: str, val: Any, hint: Any) -> Any:
+def _build(cls: type, data: Dict[str, Any], path: str, errs: List[str]) -> Any:
+    fmap = _field_map(cls)
+    kwargs: Dict[str, Any] = {}
+    for wire_key, val in data.items():
+        attr = fmap.get(wire_key)
+        if attr is None:
+            continue  # tolerate unknown fields, like the apiserver's pruning
+        coerced = _coerce(cls, attr, val, f"{path}.{wire_key}" if path else wire_key, errs)
+        if coerced is not _SKIP:
+            kwargs[attr] = coerced
+    try:
+        return cls(**kwargs)
+    except TypeError as e:
+        errs.append(f"{path or cls.__name__}: {e}")
+        return cls()
+
+
+_SKIP = object()
+
+
+def _coerce(owner: type, attr: str, val: Any, path: str, errs: List[str]) -> Any:
     target = _NESTED.get((owner, attr))
     if target is None:
         if owner is types.ReplicaStatus and attr == "states" and isinstance(val, dict):
-            return {types.ReplicaState(k): v for k, v in val.items()}
+            out = {}
+            for k, v in val.items():
+                try:
+                    out[types.ReplicaState(k)] = v
+                except ValueError:
+                    errs.append(f"{path}: unknown replica state {k!r}")
+            return out
         return val
+    if isinstance(target, type) and issubclass(target, enum.Enum):
+        try:
+            return target(val)
+        except ValueError:
+            valid = ", ".join(m.value for m in target if m.value)
+            errs.append(f"{path}: {val!r} is not one of [{valid}]")
+            return _SKIP
     if isinstance(val, list):
-        return [
-            _build(target, v) if isinstance(v, dict) else target(v) for v in val
-        ]
+        out = []
+        for i, v in enumerate(val):
+            if isinstance(v, dict):
+                out.append(_build(target, v, f"{path}[{i}]", errs))
+            else:
+                errs.append(f"{path}[{i}]: expected a mapping, got {type(v).__name__}")
+        return out
     if isinstance(val, dict):
-        return _build(target, val)
-    return target(val)  # enum scalar
+        return _build(target, val, path, errs)
+    errs.append(f"{path}: expected a mapping, got {type(val).__name__}")
+    return _SKIP
 
 
 def job_from_dict(data: Dict[str, Any]) -> types.TPUJob:
+    errs: List[str] = []
     kind = data.get("kind", types.KIND)
     if kind != types.KIND:
-        raise ValueError(f"expected kind {types.KIND}, got {kind!r}")
-    job = _build(types.TPUJob, data)
+        errs.append(f"kind: expected {types.KIND}, got {kind!r}")
+    job = _build(types.TPUJob, data, "", errs)
+    if errs:
+        raise ValidationError(errs)
     return job
 
 
 def load_job_yaml(src: Union[str, IO[str]]) -> types.TPUJob:
-    """Load a TPUJob from a YAML string or open file."""
-    data = yaml.safe_load(src)
+    """Load a TPUJob from a YAML string or open file. Raises ValidationError
+    (with manifest paths) on anything malformed, including YAML syntax."""
+    try:
+        data = yaml.safe_load(src)
+    except yaml.YAMLError as e:
+        raise ValidationError([f"invalid YAML: {e}"]) from None
     if not isinstance(data, dict):
-        raise ValueError("manifest did not parse to a mapping")
+        raise ValidationError(["manifest did not parse to a mapping"])
     return job_from_dict(data)
 
 

@@ -43,8 +43,10 @@ class SliceShape:
 
 
 def _v5e(chips: int, topo: Tuple[int, ...]) -> SliceShape:
-    # v5e ("v5 lite") hosts carry up to 8 chips; sub-host slices exist.
-    return SliceShape(f"v5e-{chips}", "v5e", chips, topo, min(chips, 8))
+    # v5e ("v5 lite"): single-host slices pack up to 8 chips on one VM
+    # (ct5lp-hightpu-8t); multi-host slices (16 chips and up) attach 4 chips
+    # per host VM.
+    return SliceShape(f"v5e-{chips}", "v5e", chips, topo, 8 if chips <= 8 else 4)
 
 
 def _v5p(chips: int, topo: Tuple[int, ...]) -> SliceShape:
@@ -57,7 +59,9 @@ def _v4(chips: int, topo: Tuple[int, ...]) -> SliceShape:
 
 
 def _v6e(chips: int, topo: Tuple[int, ...]) -> SliceShape:
-    return SliceShape(f"v6e-{chips}", "v6e", chips, topo, min(chips, 8))
+    # v6e (Trillium): same host geometry as v5e — 8-chip single-host slices,
+    # 4 chips per host for multi-host.
+    return SliceShape(f"v6e-{chips}", "v6e", chips, topo, 8 if chips <= 8 else 4)
 
 
 TPU_SLICE_CATALOG: Dict[str, SliceShape] = {

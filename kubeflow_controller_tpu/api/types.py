@@ -181,23 +181,35 @@ class TPUJobStatus:
         """Upsert a condition; returns True if anything changed. Keeps at most
         MAX_CONDITIONS entries, newest last."""
         now = time.time() if now is None else now
-        changed = True
-        for cond in self.conditions:
-            if cond.type == ctype:
-                if cond.status == status and cond.reason == reason:
-                    changed = False
-                else:
-                    cond.status = status
-                    cond.reason = reason
-                    cond.message = message
-                    cond.last_transition_time = now
-                break
+        existing = self.get_condition(ctype)
+        if (
+            existing is not None
+            and existing.status == status
+            and existing.reason == reason
+            and existing.message == message
+        ):
+            return False
+        if existing is not None:
+            # Transition time only moves when status flips, matching k8s
+            # lastTransitionTime semantics; reason/message refreshes keep it.
+            if existing.status != status:
+                existing.last_transition_time = now
+            existing.status = status
+            existing.reason = reason
+            existing.message = message
+            updated = existing
         else:
-            self.conditions.append(
-                Condition(ctype, status, reason, message, last_transition_time=now)
-            )
+            updated = Condition(ctype, status, reason, message,
+                                last_transition_time=now)
+        # Newest-last invariant: the touched condition moves to the tail, so
+        # the cap trims oldest first and can never trim what was just set
+        # (duplicate types from direct manipulation get squeezed out too).
+        self.conditions = [
+            c for c in self.conditions if c is not updated and c.type != ctype
+        ]
+        self.conditions.append(updated)
         del self.conditions[:-MAX_CONDITIONS]
-        return changed
+        return True
 
     def get_condition(self, ctype: ConditionType) -> Optional[Condition]:
         for cond in self.conditions:

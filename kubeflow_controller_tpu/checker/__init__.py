@@ -1,0 +1,5 @@
+from kubeflow_controller_tpu.checker.checker import (
+    HealthReport,
+    assess_health,
+    is_local_job,
+)

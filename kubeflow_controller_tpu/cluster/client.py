@@ -1,0 +1,109 @@
+"""ClusterClient — the effector seam.
+
+The exact boundary the reference drew with ``HelperInterface`` /
+``PodControlInterface`` / ``ServiceControlInterface``
+(``pkg/controller/helper.go:42-47``, ``pkg/controller/control/service.go:32-39``):
+everything above this interface is testable against the fake cluster;
+a real-cluster (GKE) adapter implements the same protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Protocol
+
+from kubeflow_controller_tpu.api.core import Pod, Service
+from kubeflow_controller_tpu.api.types import TPUJob
+from kubeflow_controller_tpu.cluster.cluster import FakeCluster
+
+
+class PodCreateRefused(RuntimeError):
+    """Injected or real apiserver-side create failure."""
+
+
+class ClusterClient(Protocol):
+    """Effector + read API the reconcile core is written against."""
+
+    def create_pod(self, pod: Pod) -> Pod: ...
+    def delete_pod(self, namespace: str, name: str) -> None: ...
+    def list_pods(self, namespace: str, selector: Dict[str, str]) -> List[Pod]: ...
+    def update_pod(self, pod: Pod) -> Pod: ...
+
+    def create_service(self, svc: Service) -> Service: ...
+    def delete_service(self, namespace: str, name: str) -> None: ...
+    def list_services(self, namespace: str, selector: Dict[str, str]) -> List[Service]: ...
+    def update_service(self, svc: Service) -> Service: ...
+
+    def get_job(self, namespace: str, name: str) -> Optional[TPUJob]: ...
+    def update_job(self, job: TPUJob) -> TPUJob: ...
+
+    def record_event(self, kind: str, name: str, reason: str, message: str) -> None: ...
+    def release_slices(self, job_uid: str) -> int: ...
+    def job_slices(self, job_uid: str): ...
+
+
+class FakeClusterClient:
+    """ClusterClient over the in-process FakeCluster."""
+
+    def __init__(self, cluster: FakeCluster):
+        self.cluster = cluster
+
+    # -- pods ---------------------------------------------------------------
+
+    def create_pod(self, pod: Pod) -> Pod:
+        if self.cluster.faults.fail_pod_creates > 0:
+            self.cluster.faults.fail_pod_creates -= 1
+            self.record_event("Pod", pod.metadata.name or pod.metadata.generate_name,
+                              "FailedCreate", "injected create failure")
+            raise PodCreateRefused("injected pod create failure")
+        created = self.cluster.pods.create(pod)
+        self.record_event("Pod", created.metadata.name, "SuccessfulCreate",
+                          f"created pod {created.metadata.name}")
+        return created
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self.cluster.pods.delete(namespace, name)
+        self.record_event("Pod", name, "SuccessfulDelete", f"deleted pod {name}")
+
+    def list_pods(self, namespace: str, selector: Dict[str, str]) -> List[Pod]:
+        return self.cluster.pods.list(namespace, selector or None)
+
+    def update_pod(self, pod: Pod) -> Pod:
+        return self.cluster.pods.update(pod)
+
+    # -- services -----------------------------------------------------------
+
+    def create_service(self, svc: Service) -> Service:
+        created = self.cluster.services.create(svc)
+        self.record_event("Service", created.metadata.name, "SuccessfulCreate",
+                          f"created service {created.metadata.name}")
+        return created
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        self.cluster.services.delete(namespace, name)
+        self.record_event("Service", name, "SuccessfulDelete",
+                          f"deleted service {name}")
+
+    def list_services(self, namespace: str, selector: Dict[str, str]) -> List[Service]:
+        return self.cluster.services.list(namespace, selector or None)
+
+    def update_service(self, svc: Service) -> Service:
+        return self.cluster.services.update(svc)
+
+    # -- jobs ---------------------------------------------------------------
+
+    def get_job(self, namespace: str, name: str) -> Optional[TPUJob]:
+        return self.cluster.jobs.try_get(namespace, name)
+
+    def update_job(self, job: TPUJob) -> TPUJob:
+        return self.cluster.jobs.update(job)
+
+    # -- misc ---------------------------------------------------------------
+
+    def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
+        self.cluster.record_event(kind, name, reason, message)
+
+    def release_slices(self, job_uid: str) -> int:
+        return self.cluster.slice_pool.release(job_uid)
+
+    def job_slices(self, job_uid: str):
+        return self.cluster.slice_pool.holdings(job_uid)

@@ -1,0 +1,277 @@
+"""FakeCluster: apiserver + gang-aware TPU scheduler + kubelet, in-process.
+
+The hermetic test bed the reference never had (SURVEY.md §4: its multi-node
+behavior was validated by hand against minikube). Deterministic: time advances
+only via ``tick()``, so reconcile/preemption/recovery tests replay exactly.
+
+Lifecycle model per pod (simulated kubelet):
+
+    created --(gang admission grants a slice; Local pods skip the gang)-->
+    scheduled --(start_delay)--> Running --(run_duration)--> Succeeded/Failed
+
+A pod may instead run *real work* (e.g. an actual JAX train step) via
+``PodRunPolicy.run_fn`` — that is how "submit YAML → reconcile → pod runs real
+training → Succeeded" is exercised end-to-end with no real cluster.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from kubeflow_controller_tpu.api.core import Pod, PodPhase, Service
+from kubeflow_controller_tpu.cluster.slices import (
+    InsufficientCapacity,
+    SlicePool,
+    TPUSlice,
+)
+from kubeflow_controller_tpu.cluster.store import ObjectStore
+
+# Well-known annotations the controller stamps on pods it creates; the fake
+# scheduler reads them to drive gang admission. (The TPU analog of the
+# reference's identity labels, distributed.go:221-228.)
+ANNOTATION_GANG_SIZE = "tpu.kubeflow.dev/gang-size"
+ANNOTATION_ACCELERATOR = "tpu.kubeflow.dev/accelerator-type"
+ANNOTATION_NUM_SLICES = "tpu.kubeflow.dev/num-slices"
+ANNOTATION_SLICE_INDEX = "tpu.kubeflow.dev/slice-index"
+ANNOTATION_HOST_INDEX = "tpu.kubeflow.dev/host-index"
+
+REASON_PREEMPTED = "Preempted"
+
+
+@dataclass
+class PodRunPolicy:
+    """How the fake kubelet runs a pod once its gang is admitted."""
+
+    start_delay: float = 0.0     # scheduled -> Running (image pull etc.)
+    run_duration: float = 0.0    # Running -> terminal
+    exit_code: int = 0           # terminal exit code (0 => Succeeded)
+    # Real work: called once when the pod transitions to Running; its return
+    # value becomes the exit code (overrides ``exit_code``). Runs in the
+    # tick thread — keep it bounded (a short real JAX program is fine).
+    run_fn: Optional[Callable[[Pod], int]] = None
+    # If >= 0, the pod crashes with this code after run_duration instead of
+    # exiting cleanly (fault injection).
+    crash_code: int = -1
+
+
+@dataclass
+class FaultInjector:
+    """Knobs tests turn to break the cluster on purpose (SURVEY.md §7.2)."""
+
+    # Fail the next N pod-create calls at the client seam.
+    fail_pod_creates: int = 0
+    # Extra scheduling latency applied to every gang (slow provisioning).
+    gang_admission_delay: float = 0.0
+    # Pod-name -> policy override (e.g. crash worker 3).
+    pod_policies: Dict[str, PodRunPolicy] = field(default_factory=dict)
+
+
+@dataclass
+class _PodRuntime:
+    scheduled_at: Optional[float] = None
+    started_at: Optional[float] = None
+    gang_waiting_since: Optional[float] = None
+
+
+class FakeCluster:
+    """Facade over the stores + slice pool + simulated scheduler/kubelet."""
+
+    def __init__(self, default_policy: Optional[PodRunPolicy] = None):
+        # All stores stamp creation timestamps on the cluster's simulated
+        # clock so control-plane latency metrics are internally consistent.
+        self.pods = ObjectStore("Pod", now_fn=lambda: self.now)
+        self.services = ObjectStore("Service", now_fn=lambda: self.now)
+        self.jobs = ObjectStore("TPUJob", now_fn=lambda: self.now)
+        self.slice_pool = SlicePool()
+        self.faults = FaultInjector()
+        self.default_policy = default_policy or PodRunPolicy(
+            start_delay=1.0, run_duration=5.0
+        )
+        self.now = 0.0
+        self._runtimes: Dict[str, _PodRuntime] = {}
+        self._lock = threading.RLock()
+        # Cluster events (k8s Events analog): list of (time, kind, name,
+        # reason, message) — the observability surface record.EventRecorder
+        # provides in the reference (controller.go:91-94).
+        self.cluster_events: List[tuple] = []
+
+    # -- event recording -----------------------------------------------------
+
+    def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
+        with self._lock:
+            self.cluster_events.append((self.now, kind, name, reason, message))
+
+    # -- time ----------------------------------------------------------------
+
+    def tick(self, dt: float = 1.0, steps: int = 1) -> None:
+        """Advance simulated time and run scheduler + kubelet transitions."""
+        for _ in range(steps):
+            with self._lock:
+                self.now += dt
+            self._schedule_pending()
+            self._advance_pods()
+
+    def run_until(
+        self,
+        predicate: Callable[[], bool],
+        dt: float = 1.0,
+        max_steps: int = 1000,
+    ) -> bool:
+        """Tick until predicate() or step budget exhausted."""
+        for _ in range(max_steps):
+            if predicate():
+                return True
+            self.tick(dt)
+        return predicate()
+
+    # -- scheduler (gang admission) -----------------------------------------
+
+    def _pod_policy(self, pod: Pod) -> PodRunPolicy:
+        return self.faults.pod_policies.get(pod.metadata.name, self.default_policy)
+
+    def _runtime(self, pod: Pod) -> _PodRuntime:
+        return self._runtimes.setdefault(pod.metadata.uid, _PodRuntime())
+
+    def _schedule_pending(self) -> None:
+        pending = [
+            p for p in self.pods.list()
+            if p.status.phase == PodPhase.PENDING and not p.spec.assigned_slice
+            and p.metadata.deletion_timestamp is None
+        ]
+        gangs: Dict[str, List[Pod]] = {}
+        for pod in pending:
+            group = pod.spec.scheduling_group
+            if not group:
+                self._bind_local(pod)
+            else:
+                gangs.setdefault(group, []).append(pod)
+
+        for group, members in gangs.items():
+            self._try_admit_gang(group, members)
+
+    def _bind_local(self, pod: Pod) -> None:
+        rt = self._runtime(pod)
+        if rt.scheduled_at is None:
+            rt.scheduled_at = self.now
+            self.record_event("Pod", pod.metadata.name, "Scheduled", "bound to local node")
+
+    def _try_admit_gang(self, group: str, members: List[Pod]) -> None:
+        expected = int(members[0].metadata.annotations.get(ANNOTATION_GANG_SIZE, 0))
+        if expected <= 0 or len(members) < expected:
+            return  # gang incomplete: nothing is admitted (all-or-nothing)
+        rt0 = self._runtime(members[0])
+        if rt0.gang_waiting_since is None:
+            for m in members:
+                self._runtime(m).gang_waiting_since = self.now
+        if self.now - rt0.gang_waiting_since < self.faults.gang_admission_delay:
+            return
+        accel = members[0].metadata.annotations.get(ANNOTATION_ACCELERATOR, "")
+        num_slices = int(members[0].metadata.annotations.get(ANNOTATION_NUM_SLICES, 1))
+        job_uid = group
+        try:
+            slices = self.slice_pool.allocate_gang(job_uid, accel, num_slices)
+        except (InsufficientCapacity, KeyError) as e:
+            self.record_event("Gang", group, "FailedScheduling", str(e))
+            return
+        # Bind: pod (slice_index, host_index) -> slice host.
+        by_index = sorted(
+            members,
+            key=lambda p: (
+                int(p.metadata.annotations.get(ANNOTATION_SLICE_INDEX, 0)),
+                int(p.metadata.annotations.get(ANNOTATION_HOST_INDEX, 0)),
+            ),
+        )
+        for pod in by_index:
+            si = int(pod.metadata.annotations.get(ANNOTATION_SLICE_INDEX, 0))
+            hi = int(pod.metadata.annotations.get(ANNOTATION_HOST_INDEX, 0))
+            sl = slices[si]
+            def bind(p: Pod, sl: TPUSlice = sl, hi: int = hi) -> None:
+                p.spec.assigned_slice = sl.name
+                p.status.host_ip = sl.hosts[hi % len(sl.hosts)]
+            self.pods.mutate(pod.metadata.namespace, pod.metadata.name, bind)
+            self._runtime(pod).scheduled_at = self.now
+        self.record_event(
+            "Gang", group, "GangScheduled",
+            f"{len(members)} pods on {num_slices}x{accel}",
+        )
+
+    # -- kubelet -------------------------------------------------------------
+
+    def _advance_pods(self) -> None:
+        for pod in self.pods.list():
+            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+            rt = self._runtime(pod)
+            policy = self._pod_policy(pod)
+            if pod.status.phase == PodPhase.PENDING:
+                if rt.scheduled_at is None:
+                    continue  # unscheduled (waiting on gang)
+                if self.now - rt.scheduled_at >= policy.start_delay:
+                    rt.started_at = self.now
+                    self._transition(pod, PodPhase.RUNNING)
+                    if policy.run_fn is not None:
+                        code = policy.run_fn(self.pods.get(
+                            pod.metadata.namespace, pod.metadata.name))
+                        self._finish(pod, code)
+            elif pod.status.phase == PodPhase.RUNNING:
+                if policy.run_fn is not None:
+                    continue  # run_fn pods finish synchronously above
+                if rt.started_at is not None and (
+                    self.now - rt.started_at >= policy.run_duration
+                ):
+                    code = policy.crash_code if policy.crash_code >= 0 else policy.exit_code
+                    self._finish(pod, code)
+
+    def _transition(self, pod: Pod, phase: PodPhase) -> None:
+        def mut(p: Pod) -> None:
+            p.status.phase = phase
+            if phase == PodPhase.RUNNING:
+                p.status.start_time = self.now
+        self.pods.mutate(pod.metadata.namespace, pod.metadata.name, mut)
+
+    def _finish(self, pod: Pod, exit_code: int) -> None:
+        phase = PodPhase.SUCCEEDED if exit_code == 0 else PodPhase.FAILED
+        def mut(p: Pod) -> None:
+            p.status.phase = phase
+            p.status.exit_code = exit_code
+            p.status.finish_time = self.now
+            if phase == PodPhase.FAILED and not p.status.reason:
+                p.status.reason = f"ExitCode{exit_code}"
+        self.pods.mutate(pod.metadata.namespace, pod.metadata.name, mut)
+
+    # -- fault injection ----------------------------------------------------
+
+    def preempt_slice(self, slice_name: str) -> List[str]:
+        """Preempt a slice: evict holder, fail every pod bound to it with
+        reason Preempted. Returns names of failed pods."""
+        self.slice_pool.preempt(slice_name)
+        failed = []
+        for pod in self.pods.list():
+            if pod.spec.assigned_slice == slice_name and pod.status.phase in (
+                PodPhase.PENDING, PodPhase.RUNNING,
+            ):
+                def mut(p: Pod) -> None:
+                    p.status.phase = PodPhase.FAILED
+                    p.status.reason = REASON_PREEMPTED
+                    p.status.message = f"slice {slice_name} was preempted"
+                    p.status.finish_time = self.now
+                self.pods.mutate(pod.metadata.namespace, pod.metadata.name, mut)
+                failed.append(pod.metadata.name)
+        self.record_event("Slice", slice_name, REASON_PREEMPTED,
+                          f"evicted {len(failed)} pods")
+        return failed
+
+    def crash_pod(self, namespace: str, name: str, exit_code: int = 137) -> None:
+        pod = self.pods.get(namespace, name)
+        self._finish(pod, exit_code)
+
+    # -- DNS -----------------------------------------------------------------
+
+    def resolve(self, dns_name: str) -> Optional[Service]:
+        """Resolve '<svc>.<ns>.svc' the way cluster DNS would."""
+        parts = dns_name.split(".")
+        if len(parts) < 2:
+            return None
+        return self.services.try_get(parts[1], parts[0])

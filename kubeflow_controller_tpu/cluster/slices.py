@@ -1,0 +1,153 @@
+"""TPU slice inventory and gang allocation.
+
+The piece with no reference analog at all (SURVEY.md §2.5 "Gang semantics:
+No"): the reference schedules pods one-by-one onto generic nodes
+(``controller.go:396-421``); a TPU pod-slice is useless partially scheduled,
+so admission here is all-or-nothing per gang. This module models the node-pool
+side: which physical slices exist, which jobs hold them, and preemption.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubeflow_controller_tpu.api.topology import SliceShape, slice_shape
+
+
+@dataclass
+class TPUSlice:
+    """One physical pod-slice in a node pool."""
+
+    name: str                      # e.g. "pool-v5e-16/slice-0"
+    shape: SliceShape
+    # Job uid currently holding the slice ("" = free).
+    holder: str = ""
+    healthy: bool = True
+    # Host VM DNS-ish names, one per host process.
+    hosts: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.hosts:
+            self.hosts = [
+                f"{self.name.replace('/', '-')}-host-{i}"
+                for i in range(self.shape.num_hosts)
+            ]
+
+
+class InsufficientCapacity(RuntimeError):
+    pass
+
+
+class SlicePool:
+    """Inventory of TPU slices, grouped by accelerator type.
+
+    ``allocate_gang`` is atomic: either every requested slice is reserved for
+    the job or none is. This is the cluster-side half of gang scheduling; the
+    controller-side half (create all pods of the gang in one sync or none)
+    lives in ``kubeflow_controller_tpu.tpu.gang``.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slices: Dict[str, TPUSlice] = {}
+
+    def add_pool(self, accelerator_type: str, count: int, pool_name: str = "") -> List[str]:
+        """Provision ``count`` slices of a type; returns their names."""
+        shape = slice_shape(accelerator_type)
+        pool = pool_name or f"pool-{accelerator_type}"
+        names = []
+        with self._lock:
+            base = sum(
+                1 for s in self._slices.values()
+                if s.shape.accelerator_type == accelerator_type
+            )
+            for i in range(count):
+                name = f"{pool}/slice-{base + i}"
+                self._slices[name] = TPUSlice(name=name, shape=shape)
+                names.append(name)
+        return names
+
+    def get(self, name: str) -> TPUSlice:
+        with self._lock:
+            return self._slices[name]
+
+    def list(self, accelerator_type: Optional[str] = None) -> List[TPUSlice]:
+        with self._lock:
+            return [
+                s for s in self._slices.values()
+                if accelerator_type is None
+                or s.shape.accelerator_type == accelerator_type
+            ]
+
+    def free(self, accelerator_type: str) -> List[TPUSlice]:
+        return [
+            s for s in self.list(accelerator_type) if not s.holder and s.healthy
+        ]
+
+    def allocate_gang(
+        self, job_uid: str, accelerator_type: str, num_slices: int
+    ) -> List[TPUSlice]:
+        """Atomically reserve ``num_slices`` healthy free slices for a job.
+
+        Idempotent per job: slices already held by ``job_uid`` count toward
+        the request (so a re-sync after partial observation cannot
+        double-allocate — the expectations-race discipline of
+        ``controller.go:259-262`` applied to slices).
+        """
+        with self._lock:
+            held = [
+                s for s in self._slices.values()
+                if s.holder == job_uid
+                and s.shape.accelerator_type == accelerator_type
+                and s.healthy
+            ]
+            need = num_slices - len(held)
+            if need <= 0:
+                return held[:num_slices]
+            avail = [
+                s for s in self._slices.values()
+                if not s.holder and s.healthy
+                and s.shape.accelerator_type == accelerator_type
+            ]
+            if len(avail) < need:
+                raise InsufficientCapacity(
+                    f"need {need} more {accelerator_type} slices for job "
+                    f"{job_uid}, only {len(avail)} free"
+                )
+            granted = avail[:need]
+            for s in granted:
+                s.holder = job_uid
+            return held + granted
+
+    def release(self, job_uid: str) -> int:
+        """Free every slice a job holds; returns count released."""
+        with self._lock:
+            n = 0
+            for s in self._slices.values():
+                if s.holder == job_uid:
+                    s.holder = ""
+                    n += 1
+            return n
+
+    def holdings(self, job_uid: str) -> List[TPUSlice]:
+        with self._lock:
+            return [s for s in self._slices.values() if s.holder == job_uid]
+
+    # -- fault injection ----------------------------------------------------
+
+    def preempt(self, name: str) -> str:
+        """Simulate slice preemption: mark unhealthy, evict holder.
+        Returns the evicted job uid ("" if free)."""
+        with self._lock:
+            s = self._slices[name]
+            evicted = s.holder
+            s.holder = ""
+            s.healthy = False
+            return evicted
+
+    def restore(self, name: str) -> None:
+        """Bring a preempted/unhealthy slice back into service."""
+        with self._lock:
+            self._slices[name].healthy = True

@@ -1,0 +1,193 @@
+"""Versioned, watchable object store — the apiserver's storage semantics,
+in-process.
+
+What the reference trusts etcd + the apiserver for, rebuilt so tests mean
+something (SURVEY.md §7 "hard parts" #3):
+
+- resource versions bump on every write;
+- updates are optimistic-concurrency checked (the reference does whole-object
+  PUT with no conflict handling, ``controller.go:630-636`` — a listed bug);
+- reads return deep copies (the reference mutates informer-cached objects in
+  place, ``updater/distributed.go:51-54`` — another listed bug; copies make
+  that class of corruption impossible here);
+- every mutation emits a WatchEvent to subscribers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from kubeflow_controller_tpu.api.core import new_uid
+from kubeflow_controller_tpu.cluster.events import EventType, WatchEvent
+
+
+class NotFound(KeyError):
+    pass
+
+
+class AlreadyExists(ValueError):
+    pass
+
+
+class Conflict(ValueError):
+    """Optimistic-concurrency failure: stored resource_version moved on."""
+
+
+Listener = Callable[[WatchEvent], None]
+
+
+class ObjectStore:
+    """Thread-safe store for one kind (Pods, Services, or TPUJobs).
+
+    Objects are any dataclass with ``.metadata`` (ObjectMeta) and
+    ``.deepcopy()``. Keys are ``namespace/name``.
+    """
+
+    def __init__(self, kind: str, now_fn: Callable[[], float] = time.time):
+        self.kind = kind
+        self._now_fn = now_fn
+        self._lock = threading.RLock()
+        self._objects: Dict[str, Any] = {}
+        self._rv = 0
+        self._listeners: List[Listener] = []
+
+    # -- watch ---------------------------------------------------------------
+
+    def subscribe(self, listener: Listener, replay: bool = True) -> None:
+        """Register a watch listener. With ``replay``, synthesizes ADDED events
+        for existing objects first (how a fresh informer list+watch behaves)."""
+        with self._lock:
+            events = [
+                WatchEvent(EventType.ADDED, self.kind, obj.deepcopy())
+                for obj in self._objects.values()
+            ] if replay else []
+            self._listeners.append(listener)
+        for ev in events:
+            listener(ev)
+
+    def _emit(self, ev: WatchEvent) -> None:
+        for listener in list(self._listeners):
+            listener(ev)
+
+    # -- CRUD ----------------------------------------------------------------
+
+    @staticmethod
+    def key_of(obj: Any) -> str:
+        return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+    def create(self, obj: Any) -> Any:
+        with self._lock:
+            meta = obj.metadata
+            if not meta.name:
+                if not meta.generate_name:
+                    raise ValueError("object needs name or generate_name")
+                # GenerateName semantics: apiserver-side random-ish suffix
+                # (reference pods get theirs from GetPodFromTemplate,
+                # controller_utils.go:564-570).
+                meta.name = meta.generate_name + new_uid("")[4:9]
+            key = self.key_of(obj)
+            if key in self._objects:
+                raise AlreadyExists(key)
+            if not meta.uid:
+                meta.uid = new_uid(self.kind.lower())
+            self._rv += 1
+            meta.resource_version = self._rv
+            if not meta.creation_timestamp:
+                meta.creation_timestamp = self._now_fn()
+            stored = obj.deepcopy()
+            self._objects[key] = stored
+            ev = WatchEvent(EventType.ADDED, self.kind, stored.deepcopy())
+        self._emit(ev)
+        return stored.deepcopy()
+
+    def get(self, namespace: str, name: str) -> Any:
+        with self._lock:
+            obj = self._objects.get(f"{namespace}/{name}")
+            if obj is None:
+                raise NotFound(f"{self.kind} {namespace}/{name}")
+            return obj.deepcopy()
+
+    def try_get(self, namespace: str, name: str) -> Optional[Any]:
+        try:
+            return self.get(namespace, name)
+        except NotFound:
+            return None
+
+    def update(self, obj: Any, enforce_rv: bool = True) -> Any:
+        """Optimistic update: fails with Conflict when the caller's copy is
+        stale (the safety net the reference lacks, SURVEY.md §8)."""
+        with self._lock:
+            key = self.key_of(obj)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFound(f"{self.kind} {key}")
+            if enforce_rv and obj.metadata.resource_version != cur.metadata.resource_version:
+                raise Conflict(
+                    f"{self.kind} {key}: stale resource_version "
+                    f"{obj.metadata.resource_version} != {cur.metadata.resource_version}"
+                )
+            if cur.metadata.uid and obj.metadata.uid != cur.metadata.uid:
+                raise Conflict(f"{self.kind} {key}: uid changed (delete+recreate race)")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            old = cur
+            stored = obj.deepcopy()
+            self._objects[key] = stored
+            ev = WatchEvent(EventType.MODIFIED, self.kind, stored.deepcopy(), old.deepcopy())
+        self._emit(ev)
+        return stored.deepcopy()
+
+    def mutate(self, namespace: str, name: str, fn: Callable[[Any], None]) -> Any:
+        """Read-modify-write with internal retry — the conflict-safe update
+        helper status writers use."""
+        while True:
+            obj = self.get(namespace, name)
+            fn(obj)
+            try:
+                return self.update(obj)
+            except Conflict:
+                continue
+
+    def delete(self, namespace: str, name: str) -> Any:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            obj = self._objects.pop(key, None)
+            if obj is None:
+                raise NotFound(f"{self.kind} {key}")
+            self._rv += 1
+            ev = WatchEvent(EventType.DELETED, self.kind, obj.deepcopy())
+        self._emit(ev)
+        return obj
+
+    # -- listing -------------------------------------------------------------
+
+    def list(
+        self,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        with self._lock:
+            out = []
+            for key, obj in self._objects.items():
+                if namespace is not None and obj.metadata.namespace != namespace:
+                    continue
+                if label_selector and not selector_matches(label_selector, obj.metadata.labels):
+                    continue
+                out.append(obj.deepcopy())
+            return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._objects)
+
+
+def selector_matches(selector: Dict[str, str], labels: Dict[str, str]) -> bool:
+    """Equality-based label selector (the only kind the reference uses,
+    ``pkg/tensorflow/distributed.go:221-228``)."""
+    return all(labels.get(k) == v for k, v in selector.items())

@@ -1,0 +1,12 @@
+"""Reconcile core: workqueue, expectations, informers, ownership, controller.
+
+The TPU-native rebuild of ``pkg/controller`` (reference
+``pkg/controller/controller.go``): a level-triggered, expectation-guarded
+reconcile loop whose domain decisions are pure functions and whose effects
+happen only at the ClusterClient seam.
+"""
+
+from kubeflow_controller_tpu.controller.workqueue import RateLimitingQueue
+from kubeflow_controller_tpu.controller.expectations import ControllerExpectations
+from kubeflow_controller_tpu.controller.informer import Informer
+from kubeflow_controller_tpu.controller.controller import Controller, ControllerOptions

@@ -1,0 +1,146 @@
+"""Rate-limited deduplicating work queue.
+
+Semantics rebuilt from client-go's workqueue as the reference uses it
+(``pkg/controller/controller.go:116,194-243``):
+
+- an item present in the queue or currently processing is not enqueued twice
+  ("it's fine if the same key is added while being processed — it re-queues",
+  the property the single-key-at-a-time discipline relies on);
+- ``add_rate_limited`` applies per-item exponential backoff;
+- ``forget`` resets an item's failure count after a successful sync.
+
+Implementation is condition-variable based, no busy waiting; delayed items are
+released by whichever waiter wakes first.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, Hashable, List, Optional, Set, Tuple
+
+
+class RateLimitingQueue:
+    def __init__(
+        self,
+        base_delay: float = 0.005,
+        max_delay: float = 60.0,
+    ):
+        self._base_delay = base_delay
+        self._max_delay = max_delay
+        self._cond = threading.Condition()
+        self._queue: List[Hashable] = []       # FIFO of ready items
+        self._queued: Set[Hashable] = set()    # ready or waiting-to-be-ready
+        self._processing: Set[Hashable] = set()
+        self._redo: Set[Hashable] = set()      # re-added while processing
+        self._delayed: List[Tuple[float, int, Hashable]] = []  # min-heap
+        self._delayed_seq = 0
+        self._failures: Dict[Hashable, int] = {}
+        self._shutdown = False
+
+    # -- producer side -------------------------------------------------------
+
+    def add(self, item: Hashable) -> None:
+        with self._cond:
+            if self._shutdown:
+                return
+            if item in self._processing:
+                # Level-trigger discipline: remember to redo after Done.
+                self._redo.add(item)
+                return
+            if item in self._queued:
+                return
+            self._queued.add(item)
+            self._queue.append(item)
+            self._cond.notify()
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            if self._shutdown or item in self._queued:
+                return
+            self._queued.add(item)
+            self._delayed_seq += 1
+            heapq.heappush(
+                self._delayed, (time.monotonic() + delay, self._delayed_seq, item)
+            )
+            self._cond.notify()
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        with self._cond:
+            failures = self._failures.get(item, 0)
+            self._failures[item] = failures + 1
+        delay = min(self._base_delay * (2 ** failures), self._max_delay)
+        self.add_after(item, delay)
+
+    def forget(self, item: Hashable) -> None:
+        with self._cond:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: Hashable) -> int:
+        with self._cond:
+            return self._failures.get(item, 0)
+
+    # -- consumer side -------------------------------------------------------
+
+    def _promote_due(self) -> Optional[float]:
+        """Move due delayed items into the FIFO; return seconds until the next
+        delayed item (None if heap empty)."""
+        now = time.monotonic()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, item = heapq.heappop(self._delayed)
+            if item in self._queued:  # not cancelled
+                if item in self._processing:
+                    self._redo.add(item)
+                    self._queued.discard(item)
+                else:
+                    self._queue.append(item)
+        return (self._delayed[0][0] - now) if self._delayed else None
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Hashable]:
+        """Block until an item is ready; None on shutdown or timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                next_due = self._promote_due()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._queued.discard(item)
+                    self._processing.add(item)
+                    return item
+                if self._shutdown:
+                    return None
+                wait = next_due
+                if deadline is not None:
+                    remain = deadline - time.monotonic()
+                    if remain <= 0:
+                        return None
+                    wait = remain if wait is None else min(wait, remain)
+                self._cond.wait(wait)
+
+    def done(self, item: Hashable) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._redo:
+                self._redo.discard(item)
+                self._queued.add(item)
+                self._queue.append(item)
+                self._cond.notify()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue) + len(self._delayed)
+
+    def empty_and_idle(self) -> bool:
+        with self._cond:
+            return not (self._queue or self._delayed or self._processing or self._redo)

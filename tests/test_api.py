@@ -76,8 +76,9 @@ class TestTopology:
 
     def test_known_geometry(self):
         s = slice_shape("v5e-16")
-        assert s.num_hosts == 2  # 16 chips / 8 per host
+        assert s.num_hosts == 4  # multi-host v5e: 4 chips per host VM
         assert s.topology_str == "4x4"
+        assert slice_shape("v5e-8").num_hosts == 1  # single-host 8-chip slice
         s = slice_shape("v5p-64")
         assert s.num_hosts == 16  # 64 chips / 4 per host
         assert s.topology == (4, 4, 4)

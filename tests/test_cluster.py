@@ -1,0 +1,302 @@
+"""Fake cluster tests: store semantics, watch streams, gang admission,
+pod lifecycle, preemption, fault injection."""
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    Service,
+)
+from kubeflow_controller_tpu.cluster import (
+    AlreadyExists,
+    Conflict,
+    EventType,
+    FakeCluster,
+    NotFound,
+    PodRunPolicy,
+)
+from kubeflow_controller_tpu.cluster.client import FakeClusterClient, PodCreateRefused
+from kubeflow_controller_tpu.cluster.cluster import (
+    ANNOTATION_ACCELERATOR,
+    ANNOTATION_GANG_SIZE,
+    ANNOTATION_HOST_INDEX,
+    ANNOTATION_NUM_SLICES,
+    ANNOTATION_SLICE_INDEX,
+    REASON_PREEMPTED,
+)
+from kubeflow_controller_tpu.cluster.slices import InsufficientCapacity, SlicePool
+
+
+def make_pod(name, gang="", annotations=None, labels=None):
+    return Pod(
+        metadata=ObjectMeta(
+            name=name,
+            namespace="default",
+            annotations=dict(annotations or {}),
+            labels=dict(labels or {}),
+        ),
+        spec=PodSpec(
+            containers=[Container(name="trainer")],
+            scheduling_group=gang,
+        ),
+    )
+
+
+def gang_pod(name, gang, accel, gang_size, slice_idx=0, host_idx=0, num_slices=1):
+    return make_pod(
+        name,
+        gang=gang,
+        annotations={
+            ANNOTATION_GANG_SIZE: str(gang_size),
+            ANNOTATION_ACCELERATOR: accel,
+            ANNOTATION_NUM_SLICES: str(num_slices),
+            ANNOTATION_SLICE_INDEX: str(slice_idx),
+            ANNOTATION_HOST_INDEX: str(host_idx),
+        },
+    )
+
+
+class TestStore:
+    def test_create_get_deepcopy_isolation(self):
+        c = FakeCluster()
+        pod = make_pod("a")
+        created = c.pods.create(pod)
+        created.status.phase = PodPhase.RUNNING  # mutate the returned copy
+        again = c.pods.get("default", "a")
+        assert again.status.phase == PodPhase.PENDING  # store unaffected
+
+    def test_duplicate_create_rejected(self):
+        c = FakeCluster()
+        c.pods.create(make_pod("a"))
+        with pytest.raises(AlreadyExists):
+            c.pods.create(make_pod("a"))
+
+    def test_generate_name(self):
+        c = FakeCluster()
+        p = Pod(metadata=ObjectMeta(generate_name="worker-", namespace="default"))
+        created = c.pods.create(p)
+        assert created.metadata.name.startswith("worker-")
+        assert len(created.metadata.name) > len("worker-")
+
+    def test_conflict_on_stale_update(self):
+        c = FakeCluster()
+        c.pods.create(make_pod("a"))
+        copy1 = c.pods.get("default", "a")
+        copy2 = c.pods.get("default", "a")
+        copy1.status.phase = PodPhase.RUNNING
+        c.pods.update(copy1)
+        copy2.status.phase = PodPhase.FAILED
+        with pytest.raises(Conflict):
+            c.pods.update(copy2)
+
+    def test_mutate_retries_conflicts(self):
+        c = FakeCluster()
+        c.pods.create(make_pod("a"))
+        c.pods.mutate("default", "a", lambda p: setattr(p.status, "reason", "x"))
+        assert c.pods.get("default", "a").status.reason == "x"
+
+    def test_delete_and_notfound(self):
+        c = FakeCluster()
+        c.pods.create(make_pod("a"))
+        c.pods.delete("default", "a")
+        with pytest.raises(NotFound):
+            c.pods.get("default", "a")
+
+    def test_label_selector_listing(self):
+        c = FakeCluster()
+        c.pods.create(make_pod("a", labels={"job": "x", "idx": "0"}))
+        c.pods.create(make_pod("b", labels={"job": "x", "idx": "1"}))
+        c.pods.create(make_pod("c", labels={"job": "y"}))
+        assert len(c.pods.list("default", {"job": "x"})) == 2
+        assert len(c.pods.list("default", {"job": "x", "idx": "1"})) == 1
+
+    def test_watch_events_and_replay(self):
+        c = FakeCluster()
+        c.pods.create(make_pod("pre"))
+        seen = []
+        c.pods.subscribe(lambda ev: seen.append((ev.type, ev.obj.metadata.name)))
+        assert seen == [(EventType.ADDED, "pre")]  # replay of existing state
+        c.pods.create(make_pod("post"))
+        c.pods.mutate("default", "post", lambda p: setattr(p.status, "reason", "r"))
+        c.pods.delete("default", "pre")
+        assert seen[1:] == [
+            (EventType.ADDED, "post"),
+            (EventType.MODIFIED, "post"),
+            (EventType.DELETED, "pre"),
+        ]
+
+    def test_modified_event_carries_old_obj(self):
+        c = FakeCluster()
+        c.pods.create(make_pod("a"))
+        evs = []
+        c.pods.subscribe(evs.append, replay=False)
+        c.pods.mutate("default", "a", lambda p: setattr(p.status, "phase", PodPhase.RUNNING))
+        assert evs[0].old_obj.status.phase == PodPhase.PENDING
+        assert evs[0].obj.status.phase == PodPhase.RUNNING
+
+
+class TestSlicePool:
+    def test_gang_all_or_nothing(self):
+        pool = SlicePool()
+        pool.add_pool("v5e-16", 2)
+        with pytest.raises(InsufficientCapacity):
+            pool.allocate_gang("job1", "v5e-16", 3)
+        assert len(pool.free("v5e-16")) == 2  # nothing was taken
+        got = pool.allocate_gang("job1", "v5e-16", 2)
+        assert len(got) == 2
+        assert not pool.free("v5e-16")
+
+    def test_allocate_idempotent_per_job(self):
+        pool = SlicePool()
+        pool.add_pool("v5e-16", 2)
+        a = pool.allocate_gang("job1", "v5e-16", 2)
+        b = pool.allocate_gang("job1", "v5e-16", 2)
+        assert {s.name for s in a} == {s.name for s in b}
+
+    def test_release(self):
+        pool = SlicePool()
+        pool.add_pool("v5e-16", 1)
+        pool.allocate_gang("job1", "v5e-16", 1)
+        assert pool.release("job1") == 1
+        assert len(pool.free("v5e-16")) == 1
+
+    def test_preempted_slice_not_allocatable_until_restore(self):
+        pool = SlicePool()
+        (name,) = pool.add_pool("v5e-16", 1)
+        evicted = pool.preempt(name)
+        assert evicted == ""
+        with pytest.raises(InsufficientCapacity):
+            pool.allocate_gang("job1", "v5e-16", 1)
+        pool.restore(name)
+        assert len(pool.allocate_gang("job1", "v5e-16", 1)) == 1
+
+
+class TestGangScheduling:
+    def test_incomplete_gang_never_admitted(self):
+        c = FakeCluster()
+        c.slice_pool.add_pool("v5e-16", 1)
+        # gang of 2 but only 1 pod exists
+        c.pods.create(gang_pod("w0", "jobuid", "v5e-16", 2, host_idx=0))
+        c.tick(dt=1, steps=10)
+        pod = c.pods.get("default", "w0")
+        assert pod.status.phase == PodPhase.PENDING
+        assert pod.spec.assigned_slice == ""
+
+    def test_complete_gang_admitted_and_runs(self):
+        c = FakeCluster(default_policy=PodRunPolicy(start_delay=1, run_duration=3))
+        c.slice_pool.add_pool("v5e-16", 1)
+        for i in range(2):
+            c.pods.create(gang_pod(f"w{i}", "jobuid", "v5e-16", 2, host_idx=i))
+        c.tick()  # admission
+        p0 = c.pods.get("default", "w0")
+        p1 = c.pods.get("default", "w1")
+        assert p0.spec.assigned_slice and p0.spec.assigned_slice == p1.spec.assigned_slice
+        assert p0.status.host_ip != p1.status.host_ip  # distinct host VMs
+        c.tick()  # start_delay elapsed -> Running
+        assert c.pods.get("default", "w0").status.phase == PodPhase.RUNNING
+        c.tick(steps=3)  # run_duration -> Succeeded
+        assert c.pods.get("default", "w0").status.phase == PodPhase.SUCCEEDED
+        assert c.pods.get("default", "w1").status.phase == PodPhase.SUCCEEDED
+
+    def test_no_capacity_gang_stays_pending(self):
+        c = FakeCluster()
+        # no pools provisioned
+        for i in range(2):
+            c.pods.create(gang_pod(f"w{i}", "j", "v5e-16", 2, host_idx=i))
+        c.tick(steps=5)
+        assert c.pods.get("default", "w0").status.phase == PodPhase.PENDING
+        reasons = [e[3] for e in c.cluster_events]
+        assert "FailedScheduling" in reasons
+
+    def test_multislice_spreads_hosts(self):
+        c = FakeCluster(default_policy=PodRunPolicy(start_delay=0, run_duration=99))
+        c.slice_pool.add_pool("v5e-16", 2)
+        # 2 slices x 2 hosts = gang of 4
+        pods = []
+        for si in range(2):
+            for hi in range(2):
+                pods.append(c.pods.create(gang_pod(
+                    f"w{si}-{hi}", "j", "v5e-16", 4,
+                    slice_idx=si, host_idx=hi, num_slices=2)))
+        c.tick()
+        slices = {c.pods.get("default", p.metadata.name).spec.assigned_slice for p in pods}
+        assert len(slices) == 2  # two distinct physical slices
+
+    def test_gang_admission_delay_fault(self):
+        c = FakeCluster(default_policy=PodRunPolicy(start_delay=0, run_duration=99))
+        c.slice_pool.add_pool("v5e-16", 1)
+        c.faults.gang_admission_delay = 5.0
+        for i in range(2):
+            c.pods.create(gang_pod(f"w{i}", "j", "v5e-16", 2, host_idx=i))
+        c.tick(steps=3)
+        assert c.pods.get("default", "w0").spec.assigned_slice == ""
+        c.tick(steps=4)
+        assert c.pods.get("default", "w0").spec.assigned_slice != ""
+
+    def test_local_pod_schedules_without_gang(self):
+        c = FakeCluster(default_policy=PodRunPolicy(start_delay=1, run_duration=2))
+        c.pods.create(make_pod("solo"))
+        c.tick(steps=2)
+        assert c.pods.get("default", "solo").status.phase == PodPhase.RUNNING
+        c.tick(steps=2)
+        assert c.pods.get("default", "solo").status.phase == PodPhase.SUCCEEDED
+
+
+class TestFaultsAndLifecycle:
+    def test_run_fn_exit_code_drives_phase(self):
+        ran = []
+        c = FakeCluster(default_policy=PodRunPolicy(
+            start_delay=0, run_fn=lambda pod: ran.append(pod.metadata.name) or 3))
+        c.pods.create(make_pod("solo"))
+        c.tick()
+        pod = c.pods.get("default", "solo")
+        assert ran == ["solo"]
+        assert pod.status.phase == PodPhase.FAILED
+        assert pod.status.exit_code == 3
+
+    def test_crash_policy(self):
+        c = FakeCluster(default_policy=PodRunPolicy(start_delay=0, run_duration=2))
+        c.faults.pod_policies["solo"] = PodRunPolicy(
+            start_delay=0, run_duration=1, crash_code=137)
+        c.pods.create(make_pod("solo"))
+        c.tick(steps=3)
+        pod = c.pods.get("default", "solo")
+        assert pod.status.phase == PodPhase.FAILED
+        assert pod.status.exit_code == 137
+
+    def test_preempt_slice_fails_pods_with_reason(self):
+        c = FakeCluster(default_policy=PodRunPolicy(start_delay=0, run_duration=99))
+        c.slice_pool.add_pool("v5e-16", 1)
+        for i in range(2):
+            c.pods.create(gang_pod(f"w{i}", "j", "v5e-16", 2, host_idx=i))
+        c.tick(steps=2)
+        slice_name = c.pods.get("default", "w0").spec.assigned_slice
+        failed = c.preempt_slice(slice_name)
+        assert sorted(failed) == ["w0", "w1"]
+        pod = c.pods.get("default", "w0")
+        assert pod.status.phase == PodPhase.FAILED
+        assert pod.status.reason == REASON_PREEMPTED
+        # slice is gone from the pool until restored
+        assert not c.slice_pool.free("v5e-16")
+
+    def test_injected_create_failure(self):
+        c = FakeCluster()
+        client = FakeClusterClient(c)
+        c.faults.fail_pod_creates = 1
+        with pytest.raises(PodCreateRefused):
+            client.create_pod(make_pod("a"))
+        client.create_pod(make_pod("a"))  # next one succeeds
+        assert len(c.pods) == 1
+
+
+class TestServicesAndDNS:
+    def test_service_dns_resolution(self):
+        c = FakeCluster()
+        svc = Service(metadata=ObjectMeta(name="job-worker-0", namespace="ml"))
+        c.services.create(svc)
+        assert c.resolve("job-worker-0.ml.svc").metadata.name == "job-worker-0"
+        assert c.resolve("missing.ml.svc") is None
