@@ -1,0 +1,11 @@
+"""Data plane: distributed bootstrap, generic train loop, checkpointing.
+
+The rewritten ``examples/workdir`` (reference ``mnist_replica.py``): instead
+of ClusterSpec + in-process gRPC server + Supervisor session recovery, a
+training process here reads the controller-injected env
+(``tpu/naming.py``), calls ``jax.distributed.initialize``, builds a Mesh, and
+runs a jitted SPMD train step with orbax checkpointing to the job's model_dir.
+"""
+
+from kubeflow_controller_tpu.dataplane.dist import ProcessContext, initialize_from_env
+from kubeflow_controller_tpu.dataplane.train import TrainLoop, TrainLoopConfig

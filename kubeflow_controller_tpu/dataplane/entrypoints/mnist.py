@@ -1,0 +1,87 @@
+"""MNIST training entrypoint — what runs inside a TPUJob's pods.
+
+The descendant of both reference examples: run under a Local job it is
+``mnist_softmax.py`` (single process); run under a Worker gang it is
+``mnist_replica.py`` reborn — but rendezvous comes from the controller's env
+injection and gradient aggregation from XLA all-reduce, with no PS role.
+
+Usable three ways: as a pod ``run_fn`` in the fake cluster (in-process), as a
+subprocess entrypoint (``python -m
+kubeflow_controller_tpu.dataplane.entrypoints.mnist``), or directly from
+bench/e2e code via :func:`train`.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import optax
+
+from kubeflow_controller_tpu.dataplane.dist import ProcessContext, initialize_from_env
+from kubeflow_controller_tpu.dataplane.train import TrainLoop, TrainLoopConfig
+from kubeflow_controller_tpu.models import mnist
+from kubeflow_controller_tpu.parallel.mesh import MeshConfig, make_mesh
+
+logger = logging.getLogger("tpujob.mnist")
+
+
+def train(
+    ctx: Optional[ProcessContext] = None,
+    total_steps: int = 200,       # --train_steps default, mnist_replica.py:68-70
+    batch_size: int = 100,        # --batch_size default, mnist_replica.py:64
+    learning_rate: float = 0.01,  # --learning_rate default, mnist_replica.py:66
+    hidden: int = mnist.HIDDEN_UNITS,
+    model_dir: str = "",
+    checkpoint_every: int = 0,
+) -> Dict[str, float]:
+    """Run MNIST training on whatever devices this process sees; returns final
+    metrics. Deterministic given the same seed/config."""
+    ctx = ctx or ProcessContext.from_env()
+    mesh = make_mesh(MeshConfig())  # pure DP over all devices
+    n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
+    if batch_size % n_data:
+        # The reference's default --batch_size=100 (mnist_replica.py:64) is
+        # not divisible by every mesh; round up so each device gets equal work.
+        batch_size = ((batch_size + n_data - 1) // n_data) * n_data
+        logger.info("rounded batch size up to %d (mesh has %d data shards)",
+                    batch_size, n_data)
+    model = mnist.MnistMLP(hidden=hidden)
+    loop = TrainLoop(
+        mesh=mesh,
+        init_fn=mnist.make_init_fn(model),
+        loss_fn=mnist.make_loss_fn(model),
+        optimizer=optax.adam(learning_rate),
+        config=TrainLoopConfig(
+            total_steps=total_steps,
+            log_every=max(1, total_steps // 5),
+            checkpoint_every=checkpoint_every,
+        ),
+        model_dir=model_dir or ctx.model_dir,
+    )
+    last: Dict[str, float] = {}
+
+    def on_metrics(m):
+        last.update({"loss": m.loss, "step": m.step, **m.extras})
+        logger.info(
+            "step %d loss %.4f acc %.3f (%.1f steps/s)",
+            m.step, m.loss, m.extras.get("accuracy", float("nan")),
+            m.steps_per_sec,
+        )
+
+    state = loop.run(mnist.synthetic_mnist(batch_size), on_metrics=on_metrics)
+    last["final_step"] = int(state.step)
+    return last
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    ctx = initialize_from_env()
+    metrics = train(ctx)
+    # Success contract: the controller marks the job Succeeded when every
+    # gang process exits 0 (or the chief does, under a chief policy).
+    return 0 if metrics.get("accuracy", 0.0) > 0.5 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,245 @@
+"""Generic SPMD train loop with checkpoint/resume.
+
+Replaces the reference's Supervisor-managed session loop
+(``examples/workdir/mnist_replica.py:200-264``): instead of a chief
+initializing variables on PS hosts and workers pushing grads over gRPC, every
+process runs the same jitted step over the global mesh; XLA all-reduces
+gradients over ICI. Checkpointing is orbax to the job's ``model_dir`` — the
+piece the reference declared in its API (``ModelDir``, ``types.go:46-47``) and
+never consumed — and is what makes the controller's preemption gang-restart an
+actual *resume*, not a restart from scratch.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_controller_tpu.parallel.mesh import batch_sharding, replicated
+from kubeflow_controller_tpu.parallel.sharding import infer_param_sharding
+
+logger = logging.getLogger("tpujob.train")
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+@dataclass
+class TrainLoopConfig:
+    total_steps: int = 100
+    log_every: int = 20
+    checkpoint_every: int = 0      # 0 = only final
+    keep_checkpoints: int = 3
+    donate_state: bool = True
+
+
+@dataclass
+class StepMetrics:
+    step: int
+    loss: float
+    extras: Dict[str, float] = field(default_factory=dict)
+    steps_per_sec: float = 0.0
+
+
+class TrainLoop:
+    """Owns state layout, the jitted step, and checkpoint/resume.
+
+    ``loss_fn(params, batch, rng) -> (loss, metrics_dict)`` defines the model;
+    parameters are placed by ``param_shardings`` (or the fsdp heuristic).
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        init_fn: Callable[[jax.Array], Any],
+        loss_fn: Callable[[Any, Any, jax.Array], Tuple[jax.Array, Dict]],
+        optimizer: optax.GradientTransformation,
+        config: Optional[TrainLoopConfig] = None,
+        model_dir: str = "",
+        param_shardings: Optional[Any] = None,
+        seed: int = 0,
+    ):
+        self.mesh = mesh
+        self.loss_fn = loss_fn
+        self.tx = optimizer
+        self.config = config or TrainLoopConfig()
+        self.model_dir = model_dir
+        self._ckpt_mgr = None
+
+        rng = jax.random.key(seed)
+        with jax.default_device(jax.devices()[0]):
+            params = init_fn(rng)
+        self.param_shardings = (
+            param_shardings
+            if param_shardings is not None
+            else infer_param_sharding(params, mesh)
+        )
+        params = jax.tree.map(jax.device_put, params, self.param_shardings)
+        opt_state = jax.jit(
+            self.tx.init,
+            out_shardings=self._opt_shardings(params),
+        )(params)
+        self.state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
+        )
+        self.state_shardings = TrainState(
+            step=replicated(mesh),
+            params=self.param_shardings,
+            opt_state=self._opt_shardings(params),
+        )
+        self._step_fn = self._build_step()
+        self._restored = False
+
+    # -- sharding helpers ----------------------------------------------------
+
+    def _opt_shardings(self, params: Any) -> Any:
+        """Optimizer state mirrors parameter sharding (ZeRO-style: moments
+        live wherever their parameter lives); scalar states replicate."""
+        shape = jax.eval_shape(self.tx.init, params)
+        # Opt-state leaves that are param-shaped adopt the param's sharding;
+        # everything else (step counters, scalars) replicates.
+        param_leaves = jax.tree.leaves(params)
+        param_shard_leaves = jax.tree.leaves(self.param_shardings)
+        by_shape = {}
+        for p, s in zip(param_leaves, param_shard_leaves):
+            by_shape.setdefault(p.shape, s)
+
+        def pick(leaf):
+            s = by_shape.get(leaf.shape)
+            if s is not None and leaf.ndim > 0:
+                return s
+            return replicated(self.mesh)
+
+        return jax.tree.map(pick, shape)
+
+    # -- jitted step ---------------------------------------------------------
+
+    def _build_step(self):
+        cfg = self.config
+
+        def step(state: TrainState, batch: Any, rng: jax.Array):
+            def lossf(params):
+                return self.loss_fn(params, batch, rng)
+
+            (loss, metrics), grads = jax.value_and_grad(lossf, has_aux=True)(
+                state.params
+            )
+            updates, opt_state = self.tx.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(
+                step=state.step + 1, params=params, opt_state=opt_state
+            )
+            metrics = {"loss": loss, **metrics}
+            return new_state, metrics
+
+        return jax.jit(
+            step,
+            in_shardings=(self.state_shardings, batch_sharding(self.mesh), None),
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=(0,) if cfg.donate_state else (),
+        )
+
+    # -- checkpointing -------------------------------------------------------
+
+    def _ckpt(self):
+        if self._ckpt_mgr is None and self.model_dir:
+            import orbax.checkpoint as ocp
+
+            self._ckpt_mgr = ocp.CheckpointManager(
+                self.model_dir,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=self.config.keep_checkpoints,
+                    create=True,
+                ),
+            )
+        return self._ckpt_mgr
+
+    def save(self, wait: bool = True) -> None:
+        mgr = self._ckpt()
+        if mgr is None:
+            return
+        import orbax.checkpoint as ocp
+
+        mgr.save(
+            int(self.state.step),
+            args=ocp.args.StandardSave(self.state),
+        )
+        if wait:
+            mgr.wait_until_finished()
+
+    def restore(self) -> bool:
+        """Resume from the latest checkpoint in model_dir, if any. The
+        preemption-survival path: a re-ganged job starts here instead of from
+        step 0."""
+        mgr = self._ckpt()
+        if mgr is None or mgr.latest_step() is None:
+            return False
+        import orbax.checkpoint as ocp
+
+        abstract = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            self.state,
+            self.state_shardings,
+        )
+        self.state = mgr.restore(
+            mgr.latest_step(), args=ocp.args.StandardRestore(abstract)
+        )
+        self._restored = True
+        logger.info("restored checkpoint at step %d", int(self.state.step))
+        return True
+
+    # -- the loop ------------------------------------------------------------
+
+    def run(
+        self,
+        data_iter: Iterator[Any],
+        on_metrics: Optional[Callable[[StepMetrics], None]] = None,
+        seed: int = 0,
+    ) -> TrainState:
+        cfg = self.config
+        self.restore()
+        start_step = int(self.state.step)
+        rng = jax.random.key(seed + 1)
+        t0 = time.perf_counter()
+        window = start_step
+        n_data = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        for _ in range(start_step, cfg.total_steps):
+            batch = next(data_iter)
+            lead = jax.tree.leaves(batch)[0].shape[0]
+            if lead % n_data:
+                raise ValueError(
+                    f"global batch {lead} not divisible by the mesh's "
+                    f"dp*fsdp={n_data} data shards; adjust batch size"
+                )
+            step_rng = jax.random.fold_in(rng, int(self.state.step))
+            self.state, metrics = self._step_fn(self.state, batch, step_rng)
+            step = int(self.state.step)
+            if cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
+                self.save(wait=True)
+            if on_metrics and (step % cfg.log_every == 0 or step == cfg.total_steps):
+                dt = time.perf_counter() - t0
+                sps = (step - window) / dt if dt > 0 else 0.0
+                on_metrics(StepMetrics(
+                    step=step,
+                    loss=float(metrics["loss"]),
+                    extras={k: float(v) for k, v in metrics.items() if k != "loss"},
+                    steps_per_sec=sps,
+                ))
+                t0 = time.perf_counter()
+                window = step
+        if self.model_dir:
+            self.save(wait=True)
+        return self.state

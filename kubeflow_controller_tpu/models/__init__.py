@@ -1,0 +1,7 @@
+"""Model families: MNIST (reference-example parity), ResNet, BERT, Llama.
+
+The reference ships two MNIST TensorFlow-1.4 scripts as its data plane
+(``examples/workdir/mnist_softmax.py``, ``mnist_replica.py``); this package
+carries their JAX/Flax descendants plus the model families from
+BASELINE.json's config ladder (ResNet-50, BERT-base, Llama-3-8B-style).
+"""

@@ -1,0 +1,82 @@
+"""MNIST models — functional parity with the reference's example workloads.
+
+- ``SoftmaxRegression`` ≙ ``examples/workdir/mnist_softmax.py:55-57`` (the
+  single W,b softmax the local example trains).
+- ``MnistMLP`` ≙ ``examples/workdir/mnist_replica.py:144-167`` (the one
+  128-unit hidden layer + sigmoid... here GELU — same capacity, better
+  conditioning) that the distributed PS/worker example trains.
+
+Data: the reference downloads real MNIST over the network
+(``read_data_sets``, ``mnist_replica.py:94``); this environment has no
+egress, so a deterministic synthetic MNIST-shaped task stands in — a fixed
+random linear teacher over 784-dim inputs, 10 classes. It trains to the same
+kind of accuracy curve and exercises an identical compute/communication
+pattern, which is what the framework is testing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+IMAGE_DIM = 784  # 28*28, mnist_softmax.py:55
+NUM_CLASSES = 10
+HIDDEN_UNITS = 128  # --hidden_units default, mnist_replica.py:60
+
+
+class SoftmaxRegression(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(NUM_CLASSES, name="softmax")(x)
+
+
+class MnistMLP(nn.Module):
+    hidden: int = HIDDEN_UNITS
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.hidden, name="hid")(x)
+        x = nn.gelu(x)
+        return nn.Dense(NUM_CLASSES, name="sm")(x)
+
+
+def synthetic_mnist(
+    batch_size: int, seed: int = 0
+) -> Iterator[Dict[str, jnp.ndarray]]:
+    """Deterministic synthetic classification stream shaped like MNIST."""
+    rng = np.random.default_rng(seed)
+    teacher = rng.standard_normal((IMAGE_DIM, NUM_CLASSES)).astype(np.float32)
+    while True:
+        x = rng.standard_normal((batch_size, IMAGE_DIM)).astype(np.float32)
+        logits = x @ teacher + 0.5 * rng.standard_normal(
+            (batch_size, NUM_CLASSES)
+        ).astype(np.float32)
+        y = logits.argmax(-1).astype(np.int32)
+        yield {"image": x, "label": y}
+
+
+def make_loss_fn(model: nn.Module):
+    def loss_fn(params, batch, rng):
+        logits = model.apply(params, batch["image"])
+        loss = jnp.mean(
+            -jax.nn.log_softmax(logits)[
+                jnp.arange(logits.shape[0]), batch["label"]
+            ]
+        )
+        acc = jnp.mean((logits.argmax(-1) == batch["label"]).astype(jnp.float32))
+        return loss, {"accuracy": acc}
+
+    return loss_fn
+
+
+def make_init_fn(model: nn.Module, batch_size: int = 8):
+    def init_fn(rng):
+        dummy = jnp.zeros((batch_size, IMAGE_DIM), jnp.float32)
+        return model.init(rng, dummy)
+
+    return init_fn

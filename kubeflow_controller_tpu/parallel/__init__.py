@@ -1,0 +1,26 @@
+"""Parallelism: device meshes, sharding rules, and collectives-based layers.
+
+The data-plane replacement for the reference's parameter-server architecture
+(SURVEY.md §2.5-2.6): instead of PS pods aggregating gradients over gRPC
+(``examples/workdir/mnist_replica.py:137-141``), parameters and activations
+are sharded over a ``jax.sharding.Mesh`` with axes
+
+    dp    data parallel (batch)          - gradient psum over ICI
+    fsdp  fully-sharded data parallel    - param/optimizer-state sharding
+    tp    tensor parallel                - megatron-style weight sharding
+    sp    sequence/context parallel      - ring attention over sequence
+
+and XLA inserts the all-reduce/all-gather/reduce-scatter collectives.
+"""
+
+from kubeflow_controller_tpu.parallel.mesh import (
+    MeshConfig,
+    make_mesh,
+    batch_sharding,
+    replicated,
+)
+from kubeflow_controller_tpu.parallel.sharding import (
+    infer_param_sharding,
+    shard_params,
+    logical_to_mesh,
+)

@@ -1,0 +1,72 @@
+"""Device mesh construction.
+
+Replaces the reference's ``generateTFClusterSpec`` host-list wiring
+(``pkg/tensorflow/distributed.go:127-159``) as the thing that gives a training
+process its place in the world: every process builds the same global Mesh from
+``jax.devices()`` after ``jax.distributed.initialize``; XLA handles cross-host
+collectives over ICI (intra-slice) / DCN (inter-slice).
+
+Axis order is (dp, fsdp, sp, tp) — tp innermost so tensor-parallel collectives
+ride the fastest ICI links; dp outermost so multi-slice jobs put pure-DP
+gradient reduction on DCN where its lower frequency tolerates lower bandwidth
+(the standard scaling-book layout).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. -1 on dp means "absorb all remaining devices"."""
+
+    dp: int = -1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int]:
+        fixed = self.fsdp * self.sp * self.tp
+        if self.dp == -1:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fsdp*sp*tp={fixed}"
+                )
+            return (n_devices // fixed, self.fsdp, self.sp, self.tp)
+        total = self.dp * fixed
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {self.dp}x{self.fsdp}x{self.sp}x{self.tp}={total} "
+                f"!= {n_devices} devices"
+            )
+        return (self.dp, self.fsdp, self.sp, self.tp)
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    config = config or MeshConfig()
+    devs = list(devices) if devices is not None else jax.devices()
+    shape = config.resolve(len(devs))
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Global batch is split over every data-like axis (dp and fsdp); sp/tp
+    groups see identical batch shards."""
+    return NamedSharding(mesh, P(("dp", "fsdp")))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,76 @@
+"""Parameter sharding rules: logical axis names -> mesh axes.
+
+The ZeRO/megatron-style replacement for the reference's
+``replica_device_setter`` (``examples/workdir/mnist_replica.py:137-141``),
+which round-robined whole variables across PS hosts. Here each parameter is
+*annotated* with logical axis names and mapped to mesh axes; XLA shards
+storage and inserts all-gathers/reduce-scatters as needed.
+
+Default rules:
+
+    "embed"   -> tp      (vocab/feature-parallel embedding)
+    "heads"   -> tp      (attention heads across tensor group)
+    "mlp"     -> tp      (ffn hidden across tensor group)
+    "fsdp"    -> fsdp    (any axis marked for fully-sharded storage)
+    None      -> replicated
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DEFAULT_RULES: Dict[str, Optional[str]] = {
+    "embed": "tp",
+    "vocab": "tp",
+    "heads": "tp",
+    "mlp": "tp",
+    "kv": None,
+    "fsdp": "fsdp",
+    "seq": "sp",
+    "batch": "dp",
+}
+
+
+def logical_to_mesh(
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Dict[str, Optional[str]]] = None,
+) -> P:
+    rules = rules or DEFAULT_RULES
+    return P(*(rules.get(ax) if ax is not None else None for ax in logical_axes))
+
+
+def infer_param_sharding(
+    params: Any,
+    mesh: Mesh,
+    rules: Optional[Dict[str, Optional[str]]] = None,
+    fsdp_min_size: int = 2 ** 16,
+) -> Any:
+    """Heuristic sharding for unannotated param trees (MNIST/ResNet-scale):
+    large 2D+ params get their biggest divisible axis sharded over fsdp;
+    everything else is replicated. Transformer models should annotate
+    explicitly instead (see models/llama.py)."""
+    fsdp = mesh.shape.get("fsdp", 1)
+
+    def spec_for(p: jax.Array) -> NamedSharding:
+        if fsdp > 1 and p.ndim >= 2 and p.size >= fsdp_min_size:
+            # shard the largest axis divisible by the fsdp group
+            order = sorted(range(p.ndim), key=lambda i: -p.shape[i])
+            for i in order:
+                if p.shape[i] % fsdp == 0:
+                    axes: list = [None] * p.ndim
+                    axes[i] = "fsdp"
+                    return NamedSharding(mesh, P(*axes))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(spec_for, params)
+
+
+def shard_params(params: Any, shardings: Any) -> Any:
+    """Place a param tree onto the mesh per the sharding tree."""
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, s), params, shardings
+    )

@@ -1,15 +1,25 @@
 """Test harness: force JAX onto a virtual 8-device CPU platform so sharding
 tests run hermetically (SURVEY.md §4 — multi-host simulated via
-``xla_force_host_platform_device_count``). Must run before jax initializes."""
+``xla_force_host_platform_device_count``).
+
+The ambient environment pins ``JAX_PLATFORMS=axon`` (one real TPU chip) and
+its sitecustomize imports jax at interpreter startup, capturing that env into
+jax's config — so plain env edits here are too late. ``jax.config.update``
+before first backend use is the reliable override; XLA_FLAGS is still read at
+backend init, so setting it here works.
+"""
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
