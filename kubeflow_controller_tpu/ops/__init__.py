@@ -1,0 +1,10 @@
+"""TPU-native hot ops.
+
+The reference's compute lives behind TensorFlow's C++/gRPC runtime
+(``examples/workdir/mnist_replica.py:144-167``); here the hot path is
+XLA-compiled JAX with Pallas TPU kernels for the ops XLA doesn't already fuse
+optimally (attention). Every kernel has a pure-XLA fallback so tests run on
+the virtual CPU mesh.
+"""
+
+from kubeflow_controller_tpu.ops.attention import mha  # noqa: F401

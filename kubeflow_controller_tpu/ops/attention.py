@@ -1,0 +1,94 @@
+"""Multi-head attention dispatch: Pallas flash kernel on TPU, XLA elsewhere.
+
+This is the framework's hottest op. On TPU the Pallas kernel
+(``ops/flash_attention.py``) tiles Q/K/V blocks through VMEM with an online
+softmax so the S×S score matrix never materialises in HBM; on CPU (the
+hermetic test mesh) a plain XLA einsum path computes identical math.
+
+Layouts are [batch, seq, heads, head_dim] throughout ("BSHD"), the layout
+that keeps the head axis free to shard over the mesh's ``tp`` axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """Grouped-query attention: expand kv heads to match query heads."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(
+        k[:, :, :, None, :], (b, s, h, n_rep, d)
+    ).reshape(b, s, h * n_rep, d)
+
+
+def mha_xla(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Dense attention in pure XLA. [B,S,H,D] in/out, fp32 softmax."""
+    *_, h, d = q.shape
+    kv_h = k.shape[2]
+    if kv_h != h:
+        k = _repeat_kv(k, h // kv_h)
+        v = _repeat_kv(v, h // kv_h)
+    scale = scale if scale is not None else d ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    q_len, k_len = logits.shape[-2], logits.shape[-1]
+    if causal:
+        mask = jnp.tril(jnp.ones((q_len, k_len), bool), k_len - q_len)
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        logits = jnp.where(seg_mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@functools.lru_cache(None)
+def _default_backend() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:  # pragma: no cover - backend probing never raises in tests
+        return "cpu"
+
+
+def mha(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Attention entry point. impl: auto|xla|flash.
+
+    "auto" picks the Pallas flash kernel on TPU backends when shapes allow
+    (seq divisible by the kernel block), else the XLA path.
+    """
+    if impl == "auto":
+        use_flash = (
+            _default_backend() == "tpu"
+            and q.shape[1] >= 256
+            and q.shape[1] % 128 == 0
+            and k.shape[1] % 128 == 0
+            and q.shape[3] in (64, 128, 256)
+        )
+        impl = "flash" if use_flash else "xla"
+    if impl == "flash":
+        from kubeflow_controller_tpu.ops.flash_attention import flash_mha
+
+        return flash_mha(q, k, v, causal=causal, segment_ids=segment_ids)
+    return mha_xla(q, k, v, causal=causal, segment_ids=segment_ids)
