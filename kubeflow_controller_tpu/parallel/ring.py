@@ -1,0 +1,164 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+Long-context sequence parallelism for the data plane. The sequence axis is
+sharded over the mesh's ``sp`` axis; K/V blocks rotate around the ring via
+``ppermute`` (one hop per step, riding ICI neighbour links) while each device
+accumulates its queries' output with an online (flash-style) softmax — the
+S×S score matrix never exists, and per-device attention memory is
+O(S/n · S/n). This is the Liu et al. ring-attention scheme expressed as a
+``shard_map`` over the same mesh the rest of the model uses, so it composes
+with dp/fsdp/tp sharding untouched.
+
+The reference has no long-context story at all (its models are MNIST MLPs,
+``examples/workdir/mnist_replica.py:144-167``; SURVEY.md §5.7) — this is a
+first-class capability the TPU rebuild adds, sized for sequences that do not
+fit a single chip's HBM.
+
+Communication note: each step moves the local K/V block to the ring
+neighbour; compute on block j overlaps with the transfer of block j+1 only if
+XLA schedules it so — on TPU the ppermute is an ICI neighbour exchange which
+latency-hides well at the block sizes long-context implies.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+_NEG = -1e30  # finite mask value: keeps online-softmax stats NaN-free
+
+
+def _block_attend(
+    q: jax.Array,            # [B, Sq, H, D] local queries (compute dtype)
+    k: jax.Array,            # [B, Sk, H, D] current ring block
+    v: jax.Array,            # [B, Sk, H, D]
+    q_pos: jax.Array,        # [Sq] global positions of local queries
+    k_pos: jax.Array,        # [Sk] global positions of the current block
+    m: jax.Array,            # [B, H, Sq] running max
+    l: jax.Array,            # [B, H, Sq] running denominator
+    o: jax.Array,            # [B, Sq, H, D] running numerator (f32)
+    causal: bool,
+    q_seg: Optional[jax.Array],
+    k_seg: Optional[jax.Array],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    mask = jnp.ones(s.shape[-2:], bool)
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+    mask = mask[None, None]
+    if q_seg is not None:
+        mask = mask & (q_seg[:, None, :, None] == k_seg[:, None, None, :])
+    s = jnp.where(mask, s, _NEG)
+    s_max = s.max(-1)                                   # [B,H,Sq]
+    m_new = jnp.maximum(m, s_max)
+    p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+    alpha = jnp.exp(m - m_new)                          # [B,H,Sq]
+    l_new = l * alpha + p.sum(-1)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l_new, o_new
+
+
+def _ring_body(
+    q, k, v, seg, axis_name: str, causal: bool,
+) -> jax.Array:
+    """Per-shard ring loop. q/k/v: [B, S_loc, H_loc, D]."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    kv_h = k.shape[2]
+    if kv_h != h:                                       # GQA: expand local kv
+        rep = h // kv_h
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    qf = q.astype(jnp.float32)
+    q_pos = my * sq + jnp.arange(sq)
+    perm = [(j, (j - 1) % n) for j in range(n)]         # receive from right
+
+    def step(i, carry):
+        k_cur, v_cur, seg_cur, m, l, o = carry
+        src = (my + i) % n                              # block id now held
+        k_pos = src * sk + jnp.arange(sk)
+        m, l, o = _block_attend(
+            qf, k_cur.astype(jnp.float32), v_cur, q_pos, k_pos, m, l, o,
+            causal, seg[0] if seg is not None else None, seg_cur,
+        )
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        if seg_cur is not None:
+            seg_cur = lax.ppermute(seg_cur, axis_name, perm)
+        return k_cur, v_cur, seg_cur, m, l, o
+
+    # Zero-init accumulators are device-invariant constants; mark them as
+    # varying over the mesh so the fori_loop carry type matches the
+    # per-device outputs (shard_map VMA discipline).
+    mesh = jax.sharding.get_abstract_mesh()
+    vary = tuple(mesh.axis_names) if mesh is not None else ()
+    m0 = lax.pcast(jnp.full((b, h, sq), _NEG, jnp.float32), vary, to="varying")
+    l0 = lax.pcast(jnp.zeros((b, h, sq), jnp.float32), vary, to="varying")
+    o0 = lax.pcast(jnp.zeros((b, sq, h, d), jnp.float32), vary, to="varying")
+    seg_cur = seg[1] if seg is not None else None
+    _, _, _, m, l, o = lax.fori_loop(
+        0, n, step, (k, v, seg_cur, m0, l0, o0)
+    )
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_mha(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """Exact attention with the sequence axis sharded over ``axis_name``.
+
+    Inputs are global [B, S, H, D] arrays (sharded or shardable); inside, a
+    shard_map runs the per-device ring. Requires an active mesh (via
+    ``jax.set_mesh``) containing ``axis_name``; without one — e.g. a plain
+    single-device jit — falls back to dense XLA attention, which is the same
+    math.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or axis_name not in mesh.axis_names:
+        from kubeflow_controller_tpu.ops.attention import mha_xla
+
+        return mha_xla(q, k, v, causal=causal, segment_ids=segment_ids)
+
+    batch = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    tp = "tp" if "tp" in mesh.axis_names else None
+    qkv_spec = P(batch, axis_name, tp, None)
+    seg_spec = P(batch, axis_name)
+
+    if segment_ids is not None:
+        def f(q, k, v, sq_seg):
+            return _ring_body(
+                q, k, v, (sq_seg, sq_seg), axis_name, causal
+            )
+
+        return jax.shard_map(
+            f,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec),
+            out_specs=qkv_spec,
+        )(q, k, v, segment_ids)
+
+    def g(q, k, v):
+        return _ring_body(q, k, v, None, axis_name, causal)
+
+    return jax.shard_map(
+        g, in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec
+    )(q, k, v)
