@@ -1,0 +1,94 @@
+"""BERT-base MLM pretraining entrypoint (BASELINE.md config #4).
+
+Mesh layout defaults to dp×fsdp (ZeRO-sharded optimizer state); tp>1 turns
+on megatron-style tensor parallelism via the model's param specs.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import jax
+import optax
+from jax.sharding import NamedSharding
+
+from kubeflow_controller_tpu.dataplane.dist import ProcessContext, initialize_from_env
+from kubeflow_controller_tpu.dataplane.train import (
+    TrainLoop, TrainLoopConfig, device_prefetch,
+)
+from kubeflow_controller_tpu.models import bert
+from kubeflow_controller_tpu.parallel.mesh import MeshConfig, batch_sharding, make_mesh
+
+logger = logging.getLogger("tpujob.bert")
+
+
+def train(
+    ctx: Optional[ProcessContext] = None,
+    total_steps: int = 100,
+    per_data_shard_batch: int = 8,
+    seq_len: int = 128,
+    learning_rate: float = 1e-4,
+    model_dir: str = "",
+    checkpoint_every: int = 0,
+    cfg: Optional[bert.BertConfig] = None,
+    mesh_config: Optional[MeshConfig] = None,
+) -> Dict[str, float]:
+    ctx = ctx or ProcessContext.from_env()
+    mesh = make_mesh(mesh_config or MeshConfig())
+    n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
+    global_batch = per_data_shard_batch * n_data
+    cfg = cfg or bert.bert_base_config(max_seq=max(seq_len, 128))
+
+    loop = TrainLoop(
+        mesh=mesh,
+        init_fn=bert.make_init_fn(cfg),
+        loss_fn=bert.make_loss_fn(cfg),
+        optimizer=optax.adamw(
+            optax.warmup_cosine_decay_schedule(
+                0.0, learning_rate, min(100, total_steps // 10 + 1), total_steps
+            ),
+            weight_decay=0.01,
+        ),
+        config=TrainLoopConfig(
+            total_steps=total_steps,
+            log_every=max(1, total_steps // 10),
+            checkpoint_every=checkpoint_every,
+        ),
+        model_dir=model_dir or ctx.model_dir,
+        param_shardings=jax.tree.map(
+            lambda s: NamedSharding(mesh, s), bert.param_specs(cfg)
+        ),
+    )
+    bs = batch_sharding(mesh)
+    data = device_prefetch(
+        bert.synthetic_mlm_batch(cfg, global_batch, seq_len),
+        {k: bs for k in ("tokens", "targets", "mlm_mask", "attention_mask")},
+        chunk=8,
+    )
+    last: Dict[str, float] = {}
+
+    def on_metrics(m):
+        tps = m.steps_per_sec * global_batch * seq_len
+        last.update({
+            "loss": m.loss, "step": m.step, "tokens_per_sec": tps, **m.extras,
+        })
+        logger.info(
+            "step %d mlm_loss %.4f acc %.3f (%.0f tok/s)",
+            m.step, m.loss, m.extras.get("mlm_accuracy", float("nan")), tps,
+        )
+
+    state = loop.run(data, on_metrics=on_metrics)
+    last["final_step"] = int(state.step)
+    return last
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    ctx = initialize_from_env()
+    metrics = train(ctx)
+    return 0 if metrics.get("final_step", 0) > 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,99 @@
+"""ResNet-50 ImageNet training entrypoint (BASELINE.md config #3).
+
+Runs inside a TPUJob's worker pods: rendezvous from controller-injected env
+(the descendant of the reference's ``--worker_hosts`` wiring,
+``pkg/tensorflow/distributed.go:127-159``), data-parallel SPMD over the
+global mesh, images/sec/chip reported from steady-state step time.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import jax
+import optax
+
+from kubeflow_controller_tpu.dataplane.dist import ProcessContext, initialize_from_env
+from kubeflow_controller_tpu.dataplane.train import (
+    TrainLoop, TrainLoopConfig, device_prefetch,
+)
+from kubeflow_controller_tpu.models import resnet
+from kubeflow_controller_tpu.parallel.mesh import MeshConfig, batch_sharding, make_mesh
+
+logger = logging.getLogger("tpujob.resnet")
+
+
+def train(
+    ctx: Optional[ProcessContext] = None,
+    total_steps: int = 100,
+    per_chip_batch: int = 128,
+    image_size: int = resnet.IMAGE_SIZE,
+    learning_rate: float = 0.1,
+    model_dir: str = "",
+    checkpoint_every: int = 0,
+    model: Optional[resnet.ResNet] = None,
+) -> Dict[str, float]:
+    ctx = ctx or ProcessContext.from_env()
+    mesh = make_mesh(MeshConfig())
+    n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
+    global_batch = per_chip_batch * n_data
+    model = model or resnet.resnet50()
+
+    tx = optax.sgd(
+        optax.warmup_cosine_decay_schedule(
+            0.0, learning_rate, min(500, total_steps // 10 + 1), total_steps
+        ),
+        momentum=0.9, nesterov=True,
+    )
+    loop = TrainLoop(
+        mesh=mesh,
+        init_fn=resnet.make_init_fn(model, image_size),
+        loss_fn=resnet.make_loss_fn(model),
+        optimizer=tx,
+        config=TrainLoopConfig(
+            total_steps=total_steps,
+            log_every=max(1, total_steps // 10),
+            checkpoint_every=checkpoint_every,
+        ),
+        model_dir=model_dir or ctx.model_dir,
+        stateful=True,
+    )
+    bs = batch_sharding(mesh)
+    data = device_prefetch(
+        resnet.synthetic_imagenet(
+            global_batch, image_size, model.num_classes
+        ),
+        {"image": bs, "label": bs},
+        chunk=4,
+    )
+    last: Dict[str, float] = {}
+
+    def on_metrics(m):
+        ips = m.steps_per_sec * global_batch
+        last.update({
+            "loss": m.loss, "step": m.step,
+            "images_per_sec": ips,
+            "images_per_sec_per_chip": ips / max(1, len(jax.devices())),
+            **m.extras,
+        })
+        logger.info(
+            "step %d loss %.4f acc %.3f (%.1f img/s, %.1f img/s/chip)",
+            m.step, m.loss, m.extras.get("accuracy", float("nan")),
+            ips, ips / max(1, len(jax.devices())),
+        )
+
+    state = loop.run(data, on_metrics=on_metrics)
+    last["final_step"] = int(state.step)
+    return last
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    ctx = initialize_from_env()
+    metrics = train(ctx)
+    return 0 if metrics.get("final_step", 0) > 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -124,6 +124,9 @@ class TrainState(struct.PyTreeNode):
     step: jax.Array
     params: Any
     opt_state: Any
+    # Non-trainable model collections (e.g. BatchNorm batch_stats), updated
+    # by the loss function rather than the optimizer. Empty dict when unused.
+    model_state: Any = struct.field(default_factory=dict)
 
 
 @dataclass
@@ -148,46 +151,62 @@ class TrainLoop:
 
     ``loss_fn(params, batch, rng) -> (loss, metrics_dict)`` defines the model;
     parameters are placed by ``param_shardings`` (or the fsdp heuristic).
+
+    Stateful models (``stateful=True``, e.g. BatchNorm): ``init_fn`` returns
+    ``(params, model_state)`` and ``loss_fn(params, model_state, batch, rng)
+    -> (loss, (metrics_dict, new_model_state))``. Note BatchNorm under
+    jit+sharding computes true global batch statistics — GSPMD inserts the
+    cross-device reductions — with none of the per-replica-stats caveats of
+    the pmap era.
     """
 
     def __init__(
         self,
         mesh: Mesh,
         init_fn: Callable[[jax.Array], Any],
-        loss_fn: Callable[[Any, Any, jax.Array], Tuple[jax.Array, Dict]],
+        loss_fn: Callable[..., Tuple[jax.Array, Any]],
         optimizer: optax.GradientTransformation,
         config: Optional[TrainLoopConfig] = None,
         model_dir: str = "",
         param_shardings: Optional[Any] = None,
         seed: int = 0,
+        stateful: bool = False,
     ):
         self.mesh = mesh
         self.loss_fn = loss_fn
         self.tx = optimizer
         self.config = config or TrainLoopConfig()
         self.model_dir = model_dir
+        self.stateful = stateful
         self._ckpt_mgr = None
 
         rng = jax.random.key(seed)
         with jax.default_device(jax.devices()[0]):
-            params = init_fn(rng)
+            init_out = init_fn(rng)
+        params, model_state = init_out if stateful else (init_out, {})
         self.param_shardings = (
             param_shardings
             if param_shardings is not None
             else infer_param_sharding(params, mesh)
         )
         params = jax.tree.map(jax.device_put, params, self.param_shardings)
+        model_state_sh = infer_param_sharding(model_state, mesh)
+        model_state = jax.tree.map(
+            jax.device_put, model_state, model_state_sh
+        )
         opt_state = jax.jit(
             self.tx.init,
             out_shardings=self._opt_shardings(params),
         )(params)
         self.state = TrainState(
-            step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
+            step=jnp.zeros((), jnp.int32), params=params,
+            opt_state=opt_state, model_state=model_state,
         )
         self.state_shardings = TrainState(
             step=replicated(mesh),
             params=self.param_shardings,
             opt_state=self._opt_shardings(params),
+            model_state=model_state_sh,
         )
         self._step_fn = self._build_step()
         self._restored = False
@@ -225,18 +244,29 @@ class TrainLoop:
             # the dispatch loop free of device syncs.
             step_rng = jax.random.fold_in(rng, state.step)
 
-            def lossf(params):
-                return self.loss_fn(params, batch, step_rng)
+            if self.stateful:
+                def lossf(params):
+                    return self.loss_fn(
+                        params, state.model_state, batch, step_rng
+                    )
+            else:
+                def lossf(params):
+                    return self.loss_fn(params, batch, step_rng)
 
-            (loss, metrics), grads = jax.value_and_grad(lossf, has_aux=True)(
+            (loss, aux), grads = jax.value_and_grad(lossf, has_aux=True)(
                 state.params
             )
+            if self.stateful:
+                metrics, model_state = aux
+            else:
+                metrics, model_state = aux, state.model_state
             updates, opt_state = self.tx.update(
                 grads, state.opt_state, state.params
             )
             params = optax.apply_updates(state.params, updates)
             new_state = TrainState(
-                step=state.step + 1, params=params, opt_state=opt_state
+                step=state.step + 1, params=params,
+                opt_state=opt_state, model_state=model_state,
             )
             metrics = {"loss": loss, **metrics}
             return new_state, metrics

@@ -1,0 +1,146 @@
+"""ResNet-50 (Flax) — the north-star vision config (BASELINE.md #3).
+
+The reference framework never shipped a vision model (its data plane stops at
+MNIST MLPs, ``examples/workdir/mnist_replica.py:144-167``); ResNet-50
+ImageNet is the repo's own headline throughput metric (images/sec/chip).
+
+TPU-first choices:
+- NHWC layout end-to-end — XLA:TPU's native conv layout; convs lower onto
+  the MXU as implicit GEMMs.
+- bf16 activations/compute with fp32 params and fp32 BatchNorm statistics.
+- BatchNorm runs under jit+GSPMD, so "sync BN" is free: the batch axis is
+  merely sharded and XLA inserts the cross-chip reductions for the true
+  global mean/var (no per-replica stats drift).
+- Data parallel by default; weights are small enough to replicate, so the
+  fsdp heuristic leaves them whole.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Iterator, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+IMAGE_SIZE = 224
+NUM_CLASSES = 1000
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, name="proj"
+            )(residual)
+            residual = self.norm(name="proj_norm")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)   # ResNet-50
+    width: int = 64
+    num_classes: int = NUM_CLASSES
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
+        )
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.width, (7, 7), (2, 2), name="stem")(x)
+        x = norm(name="stem_norm")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(
+                    filters=self.width * 2 ** i,
+                    strides=strides, conv=conv, norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), **kw)
+
+
+def resnet_tiny(**kw) -> ResNet:
+    """Test-scale: one block per stage, 8-wide, runs in seconds on CPU."""
+    kw.setdefault("dtype", jnp.float32)
+    return ResNet(stage_sizes=(1, 1), width=8, num_classes=10, **kw)
+
+
+def synthetic_imagenet(
+    batch_size: int, image_size: int = IMAGE_SIZE, num_classes: int = NUM_CLASSES,
+    seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Deterministic ImageNet-shaped stream (no egress in this environment);
+    identical tensor shapes/dtypes to a real input pipeline."""
+    rng = np.random.default_rng(seed)
+    while True:
+        yield {
+            "image": rng.standard_normal(
+                (batch_size, image_size, image_size, 3)
+            ).astype(np.float32),
+            "label": rng.integers(
+                0, num_classes, (batch_size,)
+            ).astype(np.int32),
+        }
+
+
+def make_init_fn(model: ResNet, image_size: int = IMAGE_SIZE):
+    def init_fn(rng):
+        variables = model.init(
+            rng, jnp.zeros((2, image_size, image_size, 3), jnp.float32),
+            train=False,
+        )
+        return variables["params"], variables.get("batch_stats", {})
+
+    return init_fn
+
+
+def make_loss_fn(model: ResNet):
+    """Stateful loss (TrainLoop stateful=True): returns updated batch_stats."""
+
+    def loss_fn(params, model_state, batch, rng):
+        logits, updated = model.apply(
+            {"params": params, "batch_stats": model_state},
+            batch["image"], train=True, mutable=["batch_stats"],
+        )
+        loss = jnp.mean(
+            -jax.nn.log_softmax(logits)[
+                jnp.arange(logits.shape[0]), batch["label"]
+            ]
+        )
+        acc = jnp.mean((logits.argmax(-1) == batch["label"]).astype(jnp.float32))
+        return loss, ({"accuracy": acc}, updated["batch_stats"])
+
+    return loss_fn
