@@ -1,0 +1,422 @@
+"""tpujobctl — the operational CLI (SURVEY.md §7 stage 7).
+
+The reference's operator flow is kubectl against an apiserver plus a
+controller process (``docs/get_started.md:10-63``); here the same split is
+one daemon (``tpujobctl serve`` = controller + in-process cluster + HTTP API)
+and thin client commands that speak JSON to it. A one-shot ``run`` mode
+drives a job to completion in-process for demos/CI with no daemon.
+
+Commands:
+    serve               run controller + fake cluster + HTTP API
+    submit -f job.yml   create a TPUJob
+    list / get / describe / delete
+    events              cluster events (k8s Events analog)
+    traces              per-sync reconcile traces (latency observability)
+    pools               TPU slice pool inventory
+    add-pool            register slice capacity (e.g. v5e-16 x2)
+    validate -f         schema/semantic validation only
+    run -f job.yml      one-shot: submit + reconcile to completion in-process
+    version
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import kubeflow_controller_tpu as pkg
+from kubeflow_controller_tpu.api.serialization import (
+    job_from_dict, job_to_dict, load_job_yaml,
+)
+from kubeflow_controller_tpu.api.types import JobPhase
+from kubeflow_controller_tpu.api.validation import ValidationError, validate_job
+from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
+from kubeflow_controller_tpu.runtime import LocalRuntime
+
+DEFAULT_PORT = 8377
+
+
+# -- server ------------------------------------------------------------------
+
+def _make_handler(rt: LocalRuntime):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, payload: Any) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _route(self, method: str) -> None:
+            try:
+                parts = [p for p in self.path.split("/") if p]
+                body = {}
+                if method in ("POST", "DELETE"):
+                    n = int(self.headers.get("Content-Length") or 0)
+                    if n:
+                        body = json.loads(self.rfile.read(n))
+                self._send(200, self._dispatch(method, parts, body))
+            except ValidationError as e:
+                self._send(400, {"error": "validation", "problems": e.errors})
+            except KeyError as e:
+                self._send(404, {"error": f"not found: {e}"})
+            except Exception as e:  # surface, don't crash the daemon
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _dispatch(self, method: str, parts, body) -> Any:
+            cluster = rt.cluster
+            if parts == ["healthz"]:
+                return {"ok": True, "now": cluster.now}
+            if parts == ["version"]:
+                return {"version": pkg.__version__}
+            if parts == ["jobs"] and method == "POST":
+                job = job_from_dict(body)
+                validate_job(job)
+                return job_to_dict(rt.submit(job))
+            if parts[:1] == ["jobs"] and method == "GET" and len(parts) == 1:
+                ns = self.headers.get("X-Namespace", "")
+                jobs = cluster.jobs.list(ns or None)
+                return {"items": [job_to_dict(j) for j in jobs]}
+            if parts[:1] == ["jobs"] and len(parts) == 3:
+                ns, name = parts[1], parts[2]
+                if method == "GET":
+                    return job_to_dict(cluster.jobs.get(ns, name))
+                if method == "DELETE":
+                    rt.delete_job(ns, name)
+                    return {"deleted": f"{ns}/{name}"}
+            if parts[:1] == ["pods"] and method == "GET":
+                ns = parts[1] if len(parts) > 1 else None
+                return {"items": [
+                    {
+                        "name": p.metadata.name,
+                        "namespace": p.metadata.namespace,
+                        "phase": p.status.phase.value,
+                        "slice": p.spec.assigned_slice,
+                        "labels": dict(p.metadata.labels),
+                    }
+                    for p in cluster.pods.list(ns)
+                ]}
+            if parts == ["events"] and method == "GET":
+                return {"items": [
+                    {"time": t, "kind": k, "name": n, "reason": r, "message": m}
+                    for (t, k, n, r, m) in cluster.cluster_events[-200:]
+                ]}
+            if parts == ["traces"] and method == "GET":
+                return {"items": [
+                    {
+                        "key": tr.key, "outcome": tr.outcome,
+                        "duration_ms": round(tr.duration * 1000, 3),
+                        "error": tr.error, "note": tr.note,
+                    }
+                    for tr in rt.controller.traces[-200:]
+                ]}
+            if parts == ["pools"] and method == "GET":
+                return {"items": [
+                    {
+                        "name": s.name,
+                        "accelerator": s.shape.accelerator_type,
+                        "healthy": s.healthy, "holder": s.holder,
+                    }
+                    for s in cluster.slice_pool.list()
+                ]}
+            if parts == ["pools"] and method == "POST":
+                names = cluster.slice_pool.add_pool(
+                    body["acceleratorType"], int(body.get("count", 1))
+                )
+                return {"added": names}
+            raise KeyError(self.path)
+
+        def do_GET(self):
+            self._route("GET")
+
+        def do_POST(self):
+            self._route("POST")
+
+        def do_DELETE(self):
+            self._route("DELETE")
+
+    return Handler
+
+
+def cmd_serve(args) -> int:
+    rt = LocalRuntime(
+        default_policy=PodRunPolicy(
+            start_delay=args.pod_start_delay, run_duration=args.pod_run_duration
+        ),
+        resync_period=30.0,
+    )
+    for pool in args.pool or []:
+        accel, _, count = pool.partition("x")
+        rt.cluster.slice_pool.add_pool(accel, int(count or 1))
+    rt.start_threads(workers=args.workers)
+    server = ThreadingHTTPServer(("127.0.0.1", args.port), _make_handler(rt))
+    print(f"tpujobctl serve: listening on http://127.0.0.1:{args.port} "
+          f"({args.workers} reconcile workers)")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        rt.stop()
+    return 0
+
+
+# -- client helpers ----------------------------------------------------------
+
+def _req(args, method: str, path: str, payload: Optional[Dict] = None) -> Dict:
+    url = f"http://127.0.0.1:{args.port}{path}"
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read() or b"{}")
+        raise SystemExit(f"error: {body.get('error')}"
+                         + ("".join("\n  - " + p for p in body.get("problems", []))))
+    except urllib.error.URLError as e:
+        raise SystemExit(
+            f"error: cannot reach daemon at {url} ({e.reason}); "
+            f"start one with `tpujobctl serve`"
+        )
+
+
+def _load_manifest(path: str):
+    src = sys.stdin.read() if path == "-" else open(path).read()
+    return load_job_yaml(src)
+
+
+def cmd_submit(args) -> int:
+    job = _load_manifest(args.filename)
+    out = _req(args, "POST", "/jobs", job_to_dict(job))
+    print(f"tpujob {out['metadata']['namespace']}/{out['metadata']['name']} created")
+    return 0
+
+
+def cmd_list(args) -> int:
+    items = _req(args, "GET", "/jobs")["items"]
+    rows = [("NAMESPACE", "NAME", "PHASE", "AGE")]
+    now = _req(args, "GET", "/healthz")["now"]
+    for j in items:
+        st = j.get("status", {})
+        rows.append((
+            j["metadata"].get("namespace", ""),
+            j["metadata"].get("name", ""),
+            st.get("phase", ""),
+            f"{now - j['metadata'].get('creationTimestamp', now):.0f}s",
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return 0
+
+
+def cmd_get(args) -> int:
+    out = _req(args, "GET", f"/jobs/{args.namespace}/{args.name}")
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+def cmd_describe(args) -> int:
+    j = _req(args, "GET", f"/jobs/{args.namespace}/{args.name}")
+    st = j.get("status", {})
+    meta = j["metadata"]
+    print(f"Name:       {meta['name']}\nNamespace:  {meta.get('namespace')}")
+    print(f"Phase:      {st.get('phase')}    Reason: {st.get('reason', '')}")
+    print(f"RuntimeID:  {j['spec'].get('runtimeId', '')}")
+    sub, run = st.get("submitTime"), st.get("allRunningTime")
+    if sub and run:
+        print(f"Submit -> all-running: {run - sub:.2f}s"
+              "   (north-star latency metric)")
+    for rs in st.get("replicaStatuses", []):
+        print(f"Replicas[{rs.get('type')}]: {rs.get('states')}")
+    for c in st.get("conditions", []) or []:
+        print(f"Condition: {c.get('type')}={c.get('status')}"
+              f" ({c.get('reason', '')})")
+    pods = _req(args, "GET", f"/pods/{args.namespace}")["items"]
+    mine = [p for p in pods if p["labels"].get("tpu.kubeflow.dev/job") == meta["name"]]
+    if mine:
+        print("Pods:")
+        for p in mine:
+            print(f"  {p['name']}  {p['phase']}  slice={p['slice'] or '-'}")
+    evs = _req(args, "GET", "/events")["items"]
+    mine_ev = [e for e in evs if meta["name"] in e["name"]][-10:]
+    if mine_ev:
+        print("Events:")
+        for e in mine_ev:
+            print(f"  t={e['time']:.1f} {e['reason']}: {e['message']}")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    _req(args, "DELETE", f"/jobs/{args.namespace}/{args.name}")
+    print(f"tpujob {args.namespace}/{args.name} deleted")
+    return 0
+
+
+def cmd_events(args) -> int:
+    for e in _req(args, "GET", "/events")["items"]:
+        print(f"t={e['time']:.1f} [{e['kind']}/{e['name']}] "
+              f"{e['reason']}: {e['message']}")
+    return 0
+
+
+def cmd_traces(args) -> int:
+    for t in _req(args, "GET", "/traces")["items"]:
+        err = f" error={t['error']}" if t["error"] else ""
+        note = f" note={t['note']}" if t.get("note") else ""
+        print(f"{t['key']}  {t['outcome']}  {t['duration_ms']}ms{note}{err}")
+    return 0
+
+
+def cmd_pools(args) -> int:
+    for p in _req(args, "GET", "/pools")["items"]:
+        health = "healthy" if p["healthy"] else "unhealthy"
+        print(f"{p['name']}  {p['accelerator']}  {health}"
+              f"  holder={p['holder'] or '-'}")
+    return 0
+
+
+def cmd_add_pool(args) -> int:
+    out = _req(args, "POST", "/pools",
+               {"acceleratorType": args.accelerator, "count": args.count})
+    print(f"added slices: {', '.join(out['added'])}")
+    return 0
+
+
+def cmd_validate(args) -> int:
+    try:
+        job = _load_manifest(args.filename)
+        validate_job(job)
+    except ValidationError as e:
+        print("invalid:")
+        for p in e.errors:
+            print(f"  - {p}")
+        return 1
+    print(f"{job.metadata.namespace}/{job.metadata.name}: valid")
+    return 0
+
+
+def cmd_run(args) -> int:
+    """One-shot in-process run: the reference's get-started flow
+    (submit, watch phases, exit by terminal phase) without a cluster."""
+    job = _load_manifest(args.filename)
+    rt = LocalRuntime(
+        default_policy=PodRunPolicy(
+            start_delay=args.pod_start_delay, run_duration=args.pod_run_duration
+        )
+    )
+    for pool in args.pool or []:
+        accel, _, count = pool.partition("x")
+        rt.cluster.slice_pool.add_pool(accel, int(count or 1))
+    rt.submit(job)
+    ns, name = job.metadata.namespace, job.metadata.name
+    last_phase = None
+    deadline = time.time() + args.timeout
+    while time.time() < deadline:
+        rt.step(dt=0.5)
+        j = rt.get_job(ns, name)
+        if j and j.status.phase != last_phase:
+            last_phase = j.status.phase
+            print(f"phase: {last_phase.value}")
+        if last_phase in (JobPhase.SUCCEEDED, JobPhase.FAILED):
+            break
+    j = rt.get_job(ns, name)
+    if j.status.submit_time and j.status.all_running_time:
+        print(f"submit -> all-running: "
+              f"{j.status.all_running_time - j.status.submit_time:.2f}s (sim)")
+    print(f"final: {j.status.phase.value} {j.status.reason or ''}".rstrip())
+    return 0 if j.status.phase == JobPhase.SUCCEEDED else 1
+
+
+def cmd_version(args) -> int:
+    print(pkg.__version__)
+    return 0
+
+
+# -- argparse wiring ---------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpujobctl", description="TPUJob operations CLI"
+    )
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--port", type=int, default=DEFAULT_PORT,
+                        help="daemon port (default %(default)s)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def add_parser(name, **kw):
+        return sub.add_parser(name, parents=[common], **kw)
+
+    s = add_parser("serve", help="run controller daemon + HTTP API")
+    s.add_argument("--workers", type=int, default=2)
+    s.add_argument("--pool", action="append",
+                   help="slice pool to register, e.g. v5e-16x2 (repeatable)")
+    s.add_argument("--pod-start-delay", type=float, default=1.0)
+    s.add_argument("--pod-run-duration", type=float, default=10.0)
+    s.set_defaults(fn=cmd_serve)
+
+    s = add_parser("submit", help="submit a TPUJob manifest")
+    s.add_argument("-f", "--filename", required=True)
+    s.set_defaults(fn=cmd_submit)
+
+    s = add_parser("list", help="list jobs")
+    s.set_defaults(fn=cmd_list)
+
+    for nm, fn, hp in (
+        ("get", cmd_get, "get a job as JSON"),
+        ("describe", cmd_describe, "human-readable job status"),
+        ("delete", cmd_delete, "delete a job"),
+    ):
+        s = add_parser(nm, help=hp)
+        s.add_argument("name")
+        s.add_argument("-n", "--namespace", default="default")
+        s.set_defaults(fn=fn)
+
+    add_parser("events", help="recent cluster events").set_defaults(
+        fn=cmd_events)
+    add_parser("traces", help="recent reconcile traces").set_defaults(
+        fn=cmd_traces)
+    add_parser("pools", help="TPU slice inventory").set_defaults(
+        fn=cmd_pools)
+
+    s = add_parser("add-pool", help="register TPU slice capacity")
+    s.add_argument("accelerator")
+    s.add_argument("--count", type=int, default=1)
+    s.set_defaults(fn=cmd_add_pool)
+
+    s = add_parser("validate", help="validate a manifest")
+    s.add_argument("-f", "--filename", required=True)
+    s.set_defaults(fn=cmd_validate)
+
+    s = add_parser("run", help="one-shot in-process job run")
+    s.add_argument("-f", "--filename", required=True)
+    s.add_argument("--pool", action="append")
+    s.add_argument("--timeout", type=float, default=120.0)
+    s.add_argument("--pod-start-delay", type=float, default=0.5)
+    s.add_argument("--pod-run-duration", type=float, default=3.0)
+    s.set_defaults(fn=cmd_run)
+
+    add_parser("version", help="print version").set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

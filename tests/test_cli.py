@@ -1,0 +1,95 @@
+"""tpujobctl: one-shot run mode and daemon/client flow (in-process server)."""
+
+import threading
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from kubeflow_controller_tpu import cli
+from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
+from kubeflow_controller_tpu.runtime import LocalRuntime
+
+JOB_YML = """
+apiVersion: tpu.kubeflow.dev/v1alpha1
+kind: TPUJob
+metadata: {name: clitest, namespace: default}
+spec:
+  replicaSpecs:
+  - replicaType: Worker
+    tpu: {acceleratorType: v5p-8, numSlices: 1}
+    template:
+      spec:
+        containers:
+        - name: train
+          image: jax:latest
+          command: [python, -c, "pass"]
+"""
+
+
+@pytest.fixture()
+def manifest(tmp_path):
+    p = tmp_path / "job.yml"
+    p.write_text(JOB_YML)
+    return str(p)
+
+
+@pytest.fixture()
+def daemon():
+    rt = LocalRuntime(PodRunPolicy(start_delay=0.2, run_duration=2))
+    rt.cluster.slice_pool.add_pool("v5p-8", 2)
+    rt.start_threads(workers=2, tick_interval=0.02)
+    server = ThreadingHTTPServer(("127.0.0.1", 0), cli._make_handler(rt))
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server.server_address[1]
+    server.shutdown()
+    rt.stop()
+
+
+def test_validate_ok(manifest, capsys):
+    assert cli.main(["validate", "-f", manifest]) == 0
+    assert "valid" in capsys.readouterr().out
+
+
+def test_validate_bad(tmp_path, capsys):
+    p = tmp_path / "bad.yml"
+    p.write_text(JOB_YML.replace("v5p-8", "v999-1"))
+    assert cli.main(["validate", "-f", str(p)]) == 1
+    assert "not a known slice shape" in capsys.readouterr().out
+
+
+def test_run_one_shot(manifest, capsys):
+    rc = cli.main([
+        "run", "-f", manifest, "--pool", "v5p-8x2", "--timeout", "30",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "Succeeded" in out
+    assert "submit -> all-running" in out
+
+
+def test_daemon_submit_describe_delete(daemon, manifest, capsys):
+    port = str(daemon)
+    assert cli.main(["submit", "--port", port, "-f", manifest]) == 0
+    assert cli.main(["list", "--port", port]) == 0
+    out = capsys.readouterr().out
+    assert "clitest" in out
+
+    import time
+    deadline = time.time() + 20
+    phase = ""
+    while time.time() < deadline and phase != "Succeeded":
+        cli.main(["describe", "clitest", "--port", port])
+        out = capsys.readouterr().out
+        for line in out.splitlines():
+            if line.startswith("Phase:"):
+                phase = line.split()[1]
+        time.sleep(0.2)
+    assert phase == "Succeeded", out
+
+    assert cli.main(["pools", "--port", port]) == 0
+    assert "v5p-8" in capsys.readouterr().out
+    assert cli.main(["traces", "--port", port]) == 0
+    assert "executed" in capsys.readouterr().out
+    assert cli.main(["delete", "clitest", "--port", port]) == 0
+    assert "deleted" in capsys.readouterr().out
