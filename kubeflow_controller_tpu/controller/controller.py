@@ -88,8 +88,16 @@ class Controller:
         self.pods = pod_informer
         self.services = service_informer
         self.opts = options or ControllerOptions()
-        self.queue = RateLimitingQueue()
-        self.expectations = ControllerExpectations()
+        # Hot-path structures come from the C++ core when it is loadable
+        # (csrc/tpujob_native.cc); the pure-Python implementations are the
+        # behavioural reference and the fallback. TPUJOB_NATIVE=0 forces
+        # Python.
+        from kubeflow_controller_tpu.native.queue import (
+            make_expectations, make_queue,
+        )
+
+        self.queue = make_queue()
+        self.expectations = make_expectations()
         self.traces: List[SyncTrace] = []
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()

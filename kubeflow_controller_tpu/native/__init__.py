@@ -1,0 +1,99 @@
+"""ctypes loader for the C++ runtime core (csrc/tpujob_native.cc).
+
+The reference's reconcile machinery is compiled native code (Go); here the
+hot-path structures — the rate-limited workqueue and the expectations cache
+— have a C++ implementation behind the same Python interface. Loading policy:
+
+1. use a prebuilt ``libtpujob_native.so`` next to this file if present;
+2. else try to build it once with the local toolchain (``make -C csrc``);
+3. else fall back silently to the pure-Python implementations — every
+   consumer treats the native path as an optimisation, never a requirement.
+
+``TPUJOB_NATIVE=0`` forces the Python path (used by tests to cover both).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_LIB_NAME = "libtpujob_native.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_char_p = ctypes.c_char_p
+    c_void_p = ctypes.c_void_p
+    c_double = ctypes.c_double
+    c_int = ctypes.c_int
+
+    lib.wq_new.restype = c_void_p
+    lib.wq_new.argtypes = [c_double, c_double]
+    lib.wq_free.argtypes = [c_void_p]
+    lib.wq_add.argtypes = [c_void_p, c_char_p]
+    lib.wq_add_after.argtypes = [c_void_p, c_char_p, c_double]
+    lib.wq_add_rate_limited.argtypes = [c_void_p, c_char_p]
+    lib.wq_forget.argtypes = [c_void_p, c_char_p]
+    lib.wq_num_requeues.restype = c_int
+    lib.wq_num_requeues.argtypes = [c_void_p, c_char_p]
+    lib.wq_get.restype = c_int
+    lib.wq_get.argtypes = [c_void_p, c_double, c_char_p, c_int]
+    lib.wq_done.argtypes = [c_void_p, c_char_p]
+    lib.wq_shutdown.argtypes = [c_void_p]
+    lib.wq_len.restype = c_int
+    lib.wq_len.argtypes = [c_void_p]
+    lib.wq_empty_and_idle.restype = c_int
+    lib.wq_empty_and_idle.argtypes = [c_void_p]
+
+    lib.exp_new.restype = c_void_p
+    lib.exp_new.argtypes = [c_double]
+    lib.exp_free.argtypes = [c_void_p]
+    lib.exp_satisfied.restype = c_int
+    lib.exp_satisfied.argtypes = [c_void_p, c_char_p]
+    lib.exp_expect_creations.argtypes = [c_void_p, c_char_p, c_int]
+    lib.exp_expect_deletions.argtypes = [c_void_p, c_char_p, c_int]
+    lib.exp_creation_observed.argtypes = [c_void_p, c_char_p]
+    lib.exp_deletion_observed.argtypes = [c_void_p, c_char_p]
+    lib.exp_delete.argtypes = [c_void_p, c_char_p]
+    lib.exp_pending.restype = c_int
+    lib.exp_pending.argtypes = [
+        c_void_p, c_char_p,
+        ctypes.POINTER(c_int), ctypes.POINTER(c_int),
+    ]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_attempted
+    if os.environ.get("TPUJOB_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        here = os.path.dirname(os.path.abspath(__file__))
+        path = os.path.join(here, _LIB_NAME)
+        if not os.path.exists(path):
+            csrc = os.path.join(os.path.dirname(os.path.dirname(here)), "csrc")
+            try:
+                subprocess.run(
+                    ["make", "-C", csrc],
+                    check=True, capture_output=True, timeout=120,
+                )
+            except Exception:
+                return None
+        try:
+            _lib = _configure(ctypes.CDLL(path))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
