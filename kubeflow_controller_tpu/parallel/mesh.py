@@ -62,6 +62,73 @@ def make_mesh(
     return Mesh(arr, AXES)
 
 
+def make_multislice_mesh(
+    config: Optional[MeshConfig] = None,
+    num_slices: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """DCN-aware mesh for multi-slice jobs (BASELINE config #5).
+
+    The dp axis factorises as (num_slices × dp_per_slice) with the slice
+    factor outermost, so pure-DP gradient all-reduce is the ONLY collective
+    that crosses the DCN; fsdp/sp/tp collectives stay on intra-slice ICI.
+    Device order: grouped by ``slice_index`` when the platform reports it
+    (real multi-slice TPU), else split evenly in enumeration order (CPU
+    simulation, where the grouping is only a layout statement).
+
+    The models never see any of this — the mesh still has the same four
+    logical axes, which is the point: multi-slice is a deployment detail,
+    not a model change. (The reference has no analog at all; its scaling
+    story stops at one PS/worker gRPC cluster, SURVEY.md §7 hard part 4.)
+    """
+    config = config or MeshConfig()
+    devs = list(devices) if devices is not None else jax.devices()
+    if num_slices <= 1:
+        return make_mesh(config, devs)
+    if len(devs) % num_slices:
+        raise ValueError(
+            f"{len(devs)} devices not divisible into {num_slices} slices"
+        )
+    per_slice = len(devs) // num_slices
+    by_slice: dict = {}
+    if all(hasattr(d, "slice_index") and d.slice_index is not None
+           for d in devs):
+        for d in devs:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        if len(by_slice) != num_slices:
+            raise ValueError(
+                f"platform reports {len(by_slice)} slices, job declares "
+                f"{num_slices}"
+            )
+        groups = [by_slice[k] for k in sorted(by_slice)]
+    else:
+        groups = [
+            devs[i * per_slice:(i + 1) * per_slice] for i in range(num_slices)
+        ]
+    dp, fsdp, sp, tp = config.resolve(len(devs))
+    if dp % num_slices:
+        raise ValueError(
+            f"dp={dp} must be divisible by num_slices={num_slices} "
+            f"(fsdp/sp/tp must not straddle the DCN)"
+        )
+    arr = np.array(groups).reshape(
+        num_slices, dp // num_slices, fsdp, sp, tp
+    ).reshape(dp, fsdp, sp, tp)
+    return Mesh(arr, AXES)
+
+
+def mesh_for_context(
+    ctx, config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the job's global mesh from a ProcessContext (the env the
+    controller injected): multi-slice jobs get the DCN-aware layout."""
+    return make_multislice_mesh(
+        config, num_slices=max(1, getattr(ctx, "num_slices", 1)),
+        devices=devices,
+    )
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Global batch is split over every data-like axis (dp and fsdp); sp/tp
     groups see identical batch shards."""

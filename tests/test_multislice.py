@@ -1,0 +1,107 @@
+"""Multi-slice (DCN) mesh layout and end-to-end multi-slice job wiring."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeflow_controller_tpu.dataplane.dist import ProcessContext
+from kubeflow_controller_tpu.models import transformer as tfm
+from kubeflow_controller_tpu.parallel.mesh import (
+    MeshConfig, make_multislice_mesh, mesh_for_context,
+)
+
+
+class TestMultisliceMesh:
+    def test_slice_major_dp_ordering(self):
+        """The outer dp factor must stride across slice groups: device row i
+        of the mesh's dp axis belongs to slice i // (dp/num_slices)."""
+        mesh = make_multislice_mesh(
+            MeshConfig(dp=4, fsdp=1, sp=1, tp=2), num_slices=2
+        )
+        devs = list(jax.devices())
+        arr = np.asarray(mesh.devices)          # [dp=4, fsdp=1, sp=1, tp=2]
+        # slice 0 = devices 0..3, slice 1 = devices 4..7 (enumeration order)
+        for dp_idx in range(4):
+            expect_slice = dp_idx // 2
+            for d in arr[dp_idx].flat:
+                assert devs.index(d) // 4 == expect_slice, (
+                    dp_idx, [devs.index(x) for x in arr[dp_idx].flat]
+                )
+
+    def test_intra_slice_axes_never_straddle_dcn(self):
+        mesh = make_multislice_mesh(
+            MeshConfig(dp=2, fsdp=2, sp=1, tp=2), num_slices=2
+        )
+        devs = list(jax.devices())
+        arr = np.asarray(mesh.devices)
+        # For each dp row, all fsdp/sp/tp devices must come from ONE slice.
+        for dp_idx in range(arr.shape[0]):
+            slices = {devs.index(d) // 4 for d in arr[dp_idx].flat}
+            assert len(slices) == 1, (dp_idx, slices)
+
+    def test_rejects_axes_straddling(self):
+        with pytest.raises(ValueError, match="divisible by num_slices"):
+            make_multislice_mesh(
+                MeshConfig(dp=1, fsdp=4, sp=1, tp=2), num_slices=2
+            )
+
+    def test_rejects_uneven_split(self):
+        with pytest.raises(ValueError, match="not divisible into"):
+            make_multislice_mesh(
+                MeshConfig(), num_slices=3, devices=jax.devices()[:8]
+            )
+
+    def test_mesh_for_context(self):
+        ctx = ProcessContext(num_slices=2)
+        mesh = mesh_for_context(ctx, MeshConfig(dp=2, fsdp=2, sp=1, tp=2))
+        assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "sp": 1, "tp": 2}
+        single = mesh_for_context(ProcessContext(), MeshConfig())
+        assert single.shape["dp"] == 8
+
+
+class TestMultisliceTraining:
+    def test_train_step_on_multislice_mesh(self):
+        """Full sharded train step compiles and runs on the 2-slice layout
+        and matches the single-slice result (same math, different device
+        order)."""
+        cfg = tfm.tiny_config()
+        params = tfm.init_params(cfg, jax.random.key(0))
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 17)),
+            jnp.int32,
+        )
+        tx = optax.sgd(0.1)
+
+        def losses(mesh):
+            specs = tfm.param_specs(cfg)
+            p = jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                params, specs,
+            )
+            opt = tx.init(p)
+            t = jax.device_put(
+                tokens, NamedSharding(mesh, P(("dp", "fsdp")))
+            )
+
+            def step(p, o, t):
+                (l, _), g = jax.value_and_grad(
+                    lambda pp: tfm.next_token_loss(cfg, pp, {"tokens": t}),
+                    has_aux=True,
+                )(p)
+                u, o = tx.update(g, o, p)
+                return optax.apply_updates(p, u), l
+
+            with jax.set_mesh(mesh):
+                newp, loss = jax.jit(step)(p, opt, t)
+            return float(loss)
+
+        multi = make_multislice_mesh(
+            MeshConfig(dp=2, fsdp=2, sp=1, tp=2), num_slices=2
+        )
+        single = make_multislice_mesh(
+            MeshConfig(dp=2, fsdp=2, sp=1, tp=2), num_slices=1
+        )
+        np.testing.assert_allclose(losses(multi), losses(single), rtol=1e-6)
