@@ -56,11 +56,15 @@ def main() -> None:
     # Warm up: compile + enough steps to fill the async dispatch pipeline
     # (the tunneled chip needs ~50 calls to reach steady state). Then time
     # three windows and take the median — single-window numbers are noisy
-    # over the device tunnel.
+    # over the device tunnel. Completion of each window is forced by
+    # FETCHING the step counter's value: the donated state chain makes the
+    # fetch transitively wait for every dispatched step
+    # (block_until_ready alone is not trustworthy on remote-tunnel
+    # platforms, where it can return before execution finishes).
     warm = 60
     loop.config.total_steps = warm
     loop.run(data)
-    jax.block_until_ready(loop.state.params)
+    int(loop.state.step)
 
     rates = []
     end = warm
@@ -69,8 +73,10 @@ def main() -> None:
         t0 = time.perf_counter()
         loop.config.total_steps = end
         loop.run(data)
-        jax.block_until_ready(loop.state.params)
+        reached = int(loop.state.step)   # value fetch = completion barrier
         rates.append(total_steps / (time.perf_counter() - t0))
+        if reached != end:
+            raise RuntimeError(f"expected step {end}, got {reached}")
 
     sps = sorted(rates)[1]
     print(json.dumps({

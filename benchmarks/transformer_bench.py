@@ -1,0 +1,129 @@
+"""Flagship decoder train-step benchmark on the visible device(s).
+
+Reports steady-state tokens/sec and MFU for a single-chip-sized decoder
+(same architecture as the Llama-family configs, scaled to fit one chip with
+fp32 Adam state). The reference has no model benchmark at all (SURVEY.md
+§6); this file establishes the repo's own numbers (benchmarks/RESULTS.md).
+
+Usage: python benchmarks/transformer_bench.py [--steps 30] [--seq 2048]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kubeflow_controller_tpu.models import transformer as tfm
+
+# bf16 peak of one v5e chip; override with --peak-tflops for other parts.
+DEFAULT_PEAK_TFLOPS = 197.0
+
+
+def train_flops_per_token(cfg: tfm.TransformerConfig, seq: int) -> float:
+    """6*N matmul flops per token (fwd+bwd) + causal attention term."""
+    n_params = (
+        cfg.vocab_size * cfg.d_model * (1 if cfg.tie_embeddings else 2)
+        + cfg.n_layers * (
+            cfg.d_model * cfg.n_heads * cfg.head_dim * 2
+            + cfg.d_model * cfg.n_kv_heads * cfg.head_dim * 2
+            + 3 * cfg.d_model * cfg.d_ff
+        )
+    )
+    attn = 12 * cfg.n_layers * cfg.d_model * (seq / 2)  # causal halves it
+    return 6 * n_params + attn
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=8)
+    p.add_argument("--seq", type=int, default=2048)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--d-model", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=24)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--kv-heads", type=int, default=8)
+    p.add_argument("--d-ff", type=int, default=4096)
+    p.add_argument("--vocab", type=int, default=32768)
+    p.add_argument("--attn", default="auto", choices=["auto", "xla", "flash"])
+    p.add_argument("--peak-tflops", type=float, default=DEFAULT_PEAK_TFLOPS)
+    p.add_argument("--no-remat", action="store_true")
+    p.add_argument("--loss-chunk", type=int, default=0)
+    args = p.parse_args()
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_layers=args.layers,
+        n_heads=args.heads, n_kv_heads=args.kv_heads, d_ff=args.d_ff,
+        max_seq=args.seq, attn_impl=args.attn, remat=not args.no_remat,
+    )
+    params = tfm.init_params(cfg, jax.random.key(0))
+    n_params = tfm.count_params(params)
+    tx = optax.adamw(1e-4, b1=0.9, b2=0.95)
+    opt = tx.init(params)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (args.batch, args.seq + 1)
+        ),
+        jnp.int32,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt, tokens):
+        (loss, _), g = jax.value_and_grad(
+            lambda p: tfm.next_token_loss(
+                cfg, p, {"tokens": tokens}, loss_chunk=args.loss_chunk
+            ),
+            has_aux=True,
+        )(params)
+        u, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, u), opt, loss
+
+    # Completion is forced by fetching the final loss VALUE: donated state
+    # chains the steps, so the last loss transitively waits for all of them.
+    # (block_until_ready alone is not trustworthy on remote-tunnel device
+    # platforms, where it can return before execution finishes.)
+    for _ in range(args.warmup):
+        params, opt, loss = step(params, opt, tokens)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt, loss = step(params, opt, tokens)
+    final_loss = float(loss)
+    dt = (time.perf_counter() - t0) / args.steps
+
+    tokens_per_step = args.batch * args.seq
+    tps = tokens_per_step / dt
+    flops = train_flops_per_token(cfg, args.seq) * tokens_per_step
+    n_dev = len(jax.devices())
+    mfu = flops / dt / (args.peak_tflops * 1e12 * n_dev)
+    print(json.dumps({
+        "model_params": n_params,
+        "devices": n_dev,
+        "backend": jax.default_backend(),
+        "attn": args.attn,
+        "seq": args.seq,
+        "global_batch": args.batch,
+        "loss_chunk": args.loss_chunk,
+        "step_ms": round(dt * 1000, 2),
+        "tokens_per_sec": round(tps),
+        "mfu": round(mfu, 4),
+        "loss": round(final_loss, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

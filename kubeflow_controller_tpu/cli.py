@@ -161,14 +161,24 @@ def cmd_serve(args) -> int:
         rt.cluster.slice_pool.add_pool(accel, int(count or 1))
     rt.start_threads(workers=args.workers)
     server = ThreadingHTTPServer(("127.0.0.1", args.port), _make_handler(rt))
+    # First SIGINT/SIGTERM drains gracefully; second hard-exits
+    # (util/signals.py, parity with reference pkg/util/signals). Installed
+    # before announcing readiness so a signal right after the banner is safe.
+    from kubeflow_controller_tpu.util.signals import setup_signal_handler
+
+    stop = setup_signal_handler()
+    threading.Thread(
+        target=lambda: (stop.wait(), server.shutdown()), daemon=True
+    ).start()
     print(f"tpujobctl serve: listening on http://127.0.0.1:{args.port} "
-          f"({args.workers} reconcile workers)")
+          f"({args.workers} reconcile workers)", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
         rt.stop()
+    print("tpujobctl serve: stopped")
     return 0
 
 

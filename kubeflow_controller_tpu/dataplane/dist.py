@@ -62,6 +62,20 @@ def initialize_from_env(env: Optional[Dict[str, str]] = None) -> ProcessContext:
     distributed runtime. Single-process (Local) jobs skip initialization
     entirely — the reference's local/distributed split
     (``pkg/checker/checker.go``) surfacing in the data plane."""
+    # Entrypoint processes honour JAX_PLATFORMS even when the interpreter's
+    # sitecustomize imported jax early and pinned a different platform:
+    # config.update before first backend use is the reliable override (same
+    # trick as tests/conftest.py). Only done here — i.e. for real process
+    # entry, not library imports — so in-process callers keep whatever
+    # platform config they already chose.
+    plat = (os.environ if env is None else env).get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # pragma: no cover - backend already initialised
+            pass
     ctx = ProcessContext.from_env(env)
     if ctx.num_processes > 1:
         import jax

@@ -56,20 +56,29 @@ def train(
             total_steps=total_steps,
             log_every=max(1, total_steps // 5),
             checkpoint_every=checkpoint_every,
+            eval_every=max(1, total_steps // 5),
         ),
         model_dir=model_dir or ctx.model_dir,
+        eval_fn=mnist.make_eval_fn(model),
     )
     last: Dict[str, float] = {}
 
     def on_metrics(m):
         last.update({"loss": m.loss, "step": m.step, **m.extras})
         logger.info(
-            "step %d loss %.4f acc %.3f (%.1f steps/s)",
+            "step %d loss %.4f acc %.3f val_xent %.4f val_acc %.3f "
+            "(%.1f steps/s)",
             m.step, m.loss, m.extras.get("accuracy", float("nan")),
+            m.extras.get("val_cross_entropy", float("nan")),
+            m.extras.get("val_accuracy", float("nan")),
             m.steps_per_sec,
         )
 
-    state = loop.run(mnist.synthetic_mnist(batch_size), on_metrics=on_metrics)
+    state = loop.run(
+        mnist.synthetic_mnist(batch_size),
+        on_metrics=on_metrics,
+        eval_iter=mnist.synthetic_mnist(batch_size, seed=1),  # held-out stream
+    )
     last["final_step"] = int(state.step)
     return last
 

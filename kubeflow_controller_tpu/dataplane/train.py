@@ -136,6 +136,12 @@ class TrainLoopConfig:
     checkpoint_every: int = 0      # 0 = only final
     keep_checkpoints: int = 3
     donate_state: bool = True
+    # Periodic validation (parity with the reference's post-train validation
+    # cross-entropy report, mnist_replica.py:266-269, made continuous):
+    # every eval_every steps, run eval_fn over eval_batches batches from the
+    # eval stream and report val_* metrics.
+    eval_every: int = 0
+    eval_batches: int = 1
 
 
 @dataclass
@@ -171,9 +177,11 @@ class TrainLoop:
         param_shardings: Optional[Any] = None,
         seed: int = 0,
         stateful: bool = False,
+        eval_fn: Optional[Callable[..., Dict]] = None,
     ):
         self.mesh = mesh
         self.loss_fn = loss_fn
+        self.eval_fn = eval_fn
         self.tx = optimizer
         self.config = config or TrainLoopConfig()
         self.model_dir = model_dir
@@ -209,6 +217,8 @@ class TrainLoop:
             model_state=model_state_sh,
         )
         self._step_fn = self._build_step()
+        self._eval_step = self._build_eval() if eval_fn else None
+        self.last_eval: Dict[str, float] = {}
         self._restored = False
 
     # -- sharding helpers ----------------------------------------------------
@@ -278,6 +288,30 @@ class TrainLoop:
             donate_argnums=(0,) if cfg.donate_state else (),
         )
 
+    def _build_eval(self):
+        def ev(state: TrainState, batch: Any):
+            if self.stateful:
+                return self.eval_fn(state.params, state.model_state, batch)
+            return self.eval_fn(state.params, batch)
+
+        return jax.jit(
+            ev,
+            in_shardings=(self.state_shardings, batch_sharding(self.mesh)),
+        )
+
+    def evaluate(self, eval_iter: Iterator[Any], batches: int = 1) -> Dict:
+        """Run eval_fn over ``batches`` batches; returns averaged metrics.
+        Accumulates on-device and converts once at the end — no per-batch
+        host sync."""
+        if self._eval_step is None:
+            raise ValueError("TrainLoop built without eval_fn")
+        acc: Dict[str, Any] = {}
+        for _ in range(batches):
+            out = self._eval_step(self.state, next(eval_iter))
+            for k, v in out.items():
+                acc[k] = v if k not in acc else acc[k] + v
+        return {k: float(v) / batches for k, v in acc.items()}
+
     # -- checkpointing -------------------------------------------------------
 
     def _ckpt(self):
@@ -334,6 +368,7 @@ class TrainLoop:
         data_iter: Iterator[Any],
         on_metrics: Optional[Callable[[StepMetrics], None]] = None,
         seed: int = 0,
+        eval_iter: Optional[Iterator[Any]] = None,
     ) -> TrainState:
         cfg = self.config
         self.restore()
@@ -359,13 +394,27 @@ class TrainLoop:
             step = py_step + 1
             if cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
                 self.save(wait=True)
+            if (
+                cfg.eval_every and self._eval_step is not None
+                and eval_iter is not None and step % cfg.eval_every == 0
+            ):
+                self.last_eval = {
+                    f"val_{k}": v
+                    for k, v in self.evaluate(
+                        eval_iter, cfg.eval_batches
+                    ).items()
+                }
             if on_metrics and (step % cfg.log_every == 0 or step == cfg.total_steps):
                 dt = time.perf_counter() - t0
                 sps = (step - window) / dt if dt > 0 else 0.0
+                extras = {
+                    k: float(v) for k, v in metrics.items() if k != "loss"
+                }
+                extras.update(self.last_eval)
                 on_metrics(StepMetrics(
                     step=step,
                     loss=float(metrics["loss"]),
-                    extras={k: float(v) for k, v in metrics.items() if k != "loss"},
+                    extras=extras,
                     steps_per_sec=sps,
                 ))
                 t0 = time.perf_counter()

@@ -46,11 +46,20 @@ class MnistMLP(nn.Module):
 
 
 def synthetic_mnist(
-    batch_size: int, seed: int = 0
+    batch_size: int, seed: int = 0, teacher_seed: int = 1234
 ) -> Iterator[Dict[str, jnp.ndarray]]:
-    """Deterministic synthetic classification stream shaped like MNIST."""
+    """Deterministic synthetic classification stream shaped like MNIST.
+
+    The labeling function (teacher) is seeded separately from the data
+    stream, so ``seed`` selects a different sample draw from the SAME task —
+    which is what makes a second stream usable as a held-out validation
+    split."""
+    teacher = (
+        np.random.default_rng(teacher_seed)
+        .standard_normal((IMAGE_DIM, NUM_CLASSES))
+        .astype(np.float32)
+    )
     rng = np.random.default_rng(seed)
-    teacher = rng.standard_normal((IMAGE_DIM, NUM_CLASSES)).astype(np.float32)
     while True:
         x = rng.standard_normal((batch_size, IMAGE_DIM)).astype(np.float32)
         logits = x @ teacher + 0.5 * rng.standard_normal(
@@ -60,18 +69,32 @@ def synthetic_mnist(
         yield {"image": x, "label": y}
 
 
+def _metrics(logits: jnp.ndarray, labels: jnp.ndarray):
+    xent = jnp.mean(
+        -jax.nn.log_softmax(logits)[jnp.arange(logits.shape[0]), labels]
+    )
+    acc = jnp.mean((logits.argmax(-1) == labels).astype(jnp.float32))
+    return xent, acc
+
+
 def make_loss_fn(model: nn.Module):
     def loss_fn(params, batch, rng):
-        logits = model.apply(params, batch["image"])
-        loss = jnp.mean(
-            -jax.nn.log_softmax(logits)[
-                jnp.arange(logits.shape[0]), batch["label"]
-            ]
-        )
-        acc = jnp.mean((logits.argmax(-1) == batch["label"]).astype(jnp.float32))
-        return loss, {"accuracy": acc}
+        xent, acc = _metrics(model.apply(params, batch["image"]), batch["label"])
+        return xent, {"accuracy": acc}
 
     return loss_fn
+
+
+def make_eval_fn(model: nn.Module):
+    """Validation metrics — the reference reports validation cross entropy
+    after training (``mnist_replica.py:266-269``); here it runs periodically
+    in-loop (TrainLoopConfig.eval_every)."""
+
+    def eval_fn(params, batch):
+        xent, acc = _metrics(model.apply(params, batch["image"]), batch["label"])
+        return {"cross_entropy": xent, "accuracy": acc}
+
+    return eval_fn
 
 
 def make_init_fn(model: nn.Module, batch_size: int = 8):

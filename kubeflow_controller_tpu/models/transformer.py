@@ -245,14 +245,14 @@ def _layer(
     return x + _constrain(down, _act_spec(cfg))
 
 
-def forward(
+def forward_hidden(
     cfg: TransformerConfig,
     params: Params,
     tokens: jax.Array,
     positions: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """tokens [B,S] int32 -> logits [B,S,vocab] float32."""
+    """tokens [B,S] int32 -> final-norm hidden states [B,S,d_model]."""
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
@@ -267,8 +267,18 @@ def forward(
             body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         )
     x, _ = lax.scan(body, x, params["layers"])
+    return rmsnorm(x, params["final_norm"], cfg.norm_eps)
 
-    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+
+def forward(
+    cfg: TransformerConfig,
+    params: Params,
+    tokens: jax.Array,
+    positions: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """tokens [B,S] int32 -> logits [B,S,vocab] float32."""
+    x = forward_hidden(cfg, params, tokens, positions, segment_ids)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
@@ -281,23 +291,72 @@ def forward(
 
 # -- loss / glue for TrainLoop ------------------------------------------------
 
+def _chunked_nll_and_argmax(
+    cfg: TransformerConfig, hidden: jax.Array, head: jax.Array,
+    targets: jax.Array, chunk: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-position NLL + argmax without materialising [B,S,vocab] fp32
+    logits: sequence positions stream through lax.scan in chunks, so peak
+    logits memory is [B,chunk,vocab]. The fp32 logits tensor is otherwise
+    the largest single buffer of the train step (HBM, not FLOPs, is what it
+    costs — the classic large-vocab bottleneck)."""
+    b, s, d = hidden.shape
+    n_chunks = s // chunk
+    h = hidden.reshape(b, n_chunks, chunk, d).transpose(1, 0, 2, 3)
+    t = targets.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
+
+    def body(_, ht):
+        hc, tc = ht
+        logits = jnp.einsum(
+            "bsd,dv->bsv", hc, head, preferred_element_type=jnp.float32
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, tc[..., None], -1)[..., 0]
+        return None, (nll, logits.argmax(-1))
+
+    _, (nll, am) = lax.scan(body, None, (h, t))
+    return (
+        nll.transpose(1, 0, 2).reshape(b, s),
+        am.transpose(1, 0, 2).reshape(b, s),
+    )
+
+
 def next_token_loss(
     cfg: TransformerConfig, params: Params, batch: Dict[str, jax.Array],
+    loss_chunk: int = 0,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Causal LM loss: predict tokens[1:] from tokens[:-1]. Ignores positions
-    where ``batch['mask']`` (optional) is 0."""
+    where ``batch['mask']`` (optional) is 0. loss_chunk > 0 streams the
+    vocab projection in sequence chunks of that size (bounds logits memory)."""
     tokens = batch["tokens"]
-    logits = forward(cfg, params, tokens[:, :-1])
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    if loss_chunk:
+        s = targets.shape[1]
+        # Largest divisor of S not exceeding the requested chunk, so the
+        # memory bound holds for ANY sequence length instead of silently
+        # falling back to full logits on non-divisible shapes.
+        chunk = max(
+            (d for d in range(1, min(loss_chunk, s) + 1) if s % d == 0)
+        )
+        hidden = forward_hidden(cfg, params, tokens[:, :-1])
+        head = params.get("lm_head")
+        if head is None:
+            head = params["embed"].T
+        nll, am = _chunked_nll_and_argmax(
+            cfg, hidden, head.astype(cfg.dtype), targets, chunk
+        )
+    else:
+        logits = forward(cfg, params, tokens[:, :-1])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+        am = logits.argmax(-1)
     mask = batch.get("mask")
     if mask is not None:
         mask = mask[:, 1:].astype(jnp.float32)
         loss = (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
     else:
         loss = nll.mean()
-    acc = jnp.mean((logits.argmax(-1) == targets).astype(jnp.float32))
+    acc = jnp.mean((am == targets).astype(jnp.float32))
     return loss, {"accuracy": acc, "perplexity": jnp.exp(loss)}
 
 

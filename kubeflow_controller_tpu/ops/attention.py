@@ -79,12 +79,16 @@ def mha(
     (seq divisible by the kernel block), else the XLA path.
     """
     if impl == "auto":
+        # Flash wins when its tiles fill the MXU/lanes: head_dim >= 128.
+        # At head_dim 64 XLA's fused attention is faster end-to-end
+        # (measured in benchmarks/transformer_bench.py), so auto routes
+        # there.
         use_flash = (
             _default_backend() == "tpu"
             and q.shape[1] >= 256
             and q.shape[1] % 128 == 0
             and k.shape[1] % 128 == 0
-            and q.shape[3] in (64, 128, 256)
+            and q.shape[3] in (128, 256)
         )
         impl = "flash" if use_flash else "xla"
     if impl == "flash":

@@ -101,6 +101,13 @@ def make_multislice_mesh(
                 f"{num_slices}"
             )
         groups = [by_slice[k] for k in sorted(by_slice)]
+        sizes = {len(g) for g in groups}
+        if sizes != {per_slice}:
+            raise ValueError(
+                f"uneven slice membership: got group sizes "
+                f"{sorted(len(g) for g in groups)}, need {per_slice} each "
+                f"({len(devs)} devices / {num_slices} slices)"
+            )
     else:
         groups = [
             devs[i * per_slice:(i + 1) * per_slice] for i in range(num_slices)

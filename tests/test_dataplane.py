@@ -222,3 +222,38 @@ spec:
         job = rt.get_job("default", "mnist-local")
         assert job.status.restarts == 1
         assert len(attempts) == 2
+
+
+class TestEval:
+    def test_periodic_eval_reports_val_metrics(self):
+        import optax
+
+        from kubeflow_controller_tpu.dataplane.train import (
+            TrainLoop, TrainLoopConfig,
+        )
+        from kubeflow_controller_tpu.models import mnist
+        from kubeflow_controller_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        model = mnist.MnistMLP(hidden=16)
+        loop = TrainLoop(
+            mesh=make_mesh(MeshConfig()),
+            init_fn=mnist.make_init_fn(model),
+            loss_fn=mnist.make_loss_fn(model),
+            optimizer=optax.adam(1e-2),
+            config=TrainLoopConfig(
+                total_steps=8, log_every=4, eval_every=4, eval_batches=2,
+            ),
+            eval_fn=mnist.make_eval_fn(model),
+        )
+        seen = []
+        loop.run(
+            mnist.synthetic_mnist(16),
+            on_metrics=lambda m: seen.append(m),
+            eval_iter=mnist.synthetic_mnist(16, seed=9),
+        )
+        assert seen, "no metrics reported"
+        assert all("val_cross_entropy" in m.extras for m in seen)
+        assert all("val_accuracy" in m.extras for m in seen)
+        import numpy as np
+
+        assert np.isfinite(seen[-1].extras["val_cross_entropy"])

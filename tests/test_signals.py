@@ -1,0 +1,68 @@
+"""Signal handling: graceful stop on first signal, hard exit on second;
+daemon shuts down cleanly on SIGTERM (subprocess test)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def test_two_strike_semantics_in_subprocess():
+    code = r"""
+import os, signal, sys, time
+from kubeflow_controller_tpu.util.signals import setup_signal_handler
+stop = setup_signal_handler()
+print("ready", flush=True)
+stop.wait(10)
+print("graceful", flush=True)
+time.sleep(10)   # second signal during this window must hard-exit(1)
+"""
+    p = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert p.stdout.readline().strip() == "ready"
+    p.send_signal(signal.SIGTERM)
+    assert p.stdout.readline().strip() == "graceful"
+    p.send_signal(signal.SIGTERM)
+    assert p.wait(10) == 1       # hard exit on the second strike
+
+
+def test_double_install_rejected():
+    sub = subprocess.run(
+        [sys.executable, "-c", (
+            "from kubeflow_controller_tpu.util.signals import "
+            "setup_signal_handler\n"
+            "setup_signal_handler()\n"
+            "try:\n"
+            "    setup_signal_handler()\n"
+            "except RuntimeError:\n"
+            "    print('rejected')\n"
+        )],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert sub.stdout.strip() == "rejected"
+
+
+def test_serve_daemon_sigterm_clean_shutdown(tmp_path):
+    p = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_controller_tpu.cli",
+         "serve", "--port", "8391"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    line = p.stdout.readline()
+    assert "listening" in line, line
+    p.send_signal(signal.SIGTERM)
+    try:
+        out, _ = p.communicate(timeout=15)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        pytest.fail("daemon did not shut down on SIGTERM")
+    assert p.returncode == 0
+    assert "stopped" in out

@@ -110,3 +110,25 @@ def test_trains_on_synthetic_lm(cfg, params):
         if first is None:
             first = float(loss)
     assert float(loss) < first * 0.5, (first, float(loss))
+
+
+def test_chunked_loss_matches_dense(cfg, params):
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(0, cfg.vocab_size, (2, 33)),
+        jnp.int32,
+    )
+    ref, ref_m = tfm.next_token_loss(cfg, params, {"tokens": tokens})
+    out, out_m = tfm.next_token_loss(
+        cfg, params, {"tokens": tokens}, loss_chunk=8
+    )
+    np.testing.assert_allclose(float(ref), float(out), rtol=1e-6)
+    np.testing.assert_allclose(
+        float(ref_m["accuracy"]), float(out_m["accuracy"]), rtol=1e-6
+    )
+    # grads must match too
+    g1 = jax.grad(lambda p: tfm.next_token_loss(cfg, p, {"tokens": tokens})[0])(params)
+    g2 = jax.grad(
+        lambda p: tfm.next_token_loss(cfg, p, {"tokens": tokens}, loss_chunk=8)[0]
+    )(params)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
