@@ -37,7 +37,7 @@ def _field_map(cls: type) -> Dict[str, str]:
     return _SNAKE_CACHE[cls]
 
 
-def _to_wire(obj: Any) -> Any:
+def _to_wire(obj: Any, top: bool = False) -> Any:
     if is_dataclass(obj) and not isinstance(obj, type):
         out = {}
         for f in fields(obj):
@@ -48,7 +48,10 @@ def _to_wire(obj: Any) -> Any:
                 continue
             if isinstance(val, (int, float, bool)) and val == f.default:
                 continue
-            if f.name in ("kind", "api_version"):
+            # kind/apiVersion of the TOP object form the envelope (emitted by
+            # the *_to_dict wrappers); nested dataclasses (OwnerReference)
+            # carry theirs as ordinary data.
+            if top and f.name in ("kind", "api_version"):
                 continue
             out[_camel(f.name)] = _to_wire(val)
         return out
@@ -66,7 +69,7 @@ def _to_wire(obj: Any) -> Any:
 
 def job_to_dict(job: types.TPUJob) -> Dict[str, Any]:
     out = {"apiVersion": job.api_version, "kind": job.kind}
-    out.update(_to_wire(job))
+    out.update(_to_wire(job, top=True))
     return out
 
 
@@ -92,7 +95,47 @@ _NESTED = {
     (core.PodTemplateSpec, "spec"): core.PodSpec,
     (core.PodSpec, "containers"): core.Container,
     (core.ObjectMeta, "owner_references"): core.OwnerReference,
+    # Pod/Service wire forms (REST adapter, cluster/rest_client.py):
+    (core.Pod, "metadata"): core.ObjectMeta,
+    (core.Pod, "spec"): core.PodSpec,
+    (core.Pod, "status"): core.PodStatus,
+    (core.PodStatus, "phase"): core.PodPhase,
+    (core.Service, "metadata"): core.ObjectMeta,
+    (core.Service, "spec"): core.ServiceSpec,
+    (core.ServiceSpec, "ports"): core.ServicePort,
 }
+
+
+def pod_to_dict(pod: core.Pod) -> Dict[str, Any]:
+    out = {"apiVersion": pod.api_version, "kind": pod.kind}
+    out.update(_to_wire(pod, top=True))
+    return out
+
+
+def pod_from_dict(data: Dict[str, Any]) -> core.Pod:
+    errs: List[str] = []
+    pod = _build(core.Pod, {
+        k: v for k, v in data.items() if k not in ("apiVersion", "kind")
+    }, "", errs)
+    if errs:
+        raise ValidationError(errs)
+    return pod
+
+
+def service_to_dict(svc: core.Service) -> Dict[str, Any]:
+    out = {"apiVersion": svc.api_version, "kind": svc.kind}
+    out.update(_to_wire(svc, top=True))
+    return out
+
+
+def service_from_dict(data: Dict[str, Any]) -> core.Service:
+    errs: List[str] = []
+    svc = _build(core.Service, {
+        k: v for k, v in data.items() if k not in ("apiVersion", "kind")
+    }, "", errs)
+    if errs:
+        raise ValidationError(errs)
+    return svc
 
 
 def _build(cls: type, data: Dict[str, Any], path: str, errs: List[str]) -> Any:

@@ -9,7 +9,7 @@ drives a job to completion in-process for demos/CI with no daemon.
 Commands:
     serve               run controller + fake cluster + HTTP API
     submit -f job.yml   create a TPUJob
-    list / get / describe / delete
+    list / get / describe / delete / logs
     events              cluster events (k8s Events analog)
     traces              per-sync reconcile traces (latency observability)
     pools               TPU slice pool inventory
@@ -106,6 +106,23 @@ def _make_handler(rt: LocalRuntime):
                         "labels": dict(p.metadata.labels),
                     }
                     for p in cluster.pods.list(ns)
+                ]}
+            if parts[:1] == ["logs"] and method == "GET" and len(parts) == 3:
+                ns, name = parts[1], parts[2]
+                lines = cluster.get_pod_logs(name)
+                if not lines:  # maybe a job name: aggregate its pods' logs
+                    pods = [
+                        pp for pp in cluster.pods.list(ns)
+                        if pp.metadata.labels.get("tpu.kubeflow.dev/job") == name
+                    ]
+                    lines = [
+                        (t, f"[{pp.metadata.name}] {line}")
+                        for pp in pods
+                        for (t, line) in cluster.get_pod_logs(pp.metadata.name)
+                    ]
+                    lines.sort(key=lambda x: x[0])
+                return {"items": [
+                    {"time": t, "line": line} for (t, line) in lines
                 ]}
             if parts == ["events"] and method == "GET":
                 return {"items": [
@@ -276,6 +293,18 @@ def cmd_delete(args) -> int:
     return 0
 
 
+def cmd_logs(args) -> int:
+    items = _req(
+        args, "GET", f"/logs/{args.namespace}/{args.name}"
+    )["items"]
+    if not items:
+        print(f"no logs for {args.namespace}/{args.name}")
+        return 1
+    for e in items:
+        print(f"t={e['time']:.1f} {e['line']}")
+    return 0
+
+
 def cmd_events(args) -> int:
     for e in _req(args, "GET", "/events")["items"]:
         print(f"t={e['time']:.1f} [{e['kind']}/{e['name']}] "
@@ -389,6 +418,7 @@ def build_parser() -> argparse.ArgumentParser:
         ("get", cmd_get, "get a job as JSON"),
         ("describe", cmd_describe, "human-readable job status"),
         ("delete", cmd_delete, "delete a job"),
+        ("logs", cmd_logs, "pod (or whole-job) logs"),
     ):
         s = add_parser(nm, help=hp)
         s.add_argument("name")

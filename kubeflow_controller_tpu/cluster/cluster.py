@@ -96,12 +96,24 @@ class FakeCluster:
         # reason, message) — the observability surface record.EventRecorder
         # provides in the reference (controller.go:91-94).
         self.cluster_events: List[tuple] = []
+        # Per-pod log lines (kubectl-logs analog): pod name -> [(time, line)].
+        # The fake kubelet writes lifecycle lines; run_fn workloads may append
+        # via append_pod_log.
+        self.pod_logs: Dict[str, List[tuple]] = {}
 
     # -- event recording -----------------------------------------------------
 
     def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
         with self._lock:
             self.cluster_events.append((self.now, kind, name, reason, message))
+
+    def append_pod_log(self, pod_name: str, line: str) -> None:
+        with self._lock:
+            self.pod_logs.setdefault(pod_name, []).append((self.now, line))
+
+    def get_pod_logs(self, pod_name: str) -> List[tuple]:
+        with self._lock:
+            return list(self.pod_logs.get(pod_name, []))
 
     # -- time ----------------------------------------------------------------
 
@@ -156,6 +168,7 @@ class FakeCluster:
         if rt.scheduled_at is None:
             rt.scheduled_at = self.now
             self.record_event("Pod", pod.metadata.name, "Scheduled", "bound to local node")
+            self.append_pod_log(pod.metadata.name, "scheduled: local node")
 
     def _try_admit_gang(self, group: str, members: List[Pod]) -> None:
         expected = int(members[0].metadata.annotations.get(ANNOTATION_GANG_SIZE, 0))
@@ -192,6 +205,10 @@ class FakeCluster:
                 p.status.host_ip = sl.hosts[hi % len(sl.hosts)]
             self.pods.mutate(pod.metadata.namespace, pod.metadata.name, bind)
             self._runtime(pod).scheduled_at = self.now
+            self.append_pod_log(
+                pod.metadata.name,
+                f"scheduled: slice {sl.name} host {hi % len(sl.hosts)}",
+            )
         self.record_event(
             "Gang", group, "GangScheduled",
             f"{len(members)} pods on {num_slices}x{accel}",
@@ -230,6 +247,9 @@ class FakeCluster:
             if phase == PodPhase.RUNNING:
                 p.status.start_time = self.now
         self.pods.mutate(pod.metadata.namespace, pod.metadata.name, mut)
+        if phase == PodPhase.RUNNING:
+            cmd = " ".join(pod.spec.main_container().command)
+            self.append_pod_log(pod.metadata.name, f"started: {cmd}")
 
     def _finish(self, pod: Pod, exit_code: int) -> None:
         phase = PodPhase.SUCCEEDED if exit_code == 0 else PodPhase.FAILED
@@ -240,6 +260,9 @@ class FakeCluster:
             if phase == PodPhase.FAILED and not p.status.reason:
                 p.status.reason = f"ExitCode{exit_code}"
         self.pods.mutate(pod.metadata.namespace, pod.metadata.name, mut)
+        self.append_pod_log(
+            pod.metadata.name, f"exited: code {exit_code} ({phase.value})"
+        )
 
     # -- fault injection ----------------------------------------------------
 

@@ -1,0 +1,187 @@
+"""RestClusterClient — the ClusterClient over apiserver-style REST.
+
+The real-cluster swap-in at the effector seam (SURVEY.md §7: "real-GKE
+adapter as a thin swap-in at the client boundary"). The reconcile core is
+written against ``ClusterClient`` (``cluster/client.py``); this
+implementation speaks the Kubernetes resource REST shape over HTTP —
+against ``rest_server.RestServer`` in tests, against a real apiserver (URL +
+bearer token) in deployment. Framework-specific surfaces with no core-k8s
+analog (event recording and TPU slice-pool bookkeeping) live under
+``/framework/v1/...`` extension paths — on a real cluster those map to the
+Events API and the cloud provider's node-pool API respectively.
+
+Error mapping: 404 -> NotFound, 409 -> AlreadyExists/Conflict, other
+non-2xx -> RuntimeError. The store layer's optimistic-concurrency semantics
+(resourceVersion enforcement) therefore survive the HTTP hop — an
+update-conflict test drives that end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from kubeflow_controller_tpu.api.core import Pod, Service
+from kubeflow_controller_tpu.api.serialization import (
+    job_from_dict, job_to_dict, pod_from_dict, pod_to_dict,
+    service_from_dict, service_to_dict,
+)
+from kubeflow_controller_tpu.api.types import TPUJob
+from kubeflow_controller_tpu.cluster.store import (
+    AlreadyExists, Conflict, NotFound,
+)
+
+JOB_GROUP = "/apis/tpu.kubeflow.dev/v1alpha1"
+
+
+class RestClusterClient:
+    def __init__(self, base_url: str, token: str = "", timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+
+    # -- transport -----------------------------------------------------------
+
+    def _req(
+        self, method: str, path: str, payload: Optional[Dict] = None,
+    ) -> Dict[str, Any]:
+        url = self.base_url + path
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read() or b"{}")
+            except json.JSONDecodeError:
+                body = {}
+            msg = body.get("error", str(e))
+            if e.code == 404:
+                raise NotFound(msg) from None
+            if e.code == 409:
+                if body.get("reason") == "AlreadyExists":
+                    raise AlreadyExists(msg) from None
+                raise Conflict(msg) from None
+            raise RuntimeError(f"{method} {path}: HTTP {e.code}: {msg}")
+
+    @staticmethod
+    def _selector_q(selector: Dict[str, str]) -> str:
+        if not selector:
+            return ""
+        joined = ",".join(f"{k}={v}" for k, v in sorted(selector.items()))
+        return "?labelSelector=" + urllib.parse.quote(joined)
+
+    # -- pods ---------------------------------------------------------------
+
+    def create_pod(self, pod: Pod) -> Pod:
+        out = self._req(
+            "POST",
+            f"/api/v1/namespaces/{pod.metadata.namespace}/pods",
+            pod_to_dict(pod),
+        )
+        self.record_event("Pod", out["metadata"]["name"], "SuccessfulCreate",
+                          f"created pod {out['metadata']['name']}")
+        return pod_from_dict(out)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._req("DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}")
+        self.record_event("Pod", name, "SuccessfulDelete", f"deleted pod {name}")
+
+    def list_pods(self, namespace: str, selector: Dict[str, str]) -> List[Pod]:
+        out = self._req(
+            "GET",
+            f"/api/v1/namespaces/{namespace}/pods"
+            + self._selector_q(selector),
+        )
+        return [pod_from_dict(d) for d in out["items"]]
+
+    def update_pod(self, pod: Pod) -> Pod:
+        out = self._req(
+            "PUT",
+            f"/api/v1/namespaces/{pod.metadata.namespace}/pods/"
+            f"{pod.metadata.name}",
+            pod_to_dict(pod),
+        )
+        return pod_from_dict(out)
+
+    # -- services -----------------------------------------------------------
+
+    def create_service(self, svc: Service) -> Service:
+        out = self._req(
+            "POST",
+            f"/api/v1/namespaces/{svc.metadata.namespace}/services",
+            service_to_dict(svc),
+        )
+        self.record_event(
+            "Service", out["metadata"]["name"], "SuccessfulCreate",
+            f"created service {out['metadata']['name']}",
+        )
+        return service_from_dict(out)
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        self._req(
+            "DELETE", f"/api/v1/namespaces/{namespace}/services/{name}"
+        )
+        self.record_event("Service", name, "SuccessfulDelete",
+                          f"deleted service {name}")
+
+    def list_services(
+        self, namespace: str, selector: Dict[str, str]
+    ) -> List[Service]:
+        out = self._req(
+            "GET",
+            f"/api/v1/namespaces/{namespace}/services"
+            + self._selector_q(selector),
+        )
+        return [service_from_dict(d) for d in out["items"]]
+
+    def update_service(self, svc: Service) -> Service:
+        out = self._req(
+            "PUT",
+            f"/api/v1/namespaces/{svc.metadata.namespace}/services/"
+            f"{svc.metadata.name}",
+            service_to_dict(svc),
+        )
+        return service_from_dict(out)
+
+    # -- jobs ---------------------------------------------------------------
+
+    def get_job(self, namespace: str, name: str) -> Optional[TPUJob]:
+        try:
+            out = self._req(
+                "GET", f"{JOB_GROUP}/namespaces/{namespace}/tpujobs/{name}"
+            )
+        except NotFound:
+            return None
+        return job_from_dict(out)
+
+    def update_job(self, job: TPUJob) -> TPUJob:
+        out = self._req(
+            "PUT",
+            f"{JOB_GROUP}/namespaces/{job.metadata.namespace}/tpujobs/"
+            f"{job.metadata.name}",
+            job_to_dict(job),
+        )
+        return job_from_dict(out)
+
+    # -- framework extensions ------------------------------------------------
+
+    def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
+        self._req("POST", "/framework/v1/events", {
+            "kind": kind, "name": name, "reason": reason, "message": message,
+        })
+
+    def release_slices(self, job_uid: str) -> int:
+        return self._req(
+            "DELETE", f"/framework/v1/slices/{job_uid}"
+        )["released"]
+
+    def job_slices(self, job_uid: str):
+        return self._req("GET", f"/framework/v1/slices/{job_uid}")["items"]

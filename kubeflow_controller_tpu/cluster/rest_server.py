@@ -1,0 +1,180 @@
+"""Apiserver-style REST facade over a cluster.
+
+Serves the same resource model a real Kubernetes apiserver would —
+``/api/v1/namespaces/{ns}/pods[/{name}]``,
+``/apis/tpu.kubeflow.dev/v1alpha1/namespaces/{ns}/tpujobs[/{name}]`` — over
+an in-process FakeCluster. Together with ``rest_client.RestClusterClient``
+this closes the loop the reference ran against a real apiserver
+(``docs/development.md:24-41`` there): client and server speak genuine HTTP
+over a socket, resourceVersion conflicts surface as 409s, label selectors
+filter server-side. Deploying against a real cluster means pointing the
+client at a real apiserver URL (plus auth) — the protocol shape is the same.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from kubeflow_controller_tpu.api.serialization import (
+    job_from_dict, job_to_dict, pod_from_dict, pod_to_dict,
+    service_from_dict, service_to_dict,
+)
+from kubeflow_controller_tpu.cluster.cluster import FakeCluster
+from kubeflow_controller_tpu.cluster.store import (
+    AlreadyExists, Conflict, NotFound,
+)
+
+POD_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods(?:/([^/]+))?$")
+SVC_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/services(?:/([^/]+))?$")
+JOB_RE = re.compile(
+    r"^/apis/tpu\.kubeflow\.dev/v1alpha1/namespaces/([^/]+)/tpujobs"
+    r"(?:/([^/]+))?$"
+)
+EVENT_PATH = "/framework/v1/events"
+SLICES_RE = re.compile(r"^/framework/v1/slices/([^/]+)$")
+
+
+def _parse_selector(query: str) -> Optional[Dict[str, str]]:
+    for part in (query or "").split("&"):
+        if part.startswith("labelSelector="):
+            sel = {}
+            import urllib.parse
+
+            for kv in urllib.parse.unquote(part[len("labelSelector="):]).split(","):
+                if "=" in kv:
+                    k, _, v = kv.partition("=")
+                    sel[k] = v
+            return sel or None
+    return None
+
+
+def make_rest_handler(cluster: FakeCluster):
+    stores = {
+        "pods": (cluster.pods, pod_to_dict, pod_from_dict),
+        "services": (cluster.services, service_to_dict, service_from_dict),
+        "jobs": (cluster.jobs, job_to_dict, job_from_dict),
+    }
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code: int, payload: Any) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> Dict:
+            n = int(self.headers.get("Content-Length") or 0)
+            return json.loads(self.rfile.read(n)) if n else {}
+
+        def _match(self) -> Optional[Tuple[str, str, Optional[str], str]]:
+            path, _, query = self.path.partition("?")
+            for kind, rx in (("pods", POD_RE), ("services", SVC_RE),
+                             ("jobs", JOB_RE)):
+                m = rx.match(path)
+                if m:
+                    return kind, m.group(1), m.group(2), query
+            return None
+
+        def _handle(self, method: str) -> None:
+            path = self.path.partition("?")[0]
+            try:
+                if path == EVENT_PATH and method == "POST":
+                    b = self._body()
+                    cluster.record_event(
+                        b["kind"], b["name"], b["reason"], b["message"]
+                    )
+                    return self._send(200, {"ok": True})
+                m = SLICES_RE.match(path)
+                if m:
+                    uid = m.group(1)
+                    if method == "DELETE":
+                        return self._send(
+                            200, {"released": cluster.slice_pool.release(uid)}
+                        )
+                    if method == "GET":
+                        return self._send(200, {"items": [
+                            {
+                                "name": s.name,
+                                "accelerator": s.shape.accelerator_type,
+                                "hosts": list(s.hosts),
+                                "healthy": s.healthy,
+                            }
+                            for s in cluster.slice_pool.holdings(uid)
+                        ]})
+                matched = self._match()
+                if matched is None:
+                    return self._send(404, {"error": f"no route {path}"})
+                kind, ns, name, query = matched
+                store, to_dict, from_dict = stores[kind]
+                if method == "GET" and name is None:
+                    sel = _parse_selector(query)
+                    return self._send(200, {
+                        "items": [to_dict(o) for o in store.list(ns, sel)]
+                    })
+                if method == "GET":
+                    return self._send(200, to_dict(store.get(ns, name)))
+                if method == "POST":
+                    obj = from_dict(self._body())
+                    return self._send(201, to_dict(store.create(obj)))
+                if method == "PUT":
+                    obj = from_dict(self._body())
+                    return self._send(200, to_dict(store.update(obj)))
+                if method == "DELETE":
+                    store.delete(ns, name)
+                    return self._send(200, {"deleted": f"{ns}/{name}"})
+                self._send(405, {"error": method})
+            except NotFound as e:
+                self._send(404, {"error": str(e)})
+            except AlreadyExists as e:
+                self._send(409, {"error": str(e), "reason": "AlreadyExists"})
+            except Conflict as e:
+                self._send(409, {"error": str(e), "reason": "Conflict"})
+            except Exception as e:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def do_GET(self):
+            self._handle("GET")
+
+        def do_POST(self):
+            self._handle("POST")
+
+        def do_PUT(self):
+            self._handle("PUT")
+
+        def do_DELETE(self):
+            self._handle("DELETE")
+
+    return Handler
+
+
+class RestServer:
+    """In-process apiserver facade; bind port 0 for an ephemeral port."""
+
+    def __init__(self, cluster: FakeCluster, port: int = 0):
+        self._httpd = ThreadingHTTPServer(
+            ("127.0.0.1", port), make_rest_handler(cluster)
+        )
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "RestServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()

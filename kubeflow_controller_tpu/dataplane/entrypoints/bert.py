@@ -14,6 +14,7 @@ import optax
 from jax.sharding import NamedSharding
 
 from kubeflow_controller_tpu.dataplane.dist import ProcessContext, initialize_from_env
+from kubeflow_controller_tpu.dataplane import metrics as metrics_sink
 from kubeflow_controller_tpu.dataplane.train import (
     TrainLoop, TrainLoopConfig, device_prefetch,
 )
@@ -35,6 +36,7 @@ def train(
     mesh_config: Optional[MeshConfig] = None,
 ) -> Dict[str, float]:
     ctx = ctx or ProcessContext.from_env()
+    mlog = metrics_sink.from_context(ctx)
     mesh = make_mesh(mesh_config or MeshConfig())
     n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
     global_batch = per_data_shard_batch * n_data
@@ -69,6 +71,10 @@ def train(
     last: Dict[str, float] = {}
 
     def on_metrics(m):
+        if mlog:
+            mlog.write(m.step, {"loss": m.loss,
+                                "steps_per_sec": m.steps_per_sec,
+                                **m.extras})
         tps = m.steps_per_sec * global_batch * seq_len
         last.update({
             "loss": m.loss, "step": m.step, "tokens_per_sec": tps, **m.extras,

@@ -19,6 +19,7 @@ import optax
 from jax.sharding import NamedSharding
 
 from kubeflow_controller_tpu.dataplane.dist import ProcessContext, initialize_from_env
+from kubeflow_controller_tpu.dataplane import metrics as metrics_sink
 from kubeflow_controller_tpu.dataplane.train import (
     TrainLoop, TrainLoopConfig, device_prefetch,
 )
@@ -61,6 +62,7 @@ def train(
     checkpoint_every: int = 0,
 ) -> Dict[str, float]:
     ctx = ctx or ProcessContext.from_env()
+    mlog = metrics_sink.from_context(ctx)
     mesh = mesh_for_context(ctx, mesh_config or MeshConfig())
     cfg = CONFIGS[config](
         max_seq=max(seq_len, 128),
@@ -98,6 +100,10 @@ def train(
     last: Dict[str, float] = {}
 
     def on_metrics(m):
+        if mlog:
+            mlog.write(m.step, {"loss": m.loss,
+                                "steps_per_sec": m.steps_per_sec,
+                                **m.extras})
         tps = m.steps_per_sec * global_batch * seq_len
         last.update({
             "loss": m.loss, "step": m.step, "tokens_per_sec": tps, **m.extras,

@@ -19,6 +19,7 @@ from typing import Dict, Optional
 import optax
 
 from kubeflow_controller_tpu.dataplane.dist import ProcessContext, initialize_from_env
+from kubeflow_controller_tpu.dataplane import metrics as metrics_sink
 from kubeflow_controller_tpu.dataplane.train import TrainLoop, TrainLoopConfig
 from kubeflow_controller_tpu.models import mnist
 from kubeflow_controller_tpu.parallel.mesh import MeshConfig, make_mesh
@@ -38,6 +39,7 @@ def train(
     """Run MNIST training on whatever devices this process sees; returns final
     metrics. Deterministic given the same seed/config."""
     ctx = ctx or ProcessContext.from_env()
+    mlog = metrics_sink.from_context(ctx)
     mesh = make_mesh(MeshConfig())  # pure DP over all devices
     n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
     if batch_size % n_data:
@@ -64,6 +66,10 @@ def train(
     last: Dict[str, float] = {}
 
     def on_metrics(m):
+        if mlog:
+            mlog.write(m.step, {"loss": m.loss,
+                                "steps_per_sec": m.steps_per_sec,
+                                **m.extras})
         last.update({"loss": m.loss, "step": m.step, **m.extras})
         logger.info(
             "step %d loss %.4f acc %.3f val_xent %.4f val_acc %.3f "

@@ -15,6 +15,7 @@ import jax
 import optax
 
 from kubeflow_controller_tpu.dataplane.dist import ProcessContext, initialize_from_env
+from kubeflow_controller_tpu.dataplane import metrics as metrics_sink
 from kubeflow_controller_tpu.dataplane.train import (
     TrainLoop, TrainLoopConfig, device_prefetch,
 )
@@ -35,6 +36,7 @@ def train(
     model: Optional[resnet.ResNet] = None,
 ) -> Dict[str, float]:
     ctx = ctx or ProcessContext.from_env()
+    mlog = metrics_sink.from_context(ctx)
     mesh = make_mesh(MeshConfig())
     n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
     global_batch = per_chip_batch * n_data
@@ -70,6 +72,10 @@ def train(
     last: Dict[str, float] = {}
 
     def on_metrics(m):
+        if mlog:
+            mlog.write(m.step, {"loss": m.loss,
+                                "steps_per_sec": m.steps_per_sec,
+                                **m.extras})
         ips = m.steps_per_sec * global_batch
         last.update({
             "loss": m.loss, "step": m.step,

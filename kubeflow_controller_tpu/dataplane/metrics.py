@@ -1,0 +1,45 @@
+"""Scalar metrics sink wired to the job's ``log_dir``.
+
+The reference declares ``LogDir`` in its API and never reads it
+(``types.go:48-49``, SURVEY.md §2.3); here it is consumed for real: every
+training process appends JSONL scalars to
+``{log_dir}/metrics-p{process_id}.jsonl``. One line per report —
+``{"ts": ..., "step": ..., "<name>": value, ...}`` — greppable, tailable,
+and trivially loadable into pandas; no TensorBoard dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+from kubeflow_controller_tpu.dataplane.dist import ProcessContext
+
+
+class MetricsLogger:
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", buffering=1)   # line-buffered
+        self.path = path
+
+    def write(self, step: int, scalars: Dict[str, float]) -> None:
+        rec = {"ts": round(time.time(), 3), "step": step}
+        rec.update({
+            k: (float(v) if v == v else None)    # NaN -> null, stays JSON
+            for k, v in scalars.items()
+        })
+        self._f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def from_context(ctx: ProcessContext) -> Optional[MetricsLogger]:
+    """MetricsLogger for this process, or None when the job has no log_dir."""
+    if not ctx.log_dir:
+        return None
+    return MetricsLogger(
+        os.path.join(ctx.log_dir, f"metrics-p{ctx.process_id}.jsonl")
+    )

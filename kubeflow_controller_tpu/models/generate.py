@@ -1,0 +1,175 @@
+"""Autoregressive decoding with a KV cache for the decoder family.
+
+Inference completes the model-family story (the reference is training-only;
+its data plane never serves a model). TPU-first shape discipline: the cache
+is a statically-shaped [L, B, max_seq, KVH, D] pair updated with
+``lax.dynamic_update_slice``; the whole generation loop is one ``lax.scan``
+(no per-token Python dispatch), so decode compiles once and streams on
+device. Attention over the cache masks positions >= cur_len — no dynamic
+shapes anywhere.
+
+Sharding: cache KV-head axis carries the same ``tp`` spec as k/v
+projections, batch over (dp, fsdp); decode works under the same mesh as
+training or on a single chip with no mesh at all.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kubeflow_controller_tpu.models import transformer as tfm
+from kubeflow_controller_tpu.models.transformer import (
+    Params, TransformerConfig, rmsnorm, rope,
+)
+
+
+class KVCache(NamedTuple):
+    k: jax.Array          # [L, B, max_seq, KVH, D]
+    v: jax.Array          # [L, B, max_seq, KVH, D]
+    length: jax.Array     # [] int32 — number of valid positions
+
+
+def init_kv_cache(
+    cfg: TransformerConfig, batch: int, max_seq: int,
+) -> KVCache:
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+def _decode_layer(
+    cfg: TransformerConfig,
+    lp: Params,
+    x: jax.Array,               # [B, 1, D_model]
+    pos: jax.Array,             # [] int32 current position
+    k_cache: jax.Array,         # [B, max_seq, KVH, D]
+    v_cache: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    b = x.shape[0]
+    hd = cfg.head_dim
+    dt = cfg.dtype
+    max_seq = k_cache.shape[1]
+
+    h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"].astype(dt)).reshape(b, 1, cfg.n_heads, hd)
+    k = (h @ lp["wk"].astype(dt)).reshape(b, 1, cfg.n_kv_heads, hd)
+    v = (h @ lp["wv"].astype(dt)).reshape(b, 1, cfg.n_kv_heads, hd)
+    positions = jnp.broadcast_to(pos[None, None], (b, 1))
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+
+    # GQA attention of the 1-token query against the cache, fp32 softmax.
+    rep = cfg.n_heads // cfg.n_kv_heads
+    kk = jnp.repeat(k_cache, rep, axis=2)       # [B, S, H, D]
+    vv = jnp.repeat(v_cache, rep, axis=2)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, kk, preferred_element_type=jnp.float32
+    ) * (hd ** -0.5)                             # [B, H, 1, S]
+    valid = jnp.arange(max_seq) <= pos
+    s = jnp.where(valid[None, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", p, vv).reshape(b, 1, -1)
+    x = x + attn @ lp["wo"].astype(dt)
+
+    h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+    up = h @ lp["w_up"].astype(dt)
+    x = x + (gate * up) @ lp["w_down"].astype(dt)
+    return x, k_cache, v_cache
+
+
+def decode_step(
+    cfg: TransformerConfig,
+    params: Params,
+    tokens: jax.Array,          # [B, 1] int32
+    cache: KVCache,
+) -> Tuple[jax.Array, KVCache]:
+    """One token for every sequence in the batch; returns logits [B, vocab]
+    and the updated cache."""
+    x = params["embed"].astype(cfg.dtype)[tokens]     # [B, 1, D]
+    pos = cache.length
+
+    def body(carry, layer_in):
+        x = carry
+        lp, kc, vc = layer_in
+        x, kc, vc = _decode_layer(cfg, lp, x, pos, kc, vc)
+        return x, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(
+        body, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = (x[:, 0] @ head.astype(cfg.dtype)).astype(jnp.float32)
+    return logits, KVCache(k=k_new, v=v_new, length=pos + 1)
+
+
+def prefill(
+    cfg: TransformerConfig,
+    params: Params,
+    prompt: jax.Array,          # [B, S_prompt]
+    cache: KVCache,
+) -> Tuple[jax.Array, KVCache]:
+    """Feed the prompt token-by-token through the decode path (simple and
+    always-correct; a fused block prefill is a later optimisation). Returns
+    logits for the LAST prompt position and the filled cache."""
+
+    def body(carry, tok):
+        cache, _ = carry
+        logits, cache = decode_step(cfg, params, tok[:, None], cache)
+        return (cache, logits), None
+
+    (cache, logits), _ = lax.scan(
+        body,
+        (cache, jnp.zeros((prompt.shape[0], cfg.vocab_size), jnp.float32)),
+        prompt.T,
+    )
+    return logits, cache
+
+
+def generate(
+    cfg: TransformerConfig,
+    params: Params,
+    prompt: jax.Array,          # [B, S_prompt] int32
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    max_seq: Optional[int] = None,
+) -> jax.Array:
+    """Greedy (temperature 0) or sampled generation. Returns [B, new] int32.
+    Jit-compatible: fixed trip counts, static shapes."""
+    b, s_prompt = prompt.shape
+    max_seq = max_seq or cfg.max_seq
+    if s_prompt + max_new_tokens > max_seq:
+        raise ValueError(
+            f"prompt {s_prompt} + new {max_new_tokens} exceeds max_seq {max_seq}"
+        )
+    cache = init_kv_cache(cfg, b, max_seq)
+    logits, cache = prefill(cfg, params, prompt, cache)
+    rng = rng if rng is not None else jax.random.key(0)
+
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return logits.argmax(-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature, axis=-1)
+
+    def body(carry, key):
+        logits, cache = carry
+        tok = pick(logits, key)
+        new_logits, cache = decode_step(cfg, params, tok[:, None], cache)
+        return (new_logits, cache), tok
+
+    keys = jax.random.split(rng, max_new_tokens)
+    _, toks = lax.scan(body, (logits, cache), keys)
+    return toks.T                                     # [B, new]

@@ -93,3 +93,21 @@ def test_daemon_submit_describe_delete(daemon, manifest, capsys):
     assert "executed" in capsys.readouterr().out
     assert cli.main(["delete", "clitest", "--port", port]) == 0
     assert "deleted" in capsys.readouterr().out
+
+
+def test_logs_verb(daemon, manifest, capsys):
+    port = str(daemon)
+    assert cli.main(["submit", "--port", port, "-f", manifest]) == 0
+    capsys.readouterr()
+    import time
+    deadline = time.time() + 20
+    rc = 1
+    while time.time() < deadline:
+        rc = cli.main(["logs", "clitest", "--port", port])
+        out = capsys.readouterr().out
+        if rc == 0 and "exited: code 0" in out:
+            break
+        time.sleep(0.3)
+    assert rc == 0, out
+    assert "scheduled: slice" in out
+    assert "started:" in out

@@ -257,3 +257,39 @@ class TestEval:
         import numpy as np
 
         assert np.isfinite(seen[-1].extras["val_cross_entropy"])
+
+
+class TestMetricsSink:
+    def test_log_dir_written(self, tmp_path):
+        from kubeflow_controller_tpu.dataplane import metrics as ms
+        from kubeflow_controller_tpu.dataplane.dist import ProcessContext
+
+        ctx = ProcessContext(log_dir=str(tmp_path / "logs"), process_id=3)
+        mlog = ms.from_context(ctx)
+        mlog.write(1, {"loss": 0.5, "nan_metric": float("nan")})
+        mlog.write(2, {"loss": 0.25})
+        mlog.close()
+        import json
+        lines = [
+            json.loads(l) for l in open(mlog.path).read().splitlines()
+        ]
+        assert [l["step"] for l in lines] == [1, 2]
+        assert lines[0]["nan_metric"] is None
+        assert lines[1]["loss"] == 0.25
+        assert mlog.path.endswith("metrics-p3.jsonl")
+
+    def test_no_log_dir_no_logger(self):
+        from kubeflow_controller_tpu.dataplane import metrics as ms
+        from kubeflow_controller_tpu.dataplane.dist import ProcessContext
+
+        assert ms.from_context(ProcessContext()) is None
+
+    def test_mnist_entrypoint_writes_metrics(self, tmp_path):
+        from kubeflow_controller_tpu.dataplane.dist import ProcessContext
+        from kubeflow_controller_tpu.dataplane.entrypoints import mnist as ep
+
+        ctx = ProcessContext(log_dir=str(tmp_path))
+        ep.train(ctx=ctx, total_steps=4, batch_size=16)
+        files = list(tmp_path.glob("metrics-*.jsonl"))
+        assert files, "no metrics file written"
+        assert "loss" in files[0].read_text()

@@ -1,0 +1,126 @@
+"""REST adapter: client <-> apiserver-facade over a real socket.
+
+Covers the swap-in seam: CRUD + label selectors + resourceVersion conflicts
+surviving the HTTP hop, and the claim/ownership metadata round-tripping.
+"""
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    Container, ObjectMeta, OwnerReference, Pod, PodPhase, PodSpec, Service,
+    ServicePort, ServiceSpec,
+)
+from kubeflow_controller_tpu.api.serialization import pod_from_dict, pod_to_dict
+from kubeflow_controller_tpu.cluster.cluster import FakeCluster
+from kubeflow_controller_tpu.cluster.rest_client import RestClusterClient
+from kubeflow_controller_tpu.cluster.rest_server import RestServer
+from kubeflow_controller_tpu.cluster.store import AlreadyExists, Conflict
+
+
+@pytest.fixture()
+def cluster():
+    return FakeCluster()
+
+
+@pytest.fixture()
+def client(cluster):
+    server = RestServer(cluster).start()
+    yield RestClusterClient(server.url)
+    server.stop()
+
+
+def make_pod(name, labels=None):
+    return Pod(
+        metadata=ObjectMeta(
+            name=name, namespace="default", labels=labels or {},
+            owner_references=[OwnerReference(
+                api_version="tpu.kubeflow.dev/v1alpha1", kind="TPUJob",
+                name="j", uid="u1", controller=True,
+            )],
+        ),
+        spec=PodSpec(containers=[Container(name="c", image="i",
+                                           command=["python", "-c", "pass"])]),
+    )
+
+
+def test_pod_wire_roundtrip():
+    pod = make_pod("p1", {"a": "b"})
+    pod.status.phase = PodPhase.RUNNING
+    pod.status.start_time = 12.5
+    d = pod_to_dict(pod)
+    back = pod_from_dict(d)
+    assert back.metadata.name == "p1"
+    assert back.metadata.labels == {"a": "b"}
+    assert back.metadata.owner_references[0].uid == "u1"
+    assert back.status.phase == PodPhase.RUNNING
+    assert back.status.start_time == 12.5
+    assert back.spec.containers[0].command == ["python", "-c", "pass"]
+
+
+def test_pod_crud_over_http(client, cluster):
+    created = client.create_pod(make_pod("p1", {"role": "worker"}))
+    assert created.metadata.uid
+    client.create_pod(make_pod("p2", {"role": "ps"}))
+    got = client.list_pods("default", {"role": "worker"})
+    assert [p.metadata.name for p in got] == ["p1"]
+    # server-side state is the same store the fake kubelet runs on
+    assert len(cluster.pods.list("default")) == 2
+    client.delete_pod("default", "p2")
+    assert len(client.list_pods("default", {})) == 1
+
+
+def test_duplicate_create_409(client):
+    client.create_pod(make_pod("p1"))
+    with pytest.raises(AlreadyExists):
+        client.create_pod(make_pod("p1"))
+
+
+def test_update_conflict_over_http(client):
+    created = client.create_pod(make_pod("p1"))
+    stale = created.deepcopy()
+    created.metadata.labels["x"] = "1"
+    client.update_pod(created)          # bumps resourceVersion server-side
+    stale.metadata.labels["x"] = "2"
+    with pytest.raises(Conflict):
+        client.update_pod(stale)        # stale resourceVersion -> 409
+
+
+def test_service_and_events(client, cluster):
+    svc = Service(
+        metadata=ObjectMeta(name="s1", namespace="default"),
+        spec=ServiceSpec(
+            selector={"app": "x"},
+            ports=[ServicePort(port=8476, name="coord")],
+        ),
+    )
+    out = client.create_service(svc)
+    assert out.spec.ports[0].port == 8476
+    assert any(
+        r == "SuccessfulCreate" for (_, _, _, r, _) in cluster.cluster_events
+    )
+    client.delete_service("default", "s1")
+    assert client.list_services("default", {}) == []
+
+
+def test_job_get_update_roundtrip(client, cluster):
+    from kubeflow_controller_tpu.api import (
+        JobPhase, TPUJob, TPUJobSpec, ObjectMeta as OM,
+    )
+
+    cluster.jobs.create(TPUJob(metadata=OM(name="j1", namespace="default"),
+                               spec=TPUJobSpec()))
+    job = client.get_job("default", "j1")
+    assert job is not None
+    job.status.phase = JobPhase.RUNNING
+    out = client.update_job(job)
+    assert out.status.phase == JobPhase.RUNNING
+    assert client.get_job("default", "missing") is None
+
+
+def test_slices_extension(client, cluster):
+    cluster.slice_pool.add_pool("v5p-8", 2)
+    cluster.slice_pool.allocate_gang("uid-1", "v5p-8", 1)
+    held = client.job_slices("uid-1")
+    assert len(held) == 1 and held[0]["accelerator"] == "v5p-8"
+    assert client.release_slices("uid-1") == 1
+    assert client.job_slices("uid-1") == []
