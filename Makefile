@@ -1,0 +1,24 @@
+# Build/test entry points (parity with the reference's Makefile targets:
+# build/test/clean — here the "build" artifact is the native runtime core).
+
+PY ?= python
+
+.PHONY: all native test test-fast bench clean
+
+all: native
+
+native:
+	$(MAKE) -C csrc
+
+test: native
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -x -m "not slow"
+
+bench:
+	$(PY) bench.py
+
+clean:
+	$(MAKE) -C csrc clean
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

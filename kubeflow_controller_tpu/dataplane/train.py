@@ -142,6 +142,12 @@ class TrainLoopConfig:
     # eval stream and report val_* metrics.
     eval_every: int = 0
     eval_batches: int = 1
+    # When set, capture a jax.profiler trace of steps [profile_start,
+    # profile_start + profile_steps) into this directory (SURVEY.md §5.1:
+    # the reference has no profiling at all; this is the data-plane hook).
+    profile_dir: str = ""
+    profile_start: int = 10
+    profile_steps: int = 5
 
 
 @dataclass
@@ -382,7 +388,15 @@ class TrainLoop:
         # what hides per-step host<->device latency (critical over a tunneled
         # chip; the reference instead blocked every step on a gRPC sess.run,
         # mnist_replica.py:251-264).
+        profiling = False
         for py_step in range(start_step, cfg.total_steps):
+            if cfg.profile_dir and py_step == cfg.profile_start:
+                jax.profiler.start_trace(cfg.profile_dir)
+                profiling = True
+            if profiling and py_step == cfg.profile_start + cfg.profile_steps:
+                jax.block_until_ready(self.state.params)
+                jax.profiler.stop_trace()
+                profiling = False
             batch = next(data_iter)
             lead = jax.tree.leaves(batch)[0].shape[0]
             if lead % n_data:
@@ -419,6 +433,9 @@ class TrainLoop:
                 ))
                 t0 = time.perf_counter()
                 window = step
+        if profiling:  # loop ended inside the profile window
+            jax.block_until_ready(self.state.params)
+            jax.profiler.stop_trace()
         if self.model_dir:
             self.save(wait=True)
         return self.state

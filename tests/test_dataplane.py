@@ -293,3 +293,30 @@ class TestMetricsSink:
         files = list(tmp_path.glob("metrics-*.jsonl"))
         assert files, "no metrics file written"
         assert "loss" in files[0].read_text()
+
+    def test_profiler_trace_written(self, tmp_path):
+        import optax
+
+        from kubeflow_controller_tpu.dataplane.train import (
+            TrainLoop, TrainLoopConfig,
+        )
+        from kubeflow_controller_tpu.models import mnist
+        from kubeflow_controller_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        model = mnist.MnistMLP(hidden=8)
+        loop = TrainLoop(
+            mesh=make_mesh(MeshConfig()),
+            init_fn=mnist.make_init_fn(model),
+            loss_fn=mnist.make_loss_fn(model),
+            optimizer=optax.sgd(1e-2),
+            config=TrainLoopConfig(
+                total_steps=6, log_every=100,
+                profile_dir=str(tmp_path / "prof"),
+                profile_start=2, profile_steps=2,
+            ),
+        )
+        loop.run(mnist.synthetic_mnist(16))
+        import glob
+        traces = glob.glob(str(tmp_path / "prof" / "**" / "*.trace*"),
+                           recursive=True)
+        assert traces, "no profiler trace written"
