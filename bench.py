@@ -26,14 +26,14 @@ def main() -> None:
     from kubeflow_controller_tpu.dataplane.train import (
         TrainLoop, TrainLoopConfig, device_prefetch,
     )
-    from kubeflow_controller_tpu.parallel.mesh import batch_sharding
+    from kubeflow_controller_tpu.parallel.mesh import data_shards, batch_sharding
     from kubeflow_controller_tpu.models import mnist
     from kubeflow_controller_tpu.parallel.mesh import MeshConfig, make_mesh
 
     total_steps = 200   # mnist_replica.py:68-70
     batch_size = 100    # mnist_replica.py:64
     mesh = make_mesh(MeshConfig())
-    n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
+    n_data = data_shards(mesh)
     if batch_size % n_data:
         batch_size = ((batch_size + n_data - 1) // n_data) * n_data
 

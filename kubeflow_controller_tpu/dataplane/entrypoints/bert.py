@@ -19,7 +19,7 @@ from kubeflow_controller_tpu.dataplane.train import (
     TrainLoop, TrainLoopConfig, device_prefetch,
 )
 from kubeflow_controller_tpu.models import bert
-from kubeflow_controller_tpu.parallel.mesh import MeshConfig, batch_sharding, make_mesh
+from kubeflow_controller_tpu.parallel.mesh import data_shards, MeshConfig, batch_sharding, make_mesh
 
 logger = logging.getLogger("tpujob.bert")
 
@@ -38,7 +38,7 @@ def train(
     ctx = ctx or ProcessContext.from_env()
     mlog = metrics_sink.from_context(ctx)
     mesh = make_mesh(mesh_config or MeshConfig())
-    n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
+    n_data = data_shards(mesh)
     global_batch = per_data_shard_batch * n_data
     cfg = cfg or bert.bert_base_config(max_seq=max(seq_len, 128))
 

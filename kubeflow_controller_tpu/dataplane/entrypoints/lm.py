@@ -25,6 +25,7 @@ from kubeflow_controller_tpu.dataplane.train import (
 )
 from kubeflow_controller_tpu.models import transformer as tfm
 from kubeflow_controller_tpu.parallel.mesh import (
+    data_shards,
     MeshConfig, batch_sharding, mesh_for_context,
 )
 
@@ -69,7 +70,7 @@ def train(
         attn_impl=attn,
         shard_seq=(attn == "ring" or mesh.shape["sp"] > 1),
     )
-    n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
+    n_data = data_shards(mesh)
     global_batch = per_data_shard_batch * n_data
 
     loop = TrainLoop(

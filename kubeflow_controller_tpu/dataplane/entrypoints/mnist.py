@@ -22,7 +22,7 @@ from kubeflow_controller_tpu.dataplane.dist import ProcessContext, initialize_fr
 from kubeflow_controller_tpu.dataplane import metrics as metrics_sink
 from kubeflow_controller_tpu.dataplane.train import TrainLoop, TrainLoopConfig
 from kubeflow_controller_tpu.models import mnist
-from kubeflow_controller_tpu.parallel.mesh import MeshConfig, make_mesh
+from kubeflow_controller_tpu.parallel.mesh import data_shards, MeshConfig, make_mesh
 
 logger = logging.getLogger("tpujob.mnist")
 
@@ -41,7 +41,7 @@ def train(
     ctx = ctx or ProcessContext.from_env()
     mlog = metrics_sink.from_context(ctx)
     mesh = make_mesh(MeshConfig())  # pure DP over all devices
-    n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
+    n_data = data_shards(mesh)
     if batch_size % n_data:
         # The reference's default --batch_size=100 (mnist_replica.py:64) is
         # not divisible by every mesh; round up so each device gets equal work.

@@ -20,7 +20,7 @@ from kubeflow_controller_tpu.dataplane.train import (
     TrainLoop, TrainLoopConfig, device_prefetch,
 )
 from kubeflow_controller_tpu.models import resnet
-from kubeflow_controller_tpu.parallel.mesh import MeshConfig, batch_sharding, make_mesh
+from kubeflow_controller_tpu.parallel.mesh import data_shards, MeshConfig, batch_sharding, make_mesh
 
 logger = logging.getLogger("tpujob.resnet")
 
@@ -38,7 +38,7 @@ def train(
     ctx = ctx or ProcessContext.from_env()
     mlog = metrics_sink.from_context(ctx)
     mesh = make_mesh(MeshConfig())
-    n_data = mesh.shape["dp"] * mesh.shape["fsdp"]
+    n_data = data_shards(mesh)
     global_batch = per_chip_batch * n_data
     model = model or resnet.resnet50()
 

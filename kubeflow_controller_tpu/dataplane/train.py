@@ -23,7 +23,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubeflow_controller_tpu.parallel.mesh import batch_sharding, replicated
+from kubeflow_controller_tpu.parallel.mesh import batch_sharding, data_shards, replicated
 from kubeflow_controller_tpu.parallel.sharding import infer_param_sharding
 
 logger = logging.getLogger("tpujob.train")
@@ -382,7 +382,7 @@ class TrainLoop:
         rng = jax.random.key(seed + 1)
         t0 = time.perf_counter()
         window = start_step
-        n_data = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+        n_data = data_shards(self.mesh)
         # The loop never reads device values except at log/checkpoint points:
         # steps are dispatched asynchronously and pipeline on-device, which is
         # what hides per-step host<->device latency (critical over a tunneled

@@ -81,10 +81,35 @@ def _decode_layer(
     x = x + attn @ lp["wo"].astype(dt)
 
     h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
-    up = h @ lp["w_up"].astype(dt)
-    x = x + (gate * up) @ lp["w_down"].astype(dt)
+    if cfg.moe_experts:
+        x = x + _moe_decode_ffn(cfg, lp, h)
+    else:
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+        up = h @ lp["w_up"].astype(dt)
+        x = x + (gate * up) @ lp["w_down"].astype(dt)
     return x, k_cache, v_cache
+
+
+def _moe_decode_ffn(
+    cfg: TransformerConfig, lp: Params, h: jax.Array,
+) -> jax.Array:
+    """Routed FFN for single-token decode: gather only the top-k experts'
+    weights per token (no capacity buffers — decode never drops tokens,
+    which matches training whenever training capacity wasn't exceeded)."""
+    dt = cfg.dtype
+    hb = h[:, 0]                                        # [B, D]
+    probs = jax.nn.softmax(
+        hb.astype(jnp.float32) @ lp["w_router"].astype(jnp.float32), -1
+    )
+    gates, idx = jax.lax.top_k(probs, cfg.moe_top_k)    # [B, k]
+    wg = lp["w_gate"].astype(dt)[idx]                   # [B, k, D, F]
+    wu = lp["w_up"].astype(dt)[idx]
+    wd = lp["w_down"].astype(dt)[idx]                   # [B, k, F, D]
+    act = jax.nn.silu(jnp.einsum("bd,bkdf->bkf", hb, wg))
+    up = jnp.einsum("bd,bkdf->bkf", hb, wu)
+    out_k = jnp.einsum("bkf,bkfd->bkd", act * up, wd)   # [B, k, D]
+    out = (out_k * gates[..., None].astype(dt)).sum(1)
+    return out[:, None, :]
 
 
 def decode_step(

@@ -39,6 +39,8 @@ from jax.sharding import PartitionSpec as P
 
 from kubeflow_controller_tpu.ops.attention import mha
 
+from kubeflow_controller_tpu.parallel.mesh import DATA_AXES as BATCH_AXES
+
 Params = Dict[str, Any]
 
 
@@ -59,6 +61,17 @@ class TransformerConfig:
     attn_impl: str = "auto"            # auto|xla|flash|ring
     tie_embeddings: bool = False
     shard_seq: bool = False            # constrain activations' seq axis to sp
+    # Mixture-of-experts: 0 = dense FFN. When > 0 every layer's FFN becomes
+    # a routed expert bank sharded over the mesh's ep axis (GShard-style
+    # grouped capacity dispatch; the all_to_alls are inserted by GSPMD from
+    # the sharding constraints). Tokens route within groups of
+    # ``moe_group_size`` so dispatch memory is linear in token count
+    # (n * group * top_k floats), not quadratic.
+    moe_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    moe_group_size: int = 1024
 
     @property
     def head_dim(self) -> int:
@@ -75,6 +88,20 @@ def tiny_config(**kw) -> TransformerConfig:
     base = TransformerConfig(
         vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=128, max_seq=128, remat=False, dtype=jnp.float32,
+    )
+    return base.replace(**kw)
+
+
+def tiny_moe_config(**kw) -> TransformerConfig:
+    base = tiny_config(moe_experts=4, moe_top_k=2, d_ff=64)
+    return base.replace(**kw)
+
+
+def mixtral_8x7b_config(**kw) -> TransformerConfig:
+    base = TransformerConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=14336, max_seq=8192, rope_theta=1e6,
+        moe_experts=8, moe_top_k=2,
     )
     return base.replace(**kw)
 
@@ -102,7 +129,7 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Params:
     lax.scan."""
     pd = cfg.param_dtype
     hd = cfg.head_dim
-    keys = jax.random.split(rng, 8)
+    keys = jax.random.split(rng, 9)
 
     def norm_init(key, shape, fan_in):
         return (jax.random.normal(key, shape, pd) * (fan_in ** -0.5))
@@ -112,19 +139,31 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Params:
     def stacked(key, shape, fan_in):
         return norm_init(key, (L, *shape), fan_in)
 
-    params: Params = {
-        "embed": norm_init(keys[0], (cfg.vocab_size, cfg.d_model), cfg.d_model),
-        "layers": {
-            "attn_norm": jnp.ones((L, cfg.d_model), pd),
-            "wq": stacked(keys[1], (cfg.d_model, cfg.n_heads * hd), cfg.d_model),
-            "wk": stacked(keys[2], (cfg.d_model, cfg.n_kv_heads * hd), cfg.d_model),
-            "wv": stacked(keys[3], (cfg.d_model, cfg.n_kv_heads * hd), cfg.d_model),
-            "wo": stacked(keys[4], (cfg.n_heads * hd, cfg.d_model), cfg.n_heads * hd),
-            "mlp_norm": jnp.ones((L, cfg.d_model), pd),
+    layers: Params = {
+        "attn_norm": jnp.ones((L, cfg.d_model), pd),
+        "wq": stacked(keys[1], (cfg.d_model, cfg.n_heads * hd), cfg.d_model),
+        "wk": stacked(keys[2], (cfg.d_model, cfg.n_kv_heads * hd), cfg.d_model),
+        "wv": stacked(keys[3], (cfg.d_model, cfg.n_kv_heads * hd), cfg.d_model),
+        "wo": stacked(keys[4], (cfg.n_heads * hd, cfg.d_model), cfg.n_heads * hd),
+        "mlp_norm": jnp.ones((L, cfg.d_model), pd),
+    }
+    if cfg.moe_experts:
+        E = cfg.moe_experts
+        layers.update({
+            "w_router": stacked(keys[8], (cfg.d_model, E), cfg.d_model),
+            "w_gate": stacked(keys[5], (E, cfg.d_model, cfg.d_ff), cfg.d_model),
+            "w_up": stacked(keys[6], (E, cfg.d_model, cfg.d_ff), cfg.d_model),
+            "w_down": stacked(keys[7], (E, cfg.d_ff, cfg.d_model), cfg.d_ff),
+        })
+    else:
+        layers.update({
             "w_gate": stacked(keys[5], (cfg.d_model, cfg.d_ff), cfg.d_model),
             "w_up": stacked(keys[6], (cfg.d_model, cfg.d_ff), cfg.d_model),
             "w_down": stacked(keys[7], (cfg.d_ff, cfg.d_model), cfg.d_ff),
-        },
+        })
+    params: Params = {
+        "embed": norm_init(keys[0], (cfg.vocab_size, cfg.d_model), cfg.d_model),
+        "layers": layers,
         "final_norm": jnp.ones((cfg.d_model,), pd),
     }
     if not cfg.tie_embeddings:
@@ -140,19 +179,31 @@ def param_specs(cfg: TransformerConfig) -> Params:
     their output dim on tp; row-parallel put their input dim on tp; the other
     matmul dim is fsdp-sharded for ZeRO-3-style storage. Stacked layer arrays
     keep the leading layer axis unsharded."""
-    specs: Params = {
-        "embed": P("tp", "fsdp"),
-        "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, "fsdp", "tp"),
-            "wk": P(None, "fsdp", "tp"),
-            "wv": P(None, "fsdp", "tp"),
-            "wo": P(None, "tp", "fsdp"),
-            "mlp_norm": P(None, None),
+    layers = {
+        "attn_norm": P(None, None),
+        "wq": P(None, "fsdp", "tp"),
+        "wk": P(None, "fsdp", "tp"),
+        "wv": P(None, "fsdp", "tp"),
+        "wo": P(None, "tp", "fsdp"),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.moe_experts:
+        layers.update({
+            "w_router": P(None, "fsdp", None),
+            # expert bank: experts over ep, then megatron (fsdp, tp) within
+            "w_gate": P(None, "ep", "fsdp", "tp"),
+            "w_up": P(None, "ep", "fsdp", "tp"),
+            "w_down": P(None, "ep", "tp", "fsdp"),
+        })
+    else:
+        layers.update({
             "w_gate": P(None, "fsdp", "tp"),
             "w_up": P(None, "fsdp", "tp"),
             "w_down": P(None, "tp", "fsdp"),
-        },
+        })
+    specs: Params = {
+        "embed": P("tp", "fsdp"),
+        "layers": layers,
         "final_norm": P(None),
     }
     if not cfg.tie_embeddings:
@@ -203,7 +254,89 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 def _act_spec(cfg: TransformerConfig) -> P:
     seq = "sp" if cfg.shard_seq else None
-    return P(("dp", "fsdp"), seq, None)
+    return P(BATCH_AXES, seq, None)
+
+
+def _moe_ffn(
+    cfg: TransformerConfig, lp: Params, h: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """GShard-style routed FFN with grouped capacity dispatch.
+
+    h: [B, S, D] -> (out [B, S, D], aux load-balance loss []).
+
+    Tokens route within groups of ``moe_group_size`` with a per-group
+    capacity of ``top_k * group / E * capacity_factor`` slots, so dispatch
+    memory is O(n · group · top_k) — linear in token count — and capacity
+    is correctly scaled for multi-way routing.
+
+    Pure-GSPMD expert parallelism: tokens arrive sharded over BATCH_AXES,
+    the dispatched expert bank is constrained to P("ep", ...), and XLA
+    derives the token->expert all_to_all from that sharding change — no
+    hand-written collectives (the scaling-book recipe).
+    """
+    b, s, d = h.shape
+    E = cfg.moe_experts
+    n = b * s
+    # Largest divisor of n not exceeding the configured group size (same
+    # trick as the chunked LM loss: the memory bound must hold for any n).
+    group = max(
+        (g for g in range(1, min(cfg.moe_group_size, n) + 1) if n % g == 0)
+    )
+    G = n // group
+    x = h.reshape(G, group, d)
+    x = _constrain(x, P(BATCH_AXES, None, None))
+    router = lp["w_router"].astype(jnp.float32)
+    probs = jax.nn.softmax(
+        x.astype(jnp.float32) @ router, axis=-1
+    )                                                   # [G, g, E]
+    cap = int(max(
+        1, round(cfg.moe_top_k * group / E * cfg.moe_capacity_factor)
+    ))
+
+    combine = jnp.zeros((G, group, E, cap), jnp.float32)
+    base_count = jnp.zeros((G, E), jnp.int32)           # slots already used
+    remaining = probs
+    aux_fraction = jnp.zeros((), jnp.float32)
+    for _ in range(cfg.moe_top_k):
+        choice = remaining.argmax(-1)                   # [G, g]
+        gate = jnp.take_along_axis(
+            remaining, choice[..., None], -1
+        )[..., 0]                                       # [G, g]
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)   # [G, g, E]
+        # position of each token within its chosen expert's capacity buffer
+        pos = (
+            jnp.cumsum(onehot, axis=1) - 1 + base_count[:, None, :]
+        )                                               # [G, g, E]
+        pos_tok = (pos * onehot).sum(-1)                # [G, g]
+        keep = pos_tok < cap
+        combine = combine + (
+            gate[..., None, None]
+            * onehot.astype(jnp.float32)[..., None]
+            * jax.nn.one_hot(pos_tok, cap, dtype=jnp.float32)[..., None, :]
+            * keep[..., None, None]
+        )
+        aux_fraction = aux_fraction + E * jnp.mean(
+            jnp.mean(onehot.astype(jnp.float32), axis=1)
+            * jnp.mean(probs, axis=1)
+        )
+        base_count = base_count + (onehot * keep[..., None]).sum(1)
+        remaining = remaining * (1 - onehot)            # mask picked expert
+
+    dispatch = (combine > 0).astype(cfg.dtype)          # [G, g, E, cap]
+    xe = jnp.einsum("gnec,gnd->egcd", dispatch, x)      # [E, G, cap, D]
+    xe = _constrain(xe, P("ep", ("dp", "fsdp"), None, None))
+    gate_h = jax.nn.silu(
+        jnp.einsum("egcd,edf->egcf", xe, lp["w_gate"].astype(cfg.dtype))
+    )
+    up_h = jnp.einsum("egcd,edf->egcf", xe, lp["w_up"].astype(cfg.dtype))
+    out_e = jnp.einsum(
+        "egcf,efd->egcd", gate_h * up_h, lp["w_down"].astype(cfg.dtype)
+    )
+    out_e = _constrain(out_e, P("ep", ("dp", "fsdp"), None, None))
+    out = jnp.einsum(
+        "gnec,egcd->gnd", combine.astype(cfg.dtype), out_e
+    ).reshape(b, s, d)
+    return _constrain(out, _act_spec(cfg)), aux_fraction
 
 
 def _layer(
@@ -224,9 +357,9 @@ def _layer(
     v = (h @ lp["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    q = _constrain(q, P(("dp", "fsdp"), None, "tp", None))
-    k = _constrain(k, P(("dp", "fsdp"), None, "tp", None))
-    v = _constrain(v, P(("dp", "fsdp"), None, "tp", None))
+    q = _constrain(q, P(BATCH_AXES, None, "tp", None))
+    k = _constrain(k, P(BATCH_AXES, None, "tp", None))
+    v = _constrain(v, P(BATCH_AXES, None, "tp", None))
     if cfg.attn_impl == "ring":
         from kubeflow_controller_tpu.parallel.ring import ring_mha
 
@@ -237,12 +370,16 @@ def _layer(
     attn = attn.reshape(b, s, cfg.n_heads * hd)
     x = x + _constrain(attn @ lp["wo"].astype(dt), _act_spec(cfg))
 
-    # -- mlp block (SwiGLU)
+    # -- mlp block (SwiGLU dense, or routed experts)
     h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
-    up = h @ lp["w_up"].astype(dt)
-    down = (gate * up) @ lp["w_down"].astype(dt)
-    return x + _constrain(down, _act_spec(cfg))
+    if cfg.moe_experts:
+        down, aux = _moe_ffn(cfg, lp, h)
+    else:
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
+        up = h @ lp["w_up"].astype(dt)
+        down = (gate * up) @ lp["w_down"].astype(dt)
+        aux = jnp.zeros((), jnp.float32)
+    return x + _constrain(down, _act_spec(cfg)), aux
 
 
 def forward_hidden(
@@ -251,8 +388,9 @@ def forward_hidden(
     tokens: jax.Array,
     positions: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
-) -> jax.Array:
-    """tokens [B,S] int32 -> final-norm hidden states [B,S,d_model]."""
+) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B,S] int32 -> (final-norm hidden [B,S,d_model], MoE aux loss
+    [] — zero for dense models)."""
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
@@ -260,14 +398,14 @@ def forward_hidden(
     x = _constrain(x, _act_spec(cfg))
 
     body = lambda carry, lp: (  # noqa: E731
-        _layer(cfg, lp, carry, positions, segment_ids), None,
+        _layer(cfg, lp, carry, positions, segment_ids)
     )
     if cfg.remat:
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         )
-    x, _ = lax.scan(body, x, params["layers"])
-    return rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    x, aux = lax.scan(body, x, params["layers"])
+    return rmsnorm(x, params["final_norm"], cfg.norm_eps), aux.sum()
 
 
 def forward(
@@ -278,7 +416,7 @@ def forward(
     segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """tokens [B,S] int32 -> logits [B,S,vocab] float32."""
-    x = forward_hidden(cfg, params, tokens, positions, segment_ids)
+    x, _ = forward_hidden(cfg, params, tokens, positions, segment_ids)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
@@ -286,7 +424,7 @@ def forward(
         "bsd,dv->bsv", x, head.astype(cfg.dtype),
         preferred_element_type=jnp.float32,
     )
-    return _constrain(logits, P(("dp", "fsdp"), None, "tp"))
+    return _constrain(logits, P(BATCH_AXES, None, "tp"))
 
 
 # -- loss / glue for TrainLoop ------------------------------------------------
@@ -330,6 +468,10 @@ def next_token_loss(
     vocab projection in sequence chunks of that size (bounds logits memory)."""
     tokens = batch["tokens"]
     targets = tokens[:, 1:]
+    hidden, aux = forward_hidden(cfg, params, tokens[:, :-1])
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
     if loss_chunk:
         s = targets.shape[1]
         # Largest divisor of S not exceeding the requested chunk, so the
@@ -338,15 +480,15 @@ def next_token_loss(
         chunk = max(
             (d for d in range(1, min(loss_chunk, s) + 1) if s % d == 0)
         )
-        hidden = forward_hidden(cfg, params, tokens[:, :-1])
-        head = params.get("lm_head")
-        if head is None:
-            head = params["embed"].T
         nll, am = _chunked_nll_and_argmax(
             cfg, hidden, head.astype(cfg.dtype), targets, chunk
         )
     else:
-        logits = forward(cfg, params, tokens[:, :-1])
+        logits = jnp.einsum(
+            "bsd,dv->bsv", hidden, head.astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        logits = _constrain(logits, P(BATCH_AXES, None, "tp"))
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
         am = logits.argmax(-1)
@@ -357,7 +499,12 @@ def next_token_loss(
     else:
         loss = nll.mean()
     acc = jnp.mean((am == targets).astype(jnp.float32))
-    return loss, {"accuracy": acc, "perplexity": jnp.exp(loss)}
+    ce = loss
+    metrics = {"accuracy": acc, "perplexity": jnp.exp(ce)}
+    if cfg.moe_experts:
+        loss = loss + cfg.moe_aux_weight * aux
+        metrics["moe_aux"] = aux
+    return loss, metrics
 
 
 def make_loss_fn(cfg: TransformerConfig):

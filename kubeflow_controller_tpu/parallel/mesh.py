@@ -22,33 +22,39 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "sp", "tp")
+AXES = ("dp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclass(frozen=True)
 class MeshConfig:
-    """Logical mesh shape. -1 on dp means "absorb all remaining devices"."""
+    """Logical mesh shape. -1 on dp means "absorb all remaining devices".
+
+    ``ep`` is the expert-parallel axis (MoE experts shard over it; dense
+    models leave it at 1 and never notice it exists).
+    """
 
     dp: int = -1
     fsdp: int = 1
+    ep: int = 1
     sp: int = 1
     tp: int = 1
 
-    def resolve(self, n_devices: int) -> Tuple[int, int, int, int]:
-        fixed = self.fsdp * self.sp * self.tp
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int, int]:
+        fixed = self.fsdp * self.ep * self.sp * self.tp
         if self.dp == -1:
             if n_devices % fixed:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fsdp*sp*tp={fixed}"
+                    f"{n_devices} devices not divisible by "
+                    f"fsdp*ep*sp*tp={fixed}"
                 )
-            return (n_devices // fixed, self.fsdp, self.sp, self.tp)
+            return (n_devices // fixed, self.fsdp, self.ep, self.sp, self.tp)
         total = self.dp * fixed
         if total != n_devices:
             raise ValueError(
-                f"mesh {self.dp}x{self.fsdp}x{self.sp}x{self.tp}={total} "
-                f"!= {n_devices} devices"
+                f"mesh {self.dp}x{self.fsdp}x{self.ep}x{self.sp}x{self.tp}"
+                f"={total} != {n_devices} devices"
             )
-        return (self.dp, self.fsdp, self.sp, self.tp)
+        return (self.dp, self.fsdp, self.ep, self.sp, self.tp)
 
 
 def make_mesh(
@@ -112,15 +118,15 @@ def make_multislice_mesh(
         groups = [
             devs[i * per_slice:(i + 1) * per_slice] for i in range(num_slices)
         ]
-    dp, fsdp, sp, tp = config.resolve(len(devs))
+    dp, fsdp, ep, sp, tp = config.resolve(len(devs))
     if dp % num_slices:
         raise ValueError(
             f"dp={dp} must be divisible by num_slices={num_slices} "
-            f"(fsdp/sp/tp must not straddle the DCN)"
+            f"(fsdp/ep/sp/tp must not straddle the DCN)"
         )
     arr = np.array(groups).reshape(
-        num_slices, dp // num_slices, fsdp, sp, tp
-    ).reshape(dp, fsdp, sp, tp)
+        num_slices, dp // num_slices, fsdp, ep, sp, tp
+    ).reshape(dp, fsdp, ep, sp, tp)
     return Mesh(arr, AXES)
 
 
@@ -136,10 +142,24 @@ def mesh_for_context(
     )
 
 
+DATA_AXES = ("dp", "fsdp", "ep")
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Global batch is split over every data-like axis (dp and fsdp); sp/tp
-    groups see identical batch shards."""
-    return NamedSharding(mesh, P(("dp", "fsdp")))
+    """Global batch is split over every data-like axis (dp, fsdp, and — for
+    MoE meshes — ep, which carries data in the dense parts of the model and
+    experts inside MoE blocks); sp/tp groups see identical batch shards."""
+    axes = tuple(a for a in DATA_AXES if a in mesh.axis_names)
+    return NamedSharding(mesh, P(axes))
+
+
+def data_shards(mesh: Mesh) -> int:
+    """Number of distinct batch shards the mesh implies (global batch must
+    divide by this)."""
+    n = 1
+    for a in DATA_AXES:
+        n *= mesh.shape.get(a, 1)
+    return n
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

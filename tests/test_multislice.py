@@ -57,7 +57,7 @@ class TestMultisliceMesh:
     def test_mesh_for_context(self):
         ctx = ProcessContext(num_slices=2)
         mesh = mesh_for_context(ctx, MeshConfig(dp=2, fsdp=2, sp=1, tp=2))
-        assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "sp": 1, "tp": 2}
+        assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
         single = mesh_for_context(ProcessContext(), MeshConfig())
         assert single.shape["dp"] == 8
 
