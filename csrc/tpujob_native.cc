@@ -280,8 +280,15 @@ int wq_num_requeues(void* h, const char* key) {
 // (excluding NUL), -1 when no item (shutdown/timeout), -2 if buf too small.
 int wq_get(void* h, double timeout, char* buf, int buflen) {
   std::string out;
-  if (!static_cast<WorkQueue*>(h)->Get(timeout, &out)) return -1;
-  if (static_cast<int>(out.size()) + 1 > buflen) return -2;
+  WorkQueue* q = static_cast<WorkQueue*>(h);
+  if (!q->Get(timeout, &out)) return -1;
+  if (static_cast<int>(out.size()) + 1 > buflen) {
+    // The key cannot be returned, so retire it from the processing set —
+    // otherwise it stays in-flight forever and empty_and_idle() wedges for
+    // every consumer. The caller still sees -2 and reports the loss.
+    q->Done(out);
+    return -2;
+  }
   std::memcpy(buf, out.data(), out.size());
   buf[out.size()] = '\0';
   return static_cast<int>(out.size());

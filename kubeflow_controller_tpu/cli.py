@@ -373,6 +373,12 @@ def cmd_run(args) -> int:
         if last_phase in (JobPhase.SUCCEEDED, JobPhase.FAILED):
             break
     j = rt.get_job(ns, name)
+    if j is None:
+        print(
+            f"error: job {ns}/{name} not found (deleted or never reached a "
+            f"terminal phase within {args.timeout}s)", file=sys.stderr,
+        )
+        return 1
     if j.status.submit_time and j.status.all_running_time:
         print(f"submit -> all-running: "
               f"{j.status.all_running_time - j.status.submit_time:.2f}s (sim)")

@@ -24,7 +24,10 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_controller_tpu.parallel.mesh import batch_sharding, data_shards, replicated
-from kubeflow_controller_tpu.parallel.sharding import infer_param_sharding
+from kubeflow_controller_tpu.parallel.sharding import (
+    infer_param_sharding,
+    opt_state_shardings,
+)
 
 logger = logging.getLogger("tpujob.train")
 
@@ -231,23 +234,11 @@ class TrainLoop:
 
     def _opt_shardings(self, params: Any) -> Any:
         """Optimizer state mirrors parameter sharding (ZeRO-style: moments
-        live wherever their parameter lives); scalar states replicate."""
-        shape = jax.eval_shape(self.tx.init, params)
-        # Opt-state leaves that are param-shaped adopt the param's sharding;
-        # everything else (step counters, scalars) replicates.
-        param_leaves = jax.tree.leaves(params)
-        param_shard_leaves = jax.tree.leaves(self.param_shardings)
-        by_shape = {}
-        for p, s in zip(param_leaves, param_shard_leaves):
-            by_shape.setdefault(p.shape, s)
-
-        def pick(leaf):
-            s = by_shape.get(leaf.shape)
-            if s is not None and leaf.ndim > 0:
-                return s
-            return replicated(self.mesh)
-
-        return jax.tree.map(pick, shape)
+        live wherever their parameter lives); scalar states replicate.
+        Matched by tree path — see ``parallel.sharding.opt_state_shardings``."""
+        return opt_state_shardings(
+            self.tx, params, self.param_shardings, self.mesh
+        )
 
     # -- jitted step ---------------------------------------------------------
 

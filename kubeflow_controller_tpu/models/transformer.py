@@ -493,12 +493,15 @@ def next_token_loss(
         nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
         am = logits.argmax(-1)
     mask = batch.get("mask")
+    hits = (am == targets).astype(jnp.float32)
     if mask is not None:
         mask = mask[:, 1:].astype(jnp.float32)
-        loss = (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        denom = jnp.maximum(mask.sum(), 1.0)
+        loss = (nll * mask).sum() / denom
+        acc = (hits * mask).sum() / denom
     else:
         loss = nll.mean()
-    acc = jnp.mean((am == targets).astype(jnp.float32))
+        acc = hits.mean()
     ce = loss
     metrics = {"accuracy": acc, "perplexity": jnp.exp(ce)}
     if cfg.moe_experts:

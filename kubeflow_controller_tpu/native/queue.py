@@ -49,8 +49,15 @@ class NativeRateLimitingQueue:
         n = self._lib.wq_get(
             self._h, -1.0 if timeout is None else timeout, buf, _KEY_BUF
         )
-        if n < 0:
+        if n == -1:
             return None
+        if n < -1:
+            # -2: the C++ side already popped the key into its processing
+            # set but it didn't fit the buffer — treating this as "empty"
+            # would silently lose the item and wedge empty_and_idle().
+            raise RuntimeError(
+                f"workqueue key exceeds {_KEY_BUF - 1} bytes; item lost"
+            )
         return buf.raw[:n].decode()
 
     def done(self, item: Hashable) -> None:

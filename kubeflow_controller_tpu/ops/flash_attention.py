@@ -36,6 +36,28 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
+def _choose_block(s: int, requested: int) -> int:
+    """Largest block <= requested that tiles the sequence exactly.
+
+    The grid is ``s // block`` with no tail handling, so a non-divisor block
+    would silently leave trailing positions uncomputed. Blocks must stay a
+    multiple of 8 (fp32 sublane tile) unless the block IS the full sequence
+    (the array-dim exception); sequences with no such divisor are rejected —
+    pad the sequence to a multiple of 8 first.
+    """
+    requested = min(requested, s)
+    if s % requested == 0 and (requested % 8 == 0 or requested == s):
+        return requested
+    for b in range(requested, 7, -1):
+        if s % b == 0 and b % 8 == 0:
+            return b
+    raise ValueError(
+        f"flash attention: seq_len {s} has no block divisor that is a "
+        f"multiple of 8; pad the sequence (e.g. to {-(-s // 8) * 8}) or "
+        "use the XLA attention path"
+    )
+
+
 # -- forward kernel ----------------------------------------------------------
 
 def _fwd_kernel(
@@ -101,16 +123,16 @@ def _fwd_kernel(
         )
 
 
-def _fwd(
+def _fwd_wide(
     q: jax.Array, k: jax.Array, v: jax.Array,
     causal: bool, block_q: int, block_k: int, interpret: bool,
 ):
-    """q: [B,H,S,D]; k/v: [B,KVH,S,D] -> (o [B,H,S,D], lse [B,H,S])."""
+    """q: [B,H,S,D]; k/v: [B,KVH,S,D] -> (o [B,H,S,D], lse [B,H,S,128])."""
     b, h, s, d = q.shape
     kv_h = k.shape[1]
     rep = h // kv_h
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
+    block_q = _choose_block(s, block_q)
+    block_k = _choose_block(s, block_k)
     nq = s // block_q
     nk = s // block_k
     sm_scale = d ** -0.5
@@ -149,6 +171,21 @@ def _fwd(
         ],
         interpret=interpret,
     )(q, k, v)
+
+
+def _fwd(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool, block_q: int, block_k: int, interpret: bool,
+):
+    """q: [B,H,S,D]; k/v: [B,KVH,S,D] -> (o [B,H,S,D], lse [B,H,S]).
+
+    The kernel emits LSE broadcast over 128 lanes (tile alignment); only
+    lane 0 carries information, so the residual saved for backward is the
+    narrow [B,H,S] slice — 128x smaller (ADVICE r1: the broadcast residual
+    was ~2x the attention output itself at head_dim 128 bf16).
+    """
+    o, lse_wide = _fwd_wide(q, k, v, causal, block_q, block_k, interpret)
+    return o, lse_wide[..., 0]
 
 
 # -- backward kernels --------------------------------------------------------
@@ -263,8 +300,8 @@ def _bwd(
     b, h, s, d = q.shape
     kv_h = k.shape[1]
     rep = h // kv_h
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
+    block_q = _choose_block(s, block_q)
+    block_k = _choose_block(s, block_k)
     nq = s // block_q
     nk = s // block_k
     sm_scale = d ** -0.5
@@ -273,6 +310,9 @@ def _bwd(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )                                                   # [B,H,S]
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
+    # Residual LSE is the narrow [B,H,S]; re-broadcast to the lane-aligned
+    # [B,H,S,128] layout the kernels read (transient, fused by XLA).
+    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
 
     # dk/dv: one pass per k-block, q innermost. Heads stay un-grouped (dk for
     # a shared GQA head accumulates across its query heads afterwards).
@@ -382,13 +422,18 @@ def flash_mha(
     segment_ids: Optional[jax.Array] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention, [B,S,H,D] in/out (BSHD, matching ops.attention.mha).
 
     segment_ids is not fused yet — packed batches fall back to the XLA path
     (the dispatcher in ops.attention already routes them there).
+
+    ``interpret=None`` auto-selects: compiled Mosaic on TPU, interpreter
+    elsewhere — so explicit ``impl='flash'`` works (slowly) on CPU meshes.
     """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     if segment_ids is not None:
         from kubeflow_controller_tpu.ops.attention import mha_xla
 

@@ -74,3 +74,45 @@ def shard_params(params: Any, shardings: Any) -> Any:
     return jax.tree.map(
         lambda p, s: jax.device_put(p, s), params, shardings
     )
+
+
+def opt_state_shardings(
+    tx: Any, params: Any, param_shardings: Any, mesh: Mesh
+) -> Any:
+    """Shardings for ``tx.init(params)``: each opt-state leaf that mirrors a
+    parameter adopts that parameter's sharding (ZeRO-style — moments live
+    wherever their parameter lives); everything else (step counters,
+    scalars, factored moments with reduced shapes) replicates.
+
+    Matching is by tree path, not array shape: optax states embed copies of
+    the param tree (e.g. Adam's ``mu``/``nu``), so a parameter's key-path
+    appears as a suffix of the corresponding opt-state leaf's path. Shape
+    matching is wrong by construction — two equal-shaped params (say ``wq``
+    vs ``wo`` when d_model == n_heads*head_dim) can carry different
+    PartitionSpecs, and first-spec-wins would silently mis-shard the second
+    param's moments.
+    """
+    shape = jax.eval_shape(tx.init, params)
+    flat_params = jax.tree_util.tree_flatten_with_path(params)[0]
+    by_path: Dict[Tuple, Tuple[Tuple[int, ...], Any]] = {
+        tuple(path): (leaf.shape, s)
+        for (path, leaf), s in zip(
+            flat_params, jax.tree.leaves(param_shardings)
+        )
+    }
+    suffix_lens = sorted({len(p) for p in by_path}, reverse=True)
+    repl = NamedSharding(mesh, P())
+
+    def pick(path, leaf):
+        if leaf.ndim > 0:
+            for plen in suffix_lens:  # longest path suffix wins
+                hit = by_path.get(tuple(path[-plen:]))
+                if hit is not None:
+                    pshape, s = hit
+                    # A factored/reduced-shape moment (e.g. adafactor row/
+                    # col stats) shares the path but not the shape; its
+                    # parameter's spec would be rank-wrong, so replicate.
+                    return s if leaf.shape == pshape else repl
+        return repl
+
+    return jax.tree_util.tree_map_with_path(pick, shape)

@@ -41,6 +41,61 @@ class TestMesh:
         shard = x.addressable_shards[0]
         assert shard.data.shape == (2, 4)
 
+    def test_opt_state_shardings_match_by_path_not_shape(self):
+        # Two equal-shaped params with DIFFERENT specs: shape-based matching
+        # would give both Adam moments the first param's spec.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kubeflow_controller_tpu.parallel.sharding import (
+            opt_state_shardings,
+        )
+
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+        params = {
+            "wq": jnp.zeros((8, 8)),
+            "wo": jnp.zeros((8, 8)),
+        }
+        param_sh = {
+            "wq": NamedSharding(mesh, P("fsdp", "tp")),
+            "wo": NamedSharding(mesh, P("tp", "fsdp")),
+        }
+        tx = optax.adamw(1e-3)
+        opt_sh = opt_state_shardings(tx, params, param_sh, mesh)
+        for moment in ("mu", "nu"):
+            tree = getattr(opt_sh[0], moment)
+            assert tree["wq"].spec == P("fsdp", "tp")
+            assert tree["wo"].spec == P("tp", "fsdp")
+        # scalar count replicates
+        assert opt_sh[0].count.spec == P()
+        # and tx.init under these shardings actually places correctly
+        state = jax.jit(tx.init, out_shardings=opt_sh)(params)
+        assert state[0].mu["wo"].sharding.spec == P("tp", "fsdp")
+
+    def test_opt_state_shardings_factored_moments_replicate(self):
+        # Adafactor's row/col stats share the param's path but not its
+        # shape; they must fall back to replicated, not a rank-wrong spec.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kubeflow_controller_tpu.parallel.sharding import (
+            opt_state_shardings,
+        )
+
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+        params = {"w": jnp.zeros((8, 16))}
+        param_sh = {"w": NamedSharding(mesh, P("fsdp", "tp"))}
+        tx = optax.adafactor(1e-3)
+        opt_sh = opt_state_shardings(tx, params, param_sh, mesh)
+        state = jax.jit(tx.init, out_shardings=opt_sh)(params)
+        flat = jax.tree_util.tree_flatten_with_path(state)[0]
+        # every factored (reduced-shape) leaf ended up replicated; the
+        # full-shape grad accumulator (if any) keeps the param spec
+        for path, leaf in flat:
+            if hasattr(leaf, "sharding") and leaf.ndim > 0:
+                if leaf.shape == (8, 16):
+                    assert leaf.sharding.spec == P("fsdp", "tp"), path
+                else:
+                    assert leaf.sharding.spec == P(), path
+
 
 class TestProcessContext:
     def test_from_env_roundtrip(self):

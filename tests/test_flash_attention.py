@@ -44,6 +44,26 @@ def test_forward_uneven_blocks():
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
 
 
+def test_non_divisor_block_shrinks_to_divisor():
+    # S=192 with the DEFAULT 128-block: 192 % 128 != 0. The kernel must
+    # shrink the block to a divisor (96) instead of silently leaving the
+    # tail positions uncomputed (r1 advisory: NaN output at s=192).
+    q, k, v = qkv(s=192)
+    ref = mha_xla(q, k, v, causal=True)
+    out = flash_mha(q, k, v, causal=True, interpret=True)  # default blocks
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+    g = jax.grad(lambda q: (flash_mha(q, k, v, interpret=True) ** 2).sum())(q)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_undivisible_seq_rejected():
+    q, k, v = qkv(s=132)  # no divisor that is a multiple of 8
+    with pytest.raises(ValueError, match="pad the sequence"):
+        flash_mha(q, k, v, interpret=True)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_grads_match_dense(causal):
     q, k, v = qkv(s=128)

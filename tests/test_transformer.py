@@ -112,6 +112,25 @@ def test_trains_on_synthetic_lm(cfg, params):
     assert float(loss) < first * 0.5, (first, float(loss))
 
 
+def test_masked_accuracy_ignores_padding(cfg, params):
+    # Accuracy must be weighted by the same mask as the loss: replacing
+    # padded positions' tokens must not move either metric.
+    r = np.random.default_rng(6)
+    tokens = jnp.asarray(r.integers(0, cfg.vocab_size, (2, 33)), jnp.int32)
+    mask = np.ones((2, 33), np.float32)
+    mask[:, 20:] = 0.0
+    batch = {"tokens": tokens, "mask": jnp.asarray(mask)}
+    loss1, m1 = tfm.next_token_loss(cfg, params, batch)
+    garbled = tokens.at[:, 21:].set(
+        jnp.asarray(r.integers(0, cfg.vocab_size, (2, 12)), jnp.int32)
+    )
+    loss2, m2 = tfm.next_token_loss(
+        cfg, params, {"tokens": garbled, "mask": jnp.asarray(mask)}
+    )
+    np.testing.assert_allclose(float(m1["accuracy"]), float(m2["accuracy"]))
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-6)
+
+
 def test_chunked_loss_matches_dense(cfg, params):
     tokens = jnp.asarray(
         np.random.default_rng(5).integers(0, cfg.vocab_size, (2, 33)),
