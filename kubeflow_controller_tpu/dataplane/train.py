@@ -278,12 +278,21 @@ class TrainLoop:
             metrics = {"loss": loss, **metrics}
             return new_state, metrics
 
-        return jax.jit(
+        jitted = jax.jit(
             step,
             in_shardings=(self.state_shardings, batch_sharding(self.mesh), None),
             out_shardings=(self.state_shardings, None),
             donate_argnums=(0,) if cfg.donate_state else (),
         )
+
+        # Trace-time code (MoE group alignment, shard-aware lookups) reads
+        # the ambient abstract mesh; jit alone never establishes one, so the
+        # first (tracing) call must run under set_mesh.
+        def call(state, batch, rng):
+            with jax.set_mesh(self.mesh):
+                return jitted(state, batch, rng)
+
+        return call
 
     def _build_eval(self):
         def ev(state: TrainState, batch: Any):
@@ -291,10 +300,16 @@ class TrainLoop:
                 return self.eval_fn(state.params, state.model_state, batch)
             return self.eval_fn(state.params, batch)
 
-        return jax.jit(
+        jitted = jax.jit(
             ev,
             in_shardings=(self.state_shardings, batch_sharding(self.mesh)),
         )
+
+        def call(state, batch):
+            with jax.set_mesh(self.mesh):
+                return jitted(state, batch)
+
+        return call
 
     def evaluate(self, eval_iter: Iterator[Any], batches: int = 1) -> Dict:
         """Run eval_fn over ``batches`` batches; returns averaged metrics.

@@ -202,7 +202,11 @@ def param_specs(cfg: TransformerConfig) -> Params:
             "w_down": P(None, "tp", "fsdp"),
         })
     specs: Params = {
-        "embed": P("tp", "fsdp"),
+        # d_model-sharded, vocab unsharded: same bytes per device as a
+        # vocab split, but the token gather then partitions cleanly (batch-
+        # sharded indices, slice dim sharded) — a vocab-sharded table forces
+        # SPMD into replicate-then-repartition on every lookup.
+        "embed": P(None, ("fsdp", "tp")),
         "layers": layers,
         "final_norm": P(None),
     }
@@ -212,6 +216,20 @@ def param_specs(cfg: TransformerConfig) -> Params:
 
 
 # -- forward -----------------------------------------------------------------
+
+def _mesh_axis_size(*names: str) -> int:
+    """Product of the active abstract mesh's sizes for ``names`` (1 off-mesh).
+    Lets trace-time code pick shard-aligned shapes/algorithms; under plain
+    single-device jit every axis reports size 1."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or not mesh.shape_tuple:
+        return 1
+    sizes = dict(mesh.shape_tuple)
+    out = 1
+    for nm in names:
+        out *= sizes.get(nm, 1)
+    return out
+
 
 def _constrain(x: jax.Array, spec: P) -> jax.Array:
     """Sharding hint that degrades to a no-op when no mesh is active (plain
@@ -278,10 +296,17 @@ def _moe_ffn(
     E = cfg.moe_experts
     n = b * s
     # Largest divisor of n not exceeding the configured group size (same
-    # trick as the chunked LM loss: the memory bound must hold for any n).
-    group = max(
-        (g for g in range(1, min(cfg.moe_group_size, n) + 1) if n % g == 0)
-    )
+    # trick as the chunked LM loss: the memory bound must hold for any n) —
+    # preferring group counts divisible by the mesh's data shards: the
+    # router/dispatch tensors are constrained on the group axis, and a group
+    # count smaller than the shard count forces SPMD into
+    # replicate-then-repartition (involuntary full remat) on every one.
+    shards = _mesh_axis_size(*BATCH_AXES)
+    divisors = [
+        g for g in range(1, min(cfg.moe_group_size, n) + 1) if n % g == 0
+    ]
+    aligned = [g for g in divisors if (n // g) % shards == 0]
+    group = max(aligned or divisors)
     G = n // group
     x = h.reshape(G, group, d)
     x = _constrain(x, P(BATCH_AXES, None, None))
@@ -429,6 +454,17 @@ def forward(
 
 # -- loss / glue for TrainLoop ------------------------------------------------
 
+def _select_target_logp(logp: jax.Array, targets: jax.Array) -> jax.Array:
+    """logp[..., targets] along the last (vocab) axis. Uses a one-hot masked
+    reduce instead of take_along_axis when the vocab axis is tp-sharded —
+    the gather would force an involuntary full rematerialization; the
+    reduce partitions as a local sum + psum over tp."""
+    if _mesh_axis_size("tp") > 1:
+        onehot = jax.nn.one_hot(targets, logp.shape[-1], dtype=logp.dtype)
+        return (logp * onehot).sum(-1)
+    return jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+
+
 def _chunked_nll_and_argmax(
     cfg: TransformerConfig, hidden: jax.Array, head: jax.Array,
     targets: jax.Array, chunk: int,
@@ -449,7 +485,7 @@ def _chunked_nll_and_argmax(
             "bsd,dv->bsv", hc, head, preferred_element_type=jnp.float32
         )
         logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, tc[..., None], -1)[..., 0]
+        nll = -_select_target_logp(logp, tc)
         return None, (nll, logits.argmax(-1))
 
     _, (nll, am) = lax.scan(body, None, (h, t))
@@ -490,7 +526,7 @@ def next_token_loss(
         )
         logits = _constrain(logits, P(BATCH_AXES, None, "tp"))
         logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+        nll = -_select_target_logp(logp, targets)
         am = logits.argmax(-1)
     mask = batch.get("mask")
     hits = (am == targets).astype(jnp.float32)
