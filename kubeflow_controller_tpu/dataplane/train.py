@@ -75,6 +75,43 @@ def _producer_stream(make_items, size: int) -> Iterator[Any]:
         abandoned.set()
 
 
+def host_to_global(tree: Any, sharding: Any) -> Any:
+    """Host (numpy) leaves -> global jax.Arrays laid out per ``sharding``
+    (a single sharding broadcast over leaves, or a matching tree).
+
+    Required under multi-process jax.distributed, where jit refuses numpy
+    inputs against non-trivial shardings. The host data must be the GLOBAL
+    batch, identical on every process — the contract all the data streams
+    here keep by seeding identically (the TPU-native analog of the
+    reference's per-worker input pipelines: instead of each worker reading
+    a distinct shard, every process materialises the global batch and XLA
+    reads only the local slice via the callback).
+
+    Single-process, this is a plain ``device_put`` (jit's fast path would
+    accept the numpy leaves anyway); the per-call dispatch lives here so
+    call sites stay unconditional."""
+    import numpy as np
+
+    if isinstance(sharding, jax.sharding.Sharding):
+        sharding = jax.tree.map(lambda _: sharding, tree)
+    if jax.process_count() == 1:
+        return jax.tree.map(
+            lambda x, s: x if isinstance(x, jax.Array)
+            else jax.device_put(x, s),
+            tree, sharding,
+        )
+
+    def conv(x, s):
+        if isinstance(x, jax.Array):
+            return x
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, s, lambda idx: arr[idx]
+        )
+
+    return jax.tree.map(conv, tree, sharding)
+
+
 def prefetch(data_iter: Iterator[Any], size: int = 2) -> Iterator[Any]:
     """Producer-thread prefetch: overlaps host-side batch generation/IO with
     device compute. The TPU-native replacement for the reference's synchronous
@@ -114,7 +151,7 @@ def device_prefetch(
             if not batches:
                 return
             stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
-            yield len(batches), jax.device_put(stacked, chunk_sh)
+            yield len(batches), host_to_global(stacked, chunk_sh)
             if len(batches) < chunk:
                 return
 
@@ -198,7 +235,12 @@ class TrainLoop:
         self._ckpt_mgr = None
 
         rng = jax.random.key(seed)
-        with jax.default_device(jax.devices()[0]):
+        # local_devices, not devices: under multi-process jax.distributed the
+        # first global device belongs to process 0, and dispatching the init
+        # computation to a non-addressable device crashes. Every process
+        # inits the same values locally (same seed), then places them onto
+        # the global mesh.
+        with jax.default_device(jax.local_devices()[0]):
             init_out = init_fn(rng)
         params, model_state = init_out if stateful else (init_out, {})
         self.param_shardings = (
@@ -318,8 +360,11 @@ class TrainLoop:
         if self._eval_step is None:
             raise ValueError("TrainLoop built without eval_fn")
         acc: Dict[str, Any] = {}
+        batch_sh = batch_sharding(self.mesh)
         for _ in range(batches):
-            out = self._eval_step(self.state, next(eval_iter))
+            out = self._eval_step(
+                self.state, host_to_global(next(eval_iter), batch_sh)
+            )
             for k, v in out.items():
                 acc[k] = v if k not in acc else acc[k] + v
         return {k: float(v) / batches for k, v in acc.items()}
@@ -395,6 +440,7 @@ class TrainLoop:
         # chip; the reference instead blocked every step on a gRPC sess.run,
         # mnist_replica.py:251-264).
         profiling = False
+        batch_sh = batch_sharding(self.mesh)
         for py_step in range(start_step, cfg.total_steps):
             if cfg.profile_dir and py_step == cfg.profile_start:
                 jax.profiler.start_trace(cfg.profile_dir)
@@ -410,7 +456,9 @@ class TrainLoop:
                     f"global batch {lead} not divisible by the mesh's "
                     f"dp*fsdp={n_data} data shards; adjust batch size"
                 )
-            self.state, metrics = self._step_fn(self.state, batch, rng)
+            self.state, metrics = self._step_fn(
+                self.state, host_to_global(batch, batch_sh), rng
+            )
             step = py_step + 1
             if cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
                 self.save(wait=True)
