@@ -8,6 +8,8 @@ drives a job to completion in-process for demos/CI with no daemon.
 
 Commands:
     serve               run controller + fake cluster + HTTP API
+    serve --cluster-url reconcile a remote apiserver (the -master analog)
+    apiserver           run the REST apiserver facade (pairs with the above)
     submit -f job.yml   create a TPUJob
     list / get / describe / delete / logs
     events              cluster events (k8s Events analog)
@@ -166,16 +168,26 @@ def _make_handler(rt: LocalRuntime):
     return Handler
 
 
+def _add_pools(slice_pool, pools) -> None:
+    """Register slice capacity from repeated --pool specs like "v5e-16x2"
+    (accelerator type, optional xCOUNT suffix)."""
+    for pool in pools or []:
+        accel, _, count = pool.rpartition("x")
+        if not accel or not count.isdigit():
+            accel, count = pool, "1"
+        slice_pool.add_pool(accel, int(count))
+
+
 def cmd_serve(args) -> int:
+    if args.cluster_url:
+        return _serve_remote(args)
     rt = LocalRuntime(
         default_policy=PodRunPolicy(
             start_delay=args.pod_start_delay, run_duration=args.pod_run_duration
         ),
         resync_period=30.0,
     )
-    for pool in args.pool or []:
-        accel, _, count = pool.partition("x")
-        rt.cluster.slice_pool.add_pool(accel, int(count or 1))
+    _add_pools(rt.cluster.slice_pool, args.pool)
     rt.start_threads(workers=args.workers)
     server = ThreadingHTTPServer(("127.0.0.1", args.port), _make_handler(rt))
     # First SIGINT/SIGTERM drains gracefully; second hard-exits
@@ -196,6 +208,54 @@ def cmd_serve(args) -> int:
     finally:
         rt.stop()
     print("tpujobctl serve: stopped")
+    return 0
+
+
+def _serve_remote(args) -> int:
+    """Controller-only mode against an apiserver URL — the reference's
+    ``-master``/``-kubeconfig`` topology (``cmd/controller/main.go:31-52``):
+    no in-process cluster, no submit API; jobs are created against the
+    apiserver (``tpujobctl apiserver`` or a real one)."""
+    from kubeflow_controller_tpu.runtime import RemoteRuntime
+    from kubeflow_controller_tpu.util.signals import setup_signal_handler
+
+    rt = RemoteRuntime(
+        args.cluster_url, namespace=args.namespace, token=args.token or ""
+    )
+    stop = setup_signal_handler()
+    rt.start(workers=args.workers)
+    print(f"tpujobctl serve: reconciling {args.namespace!r} via "
+          f"{args.cluster_url} ({args.workers} workers)", flush=True)
+    stop.wait()
+    rt.stop()
+    print("tpujobctl serve: stopped")
+    return 0
+
+
+def cmd_apiserver(args) -> int:
+    """Run the apiserver facade over a FakeCluster (with a wall-clock
+    ticker driving pod lifecycle) — the process a remote `serve
+    --cluster-url` controller reconciles against."""
+    from kubeflow_controller_tpu.cluster.cluster import FakeCluster
+    from kubeflow_controller_tpu.cluster.rest_server import RestServer
+    from kubeflow_controller_tpu.util.signals import setup_signal_handler
+
+    cluster = FakeCluster(default_policy=PodRunPolicy(
+        start_delay=args.pod_start_delay, run_duration=args.pod_run_duration
+    ))
+    _add_pools(cluster.slice_pool, args.pool)
+    server = RestServer(cluster, port=args.listen).start()
+    stop = setup_signal_handler()
+
+    def ticker() -> None:
+        while not stop.wait(0.05):
+            cluster.tick(0.05)
+
+    threading.Thread(target=ticker, daemon=True, name="ticker").start()
+    print(f"tpujobctl apiserver: listening on {server.url}", flush=True)
+    stop.wait()
+    server.stop()
+    print("tpujobctl apiserver: stopped")
     return 0
 
 
@@ -357,9 +417,7 @@ def cmd_run(args) -> int:
             start_delay=args.pod_start_delay, run_duration=args.pod_run_duration
         )
     )
-    for pool in args.pool or []:
-        accel, _, count = pool.partition("x")
-        rt.cluster.slice_pool.add_pool(accel, int(count or 1))
+    _add_pools(rt.cluster.slice_pool, args.pool)
     rt.submit(job)
     ns, name = job.metadata.namespace, job.metadata.name
     last_phase = None
@@ -411,7 +469,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="slice pool to register, e.g. v5e-16x2 (repeatable)")
     s.add_argument("--pod-start-delay", type=float, default=1.0)
     s.add_argument("--pod-run-duration", type=float, default=10.0)
+    s.add_argument("--cluster-url",
+                   help="reconcile against this apiserver URL instead of an "
+                        "in-process cluster (the -master/-kubeconfig analog)")
+    s.add_argument("--namespace", default="default",
+                   help="namespace to reconcile (with --cluster-url)")
+    s.add_argument("--token", help="bearer token (with --cluster-url)")
     s.set_defaults(fn=cmd_serve)
+
+    s = add_parser("apiserver", help="run the REST apiserver facade "
+                                     "(pair with serve --cluster-url)")
+    s.add_argument("--listen", type=int, default=8378,
+                   help="apiserver port (--port is the client-API flag)")
+    s.add_argument("--pool", action="append",
+                   help="slice pool to register, e.g. v5e-16x2 (repeatable)")
+    s.add_argument("--pod-start-delay", type=float, default=1.0)
+    s.add_argument("--pod-run-duration", type=float, default=10.0)
+    s.set_defaults(fn=cmd_apiserver)
 
     s = add_parser("submit", help="submit a TPUJob manifest")
     s.add_argument("-f", "--filename", required=True)

@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
@@ -38,21 +39,59 @@ EVENT_PATH = "/framework/v1/events"
 SLICES_RE = re.compile(r"^/framework/v1/slices/([^/]+)$")
 
 
-def _parse_selector(query: str) -> Optional[Dict[str, str]]:
+def _parse_query(query: str) -> Dict[str, str]:
+    import urllib.parse
+
+    out: Dict[str, str] = {}
     for part in (query or "").split("&"):
-        if part.startswith("labelSelector="):
-            sel = {}
-            import urllib.parse
-
-            for kv in urllib.parse.unquote(part[len("labelSelector="):]).split(","):
-                if "=" in kv:
-                    k, _, v = kv.partition("=")
-                    sel[k] = v
-            return sel or None
-    return None
+        if "=" in part:
+            k, _, v = part.partition("=")
+            out[k] = urllib.parse.unquote(v)
+    return out
 
 
-def make_rest_handler(cluster: FakeCluster):
+def _parse_selector(query: str) -> Optional[Dict[str, str]]:
+    raw = _parse_query(query).get("labelSelector")
+    if not raw:
+        return None
+    sel = {}
+    for kv in raw.split(","):
+        if "=" in kv:
+            k, _, v = kv.partition("=")
+            sel[k] = v
+    return sel or None
+
+
+class _WatchRegistry:
+    """Active watch queues, so server shutdown can wake and close them."""
+
+    CLOSE = object()
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._queues: set = set()
+        self.closing = False
+
+    def register(self, q) -> bool:
+        with self._lock:
+            if self.closing:
+                return False
+            self._queues.add(q)
+            return True
+
+    def deregister(self, q) -> None:
+        with self._lock:
+            self._queues.discard(q)
+
+    def close_all(self) -> None:
+        with self._lock:
+            self.closing = True
+            queues = list(self._queues)
+        for q in queues:
+            q.put(self.CLOSE)
+
+
+def make_rest_handler(cluster: FakeCluster, watches: _WatchRegistry):
     stores = {
         "pods": (cluster.pods, pod_to_dict, pod_from_dict),
         "services": (cluster.services, service_to_dict, service_from_dict),
@@ -117,6 +156,9 @@ def make_rest_handler(cluster: FakeCluster):
                 store, to_dict, from_dict = stores[kind]
                 if method == "GET" and name is None:
                     sel = _parse_selector(query)
+                    q = _parse_query(query)
+                    if q.get("watch") in ("true", "1"):
+                        return self._watch(store, to_dict, ns, sel, q)
                     return self._send(200, {
                         "items": [to_dict(o) for o in store.list(ns, sel)]
                     })
@@ -141,6 +183,96 @@ def make_rest_handler(cluster: FakeCluster):
             except Exception as e:
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
+        def _watch(self, store, to_dict, ns, sel, q) -> None:
+            """``?watch=true``: stream newline-delimited JSON watch events.
+
+            The k8s chunked-watch analog (the verb the reference's informers
+            are built on, ``vendor/.../informers/.../tfjob.go:56``). Always
+            list+watch in one stream: replay current objects as ADDED, send a
+            SYNC marker, then follow live mutations. BOOKMARK heartbeats keep
+            the client's read timeout from firing on idle streams;
+            ``timeoutSeconds`` closes the stream server-side (the client
+            re-watches — standard watch-expiry behavior).
+            """
+            import queue
+
+            from kubeflow_controller_tpu.cluster.events import EventType
+
+            timeout_s = float(q.get("timeoutSeconds") or 0)
+            heartbeat_s = float(q.get("heartbeatSeconds") or 5)
+            deadline = (time.monotonic() + timeout_s) if timeout_s else None
+            events: "queue.Queue" = queue.Queue()
+            if not watches.register(events):
+                return self._send(503, {"error": "server shutting down"})
+            store.subscribe(events.put, replay=True)  # replay lands in queue
+            events.put(None)  # SYNC marker: replay complete
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                while True:
+                    budget = heartbeat_s
+                    if deadline is not None:
+                        budget = min(budget, deadline - time.monotonic())
+                        if budget <= 0:
+                            return
+                    try:
+                        ev = events.get(timeout=budget)
+                    except queue.Empty:
+                        if deadline is not None and time.monotonic() >= deadline:
+                            return
+                        self._stream_line({"type": "BOOKMARK"})
+                        continue
+                    if ev is _WatchRegistry.CLOSE:
+                        return  # server stopping: drop the stream
+                    if ev is None:
+                        self._stream_line({"type": "SYNC"})
+                        continue
+                    obj = ev.obj
+                    if ns is not None and obj.metadata.namespace != ns:
+                        continue
+                    etype = ev.type
+                    if sel:
+                        # k8s selector-scoped watch semantics: events are
+                        # rewritten by the (old-matched, new-matched)
+                        # transition so watchers only ever see objects in
+                        # their scope — entering scope is ADDED, leaving
+                        # is DELETED, never-in-scope is invisible.
+                        def _m(o):
+                            return o is not None and all(
+                                o.metadata.labels.get(k) == v
+                                for k, v in sel.items()
+                            )
+
+                        now_in = _m(obj) and etype != EventType.DELETED
+                        was_in = (
+                            _m(ev.old_obj)
+                            if etype == EventType.MODIFIED
+                            else (_m(obj) if etype == EventType.DELETED
+                                  else False)
+                        )
+                        if now_in and was_in:
+                            etype = EventType.MODIFIED
+                        elif now_in:
+                            etype = EventType.ADDED
+                        elif was_in:
+                            etype = EventType.DELETED
+                        else:
+                            continue
+                    self._stream_line({
+                        "type": etype.value, "object": to_dict(obj),
+                    })
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client went away
+            finally:
+                store.unsubscribe(events.put)
+                watches.deregister(events)
+
+        def _stream_line(self, payload: Dict) -> None:
+            self.wfile.write((json.dumps(payload) + "\n").encode())
+            self.wfile.flush()
+
         def do_GET(self):
             self._handle("GET")
 
@@ -160,8 +292,9 @@ class RestServer:
     """In-process apiserver facade; bind port 0 for an ephemeral port."""
 
     def __init__(self, cluster: FakeCluster, port: int = 0):
+        self._watches = _WatchRegistry()
         self._httpd = ThreadingHTTPServer(
-            ("127.0.0.1", port), make_rest_handler(cluster)
+            ("127.0.0.1", port), make_rest_handler(cluster, self._watches)
         )
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
@@ -177,4 +310,6 @@ class RestServer:
         return self
 
     def stop(self) -> None:
+        self._watches.close_all()   # wake + drop open watch streams
         self._httpd.shutdown()
+        self._httpd.server_close()  # release the port for rebinds

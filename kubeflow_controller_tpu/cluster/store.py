@@ -57,17 +57,28 @@ class ObjectStore:
 
     def subscribe(self, listener: Listener, replay: bool = True) -> None:
         """Register a watch listener. With ``replay``, synthesizes ADDED events
-        for existing objects first (how a fresh informer list+watch behaves)."""
+        for existing objects first (how a fresh informer list+watch behaves).
+
+        Replay + registration are atomic under the store lock (and _emit
+        also runs under it), so a subscriber can never observe a newer
+        event before the stale replay copy — the watch stream is totally
+        ordered. Listeners must therefore be fast and must not call back
+        into a *different* store (same-store reentry is fine: RLock)."""
         with self._lock:
-            events = [
-                WatchEvent(EventType.ADDED, self.kind, obj.deepcopy())
-                for obj in self._objects.values()
-            ] if replay else []
+            if replay:
+                for obj in self._objects.values():
+                    listener(
+                        WatchEvent(EventType.ADDED, self.kind, obj.deepcopy())
+                    )
             self._listeners.append(listener)
-        for ev in events:
-            listener(ev)
+
+    def unsubscribe(self, listener: Listener) -> None:
+        with self._lock:
+            if listener in self._listeners:
+                self._listeners.remove(listener)
 
     def _emit(self, ev: WatchEvent) -> None:
+        # Caller holds self._lock: delivery order == resource-version order.
         for listener in list(self._listeners):
             listener(ev)
 
@@ -98,9 +109,10 @@ class ObjectStore:
                 meta.creation_timestamp = self._now_fn()
             stored = obj.deepcopy()
             self._objects[key] = stored
-            ev = WatchEvent(EventType.ADDED, self.kind, stored.deepcopy())
-        self._emit(ev)
-        return stored.deepcopy()
+            self._emit(
+                WatchEvent(EventType.ADDED, self.kind, stored.deepcopy())
+            )
+            return stored.deepcopy()
 
     def get(self, namespace: str, name: str) -> Any:
         with self._lock:
@@ -135,9 +147,11 @@ class ObjectStore:
             old = cur
             stored = obj.deepcopy()
             self._objects[key] = stored
-            ev = WatchEvent(EventType.MODIFIED, self.kind, stored.deepcopy(), old.deepcopy())
-        self._emit(ev)
-        return stored.deepcopy()
+            self._emit(WatchEvent(
+                EventType.MODIFIED, self.kind,
+                stored.deepcopy(), old.deepcopy(),
+            ))
+            return stored.deepcopy()
 
     def mutate(self, namespace: str, name: str, fn: Callable[[Any], None]) -> Any:
         """Read-modify-write with internal retry — the conflict-safe update
@@ -157,9 +171,8 @@ class ObjectStore:
             if obj is None:
                 raise NotFound(f"{self.kind} {key}")
             self._rv += 1
-            ev = WatchEvent(EventType.DELETED, self.kind, obj.deepcopy())
-        self._emit(ev)
-        return obj
+            self._emit(WatchEvent(EventType.DELETED, self.kind, obj.deepcopy()))
+            return obj
 
     # -- listing -------------------------------------------------------------
 

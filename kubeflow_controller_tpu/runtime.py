@@ -24,6 +24,63 @@ from kubeflow_controller_tpu.controller.controller import Controller, Controller
 from kubeflow_controller_tpu.controller.informer import Informer
 
 
+class RemoteRuntime:
+    """Controller wired to a cluster ONLY over the REST seam.
+
+    The operator topology of the reference's ``main()``
+    (``cmd/controller/main.go:31-52``): a controller process that talks to
+    an apiserver URL — clients built from a server address, watch-driven
+    informers, effects via HTTP. ``cluster_url`` is the ``-master``/
+    ``-kubeconfig`` analog. Namespace-scoped (one controller per
+    namespace), matching how the kubeflow operators are usually deployed.
+    """
+
+    def __init__(
+        self,
+        cluster_url: str,
+        namespace: str = "default",
+        token: str = "",
+        resync_period: float = 30.0,
+        watch_timeout_seconds: float = 0,
+    ):
+        from kubeflow_controller_tpu.cluster.rest_client import (
+            RestClusterClient, RestWatchSource,
+        )
+
+        self.namespace = namespace
+        self.client = RestClusterClient(cluster_url, token=token)
+        self._sources = [
+            RestWatchSource(self.client, kind, namespace,
+                            timeout_seconds=watch_timeout_seconds)
+            for kind in ("TPUJob", "Pod", "Service")
+        ]
+        job_src, pod_src, svc_src = self._sources
+        self.job_informer = Informer(job_src, resync_period)
+        self.pod_informer = Informer(pod_src, resync_period)
+        self.service_informer = Informer(svc_src, resync_period)
+        self.controller = Controller(
+            self.client,
+            self.job_informer,
+            self.pod_informer,
+            self.service_informer,
+            ControllerOptions(resync_period=resync_period),
+        )
+
+    def start(self, workers: int = 2) -> None:
+        """Sync informers over the wire, then run reconcile workers."""
+        self.controller.start()
+        self.controller.run(workers)
+
+    def drain(self) -> int:
+        """Deterministic drive (tests): controller.start() first."""
+        return self.controller.drain()
+
+    def stop(self) -> None:
+        self.controller.stop()
+        for src in self._sources:
+            src.stop()
+
+
 class LocalRuntime:
     def __init__(
         self,

@@ -124,3 +124,162 @@ def test_slices_extension(client, cluster):
     assert len(held) == 1 and held[0]["accelerator"] == "v5p-8"
     assert client.release_slices("uid-1") == 1
     assert client.job_slices("uid-1") == []
+
+
+# -- watch + over-the-wire controller (VERDICT r1 #1/#2) ---------------------
+
+def test_watch_stream_replay_sync_live(client, cluster):
+    import threading
+    import time
+
+    from kubeflow_controller_tpu.cluster.events import EventType
+
+    cluster.pods.create(make_pod("p0"))
+    seen = []
+    done = threading.Event()
+
+    def consume():
+        for ev in client.watch("Pod", "default", timeout_seconds=3,
+                               heartbeat_seconds=0.5):
+            seen.append(ev)
+        done.set()
+
+    threading.Thread(target=consume, daemon=True).start()
+    deadline = time.time() + 5
+    while time.time() < deadline and len(seen) < 2:  # replay + SYNC
+        time.sleep(0.01)
+    client.create_pod(make_pod("p1"))
+    client.delete_pod("default", "p0")
+    assert done.wait(10), "watch did not expire via timeoutSeconds"
+    tagged = [
+        ev if ev is None else (ev.type, ev.obj.metadata.name) for ev in seen
+    ]
+    assert tagged[0] == (EventType.ADDED, "p0")      # replay
+    assert tagged[1] is None                          # SYNC marker
+    assert (EventType.ADDED, "p1") in tagged[2:]      # live create
+    assert (EventType.DELETED, "p0") in tagged[2:]    # live delete
+
+
+def test_informer_over_rest_watch(client, cluster):
+    import time
+
+    from kubeflow_controller_tpu.cluster.rest_client import RestWatchSource
+    from kubeflow_controller_tpu.controller.informer import Informer
+
+    cluster.pods.create(make_pod("p0"))
+    src = RestWatchSource(client, "Pod", "default", heartbeat_seconds=0.5)
+    inf = Informer(src)
+    inf.start()  # blocks until the wire replay synced
+    assert inf.has_synced()
+    assert inf.get("default", "p0") is not None
+    client.create_pod(make_pod("p1"))
+    deadline = time.time() + 5
+    while time.time() < deadline and inf.get("default", "p1") is None:
+        time.sleep(0.01)
+    assert inf.get("default", "p1") is not None
+    client.delete_pod("default", "p0")
+    deadline = time.time() + 5
+    while time.time() < deadline and inf.get("default", "p0") is not None:
+        time.sleep(0.01)
+    assert inf.get("default", "p0") is None
+    src.stop()
+
+
+def test_rewatch_synthesizes_deletes_after_disconnect(cluster):
+    """Objects deleted while no watch is connected surface as DELETED on the
+    next replay (DeltaFIFO Replace semantics) — informer caches must not
+    leak deleted objects across reconnects/server restarts."""
+    import socket
+    import time
+
+    from kubeflow_controller_tpu.cluster.events import EventType
+    from kubeflow_controller_tpu.cluster.rest_client import (
+        RestClusterClient, RestWatchSource,
+    )
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    server = RestServer(cluster, port=port).start()
+    client = RestClusterClient(f"http://127.0.0.1:{port}")
+    cluster.pods.create(make_pod("p0"))
+    cluster.pods.create(make_pod("p1"))
+
+    seen = []
+    src = RestWatchSource(client, "Pod", "default", rewatch_backoff=0.1,
+                          heartbeat_seconds=0.5)
+    src.subscribe(seen.append)
+    assert {ev.obj.metadata.name for ev in seen} == {"p0", "p1"}
+
+    server.stop()  # watch drops; deletion happens while disconnected
+    cluster.pods.delete("default", "p0")
+    server2 = RestServer(cluster, port=port).start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not any(
+            ev.type == EventType.DELETED and ev.obj.metadata.name == "p0"
+            for ev in seen
+        ):
+            time.sleep(0.05)
+        assert any(
+            ev.type == EventType.DELETED and ev.obj.metadata.name == "p0"
+            for ev in seen
+        ), [(ev.type, ev.obj.metadata.name) for ev in seen]
+    finally:
+        src.stop()
+        server2.stop()
+
+
+def test_controller_over_the_wire_local_job(cluster):
+    """Full local-job lifecycle with the controller connected ONLY via REST
+    (client effects + watch-driven informers) — the reference's operator
+    topology (controller process <-> apiserver, cmd/controller/main.go)."""
+    import time
+
+    from kubeflow_controller_tpu.api import (
+        Container as C, JobPhase, ObjectMeta as OM, PodSpec as PS,
+        PodTemplateSpec, ReplicaSpec, ReplicaType, TPUJob, TPUJobSpec,
+    )
+    from kubeflow_controller_tpu.api.validation import validate_job
+    from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
+    from kubeflow_controller_tpu.runtime import RemoteRuntime
+
+    cluster.default_policy = PodRunPolicy(start_delay=0.1, run_duration=0.3)
+    server = RestServer(cluster).start()
+    rt = RemoteRuntime(server.url, resync_period=1.0)
+    try:
+        rt.start(workers=2)
+        job = TPUJob(
+            metadata=OM(name="loc", namespace="default"),
+            spec=TPUJobSpec(replica_specs=[ReplicaSpec(
+                replica_type=ReplicaType.LOCAL,
+                template=PodTemplateSpec(spec=PS(containers=[
+                    C(name="trainer", image="jax:latest")
+                ])),
+            )]),
+        )
+        validate_job(job)
+        rt.client.create_job(job)
+        phases = set()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            cluster.tick(0.05)
+            j = rt.client.get_job("default", "loc")
+            if j:
+                phases.add(j.status.phase)
+                if j.status.phase == JobPhase.SUCCEEDED:
+                    break
+            time.sleep(0.02)
+        j = rt.client.get_job("default", "loc")
+        assert j is not None and j.status.phase == JobPhase.SUCCEEDED, (
+            j and j.status)
+        assert JobPhase.RUNNING in phases
+        # the controller's only path to the cluster was HTTP: the pod it
+        # created exists server-side and reached Succeeded
+        pods = cluster.pods.list("default")
+        assert len(pods) == 1
+        assert pods[0].status.phase.value == "Succeeded"
+    finally:
+        rt.stop()
+        server.stop()
