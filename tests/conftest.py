@@ -22,4 +22,11 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# Prefer an installed package (`pip install -e .` — see pyproject.toml);
+# fall back to the checkout root so the suite also runs uninstalled.
+try:
+    import kubeflow_controller_tpu  # noqa: F401
+except ImportError:
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
