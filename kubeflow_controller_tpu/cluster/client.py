@@ -50,11 +50,16 @@ class FakeClusterClient:
     # -- pods ---------------------------------------------------------------
 
     def create_pod(self, pod: Pod) -> Pod:
-        if self.cluster.faults.fail_pod_creates > 0:
+        if (
+            self.cluster.faults.fail_pod_creates > 0
+            and self.cluster.faults.fail_pod_creates_after <= 0
+        ):
             self.cluster.faults.fail_pod_creates -= 1
             self.record_event("Pod", pod.metadata.name or pod.metadata.generate_name,
                               "FailedCreate", "injected create failure")
             raise PodCreateRefused("injected pod create failure")
+        if self.cluster.faults.fail_pod_creates_after > 0:
+            self.cluster.faults.fail_pod_creates_after -= 1
         created = self.cluster.pods.create(pod)
         self.record_event("Pod", created.metadata.name, "SuccessfulCreate",
                           f"created pod {created.metadata.name}")

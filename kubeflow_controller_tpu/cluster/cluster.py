@@ -62,6 +62,10 @@ class FaultInjector:
 
     # Fail the next N pod-create calls at the client seam.
     fail_pod_creates: int = 0
+    # Let this many creates succeed first (models a crash mid-batch: the
+    # reference's service-created-but-pods-missing window,
+    # distributed.go:131-159).
+    fail_pod_creates_after: int = 0
     # Extra scheduling latency applied to every gang (slow provisioning).
     gang_admission_delay: float = 0.0
     # Pod-name -> policy override (e.g. crash worker 3).

@@ -301,9 +301,15 @@ class RestWatchSource:
         self.timeout_seconds = timeout_seconds
         self.heartbeat_seconds = heartbeat_seconds
         self._stop = False
+        self._dead: set = set()
 
     def stop(self) -> None:
         self._stop = True
+
+    def unsubscribe(self, listener) -> None:
+        """Detach one listener: its pump thread exits at the next event or
+        re-watch, and no further events are delivered to it."""
+        self._dead.add(listener)
 
     def subscribe(self, listener, replay: bool = True) -> None:
         import threading
@@ -316,7 +322,7 @@ class RestWatchSource:
         live: Dict[str, Any] = {}  # key -> last obj, for tombstones
 
         def pump() -> None:
-            while not self._stop:
+            while not (self._stop or listener in self._dead):
                 replayed: Dict[str, Any] = {}
                 in_replay = True
                 try:
@@ -325,7 +331,7 @@ class RestWatchSource:
                         timeout_seconds=self.timeout_seconds,
                         heartbeat_seconds=self.heartbeat_seconds,
                     ):
-                        if self._stop:
+                        if self._stop or listener in self._dead:
                             return
                         if ev is None:  # SYNC: replay complete
                             if in_replay:

@@ -54,6 +54,7 @@ class Informer:
 
     def stop(self) -> None:
         self._stop.set()
+        self._store.unsubscribe(self._on_event)
 
     def has_synced(self) -> bool:
         return self._synced

@@ -89,22 +89,29 @@ class LocalRuntime:
     ):
         self.cluster = FakeCluster(default_policy=default_policy)
         self.client = FakeClusterClient(self.cluster)
-        self.job_informer = Informer(self.cluster.jobs, resync_period)
-        self.pod_informer = Informer(self.cluster.pods, resync_period)
-        self.service_informer = Informer(self.cluster.services, resync_period)
         # Everything (stores, controller, scheduler) runs on the cluster's
         # simulated clock; threaded mode advances it from a wall-clock ticker.
-        now_fn = lambda: self.cluster.now
+        self._opts = ControllerOptions(
+            now_fn=lambda: self.cluster.now, resync_period=resync_period
+        )
+        self._wire()
+        self._ticker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def _wire(self) -> None:
+        """Build informers + controller over the cluster stores and start
+        them (shared by __init__ and restart_controller)."""
+        self.job_informer = Informer(self.cluster.jobs, self._opts.resync_period)
+        self.pod_informer = Informer(self.cluster.pods, self._opts.resync_period)
+        self.service_informer = Informer(self.cluster.services, self._opts.resync_period)
         self.controller = Controller(
             self.client,
             self.job_informer,
             self.pod_informer,
             self.service_informer,
-            ControllerOptions(now_fn=now_fn, resync_period=resync_period),
+            self._opts,
         )
         self.controller.start()
-        self._ticker: Optional[threading.Thread] = None
-        self._stop = threading.Event()
 
     # -- job API -------------------------------------------------------------
 
@@ -156,6 +163,17 @@ class LocalRuntime:
             ),
             dt=dt, max_steps=max_steps,
         )
+
+    def restart_controller(self) -> None:
+        """Simulate a controller-process crash + restart: the new controller
+        has total amnesia (fresh informers, fresh expectations, fresh queue)
+        and must rebuild its world from the store — the level-trigger promise
+        the reference's expectations race comment describes
+        (``pkg/controller/controller.go:259-262``)."""
+        for inf in (self.job_informer, self.pod_informer, self.service_informer):
+            inf.stop()
+        self.controller.queue.shutdown()
+        self._wire()
 
     # -- threaded drive ------------------------------------------------------
 
