@@ -1,34 +1,46 @@
 """Round benchmark — prints ONE JSON line for the driver.
 
-Headline metric: the reference's only quantitative artifact is distributed
-MNIST PS/worker training — 200 global steps in 9.54 s (~21 steps/s) on a
-single-node CPU cluster (``docs/get_started.md:49-63``, defaults at
-``examples/workdir/mnist_replica.py:64-70``). We run the identical workload
-shape (same model capacity, same global batch 100, same 200 steps) through
-the TPU-native data plane — SPMD over whatever devices are visible, XLA
-all-reduce instead of PS push/pull — and report steady-state steps/sec.
+Two workloads run back-to-back on the visible device(s):
 
-``vs_baseline`` is our steps/sec over the reference's ~21 steps/s.
+1. **Flagship decoder MFU** (the headline ``metric``): a 335M-param
+   Llama-style decoder (d_model 1024, 16 layers, 8 heads, head_dim 128 so
+   the Pallas flash kernel is on its fast path), bf16 compute + fp32 Adam,
+   remat, 16x1024 tokens per step on one chip. The reference publishes no
+   model benchmark at all (SURVEY.md §6), so ``vs_baseline`` for this
+   metric is measured against this repo's own round-1 best (34.4 % MFU,
+   ``benchmarks/RESULTS.md``) — the "beat your own baseline" discipline
+   BASELINE.md prescribes.
+2. **Reference-parity MNIST** (reported in the same JSON object): the
+   reference's only quantitative artifact is distributed MNIST PS/worker
+   training — 200 global steps in 9.54 s (~21 steps/s) on a single-node CPU
+   cluster (``docs/get_started.md:49-63``, defaults at
+   ``examples/workdir/mnist_replica.py:64-70``). We run the identical
+   workload shape (same model capacity, same global batch 100, same 200
+   steps) through the TPU-native data plane and report steady-state
+   steps/sec as ``mnist_steps_per_sec`` / ``mnist_vs_reference``.
 """
 
 from __future__ import annotations
 
+import functools
 import json
 import time
 
 REFERENCE_STEPS_PER_SEC = 200 / 9.536664  # docs/get_started.md:49-63
+ROUND1_BEST_MFU = 0.344                   # benchmarks/RESULTS.md (r1)
 
 
-def main() -> None:
-    import jax
+def bench_mnist() -> float:
+    """Reference-parity distributed MNIST; returns steady-state steps/s."""
     import optax
 
     from kubeflow_controller_tpu.dataplane.train import (
         TrainLoop, TrainLoopConfig, device_prefetch,
     )
-    from kubeflow_controller_tpu.parallel.mesh import data_shards, batch_sharding
     from kubeflow_controller_tpu.models import mnist
-    from kubeflow_controller_tpu.parallel.mesh import MeshConfig, make_mesh
+    from kubeflow_controller_tpu.parallel.mesh import (
+        MeshConfig, batch_sharding, data_shards, make_mesh,
+    )
 
     total_steps = 200   # mnist_replica.py:68-70
     batch_size = 100    # mnist_replica.py:64
@@ -38,19 +50,25 @@ def main() -> None:
         batch_size = ((batch_size + n_data - 1) // n_data) * n_data
 
     model = mnist.MnistMLP()
+    # 25 steps per dispatch (lax.scan over a device-resident chunk): a ~1 ms
+    # MNIST step is dispatch-latency-bound over the tunneled chip, so the
+    # per-step round-trip — not the TPU — would set the score otherwise.
     loop = TrainLoop(
         mesh=mesh,
         init_fn=mnist.make_init_fn(model),
         loss_fn=mnist.make_loss_fn(model),
         optimizer=optax.adam(0.01),
-        config=TrainLoopConfig(total_steps=total_steps, log_every=10 ** 9),
+        config=TrainLoopConfig(
+            total_steps=total_steps, log_every=10 ** 9, steps_per_call=25,
+        ),
     )
     bs = batch_sharding(mesh)
     data = device_prefetch(
-        mnist.synthetic_mnist(batch_size),
+        mnist.synthetic_mnist(batch_size, uint8=True),
         {"image": bs, "label": bs},
         chunk=25,
         size=3,
+        yield_chunks=True,
     )
 
     # Warm up: compile + enough steps to fill the async dispatch pipeline
@@ -77,13 +95,82 @@ def main() -> None:
         rates.append(total_steps / (time.perf_counter() - t0))
         if reached != end:
             raise RuntimeError(f"expected step {end}, got {reached}")
+    return sorted(rates)[1]
 
-    sps = sorted(rates)[1]
+
+def bench_flagship(steps: int = 20, warmup: int = 6) -> dict:
+    """Flagship decoder train step; returns {mfu, tokens_per_sec, ...}."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kubeflow_controller_tpu.models import transformer as tfm
+
+    seq, batch = 1024, 16
+    cfg = tfm.TransformerConfig(
+        vocab_size=32768, d_model=1024, n_layers=16, n_heads=8,
+        n_kv_heads=8, d_ff=4096, max_seq=seq, attn_impl="flash", remat=True,
+    )
+    params = tfm.init_params(cfg, jax.random.key(0))
+    tx = optax.adamw(1e-4, b1=0.9, b2=0.95)
+    opt = tx.init(params)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq + 1)),
+        jnp.int32,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt, tokens):
+        (loss, _), g = jax.value_and_grad(
+            lambda p: tfm.next_token_loss(cfg, p, {"tokens": tokens}),
+            has_aux=True,
+        )(params)
+        u, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, u), opt, loss
+
+    # Donated state chains the steps; fetching the last loss VALUE is the
+    # completion barrier (see bench_mnist note on remote-tunnel platforms).
+    for _ in range(warmup):
+        params, opt, loss = step(params, opt, tokens)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, tokens)
+    float(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_step = batch * seq
+    flops = tfm.train_flops_per_token(cfg, seq) * tokens_per_step
+    # The flagship step is compiled unsharded, so it runs on exactly ONE
+    # chip no matter how many are visible — the MFU denominator is one
+    # chip's peak (bench_mnist, by contrast, meshes over all devices).
+    return {
+        "mfu": flops / dt / (tfm.PEAK_TFLOPS_BF16_V5E * 1e12),
+        "tokens_per_sec": tokens_per_step / dt,
+        "step_ms": dt * 1000,
+        "params": tfm.count_params(params),
+    }
+
+
+def main() -> None:
+    # MNIST first: its chunked input pipeline is sensitive to the device
+    # memory/tunnel state the flagship leaves behind (measured 322 steps/s
+    # fresh vs ~170 after the flagship run); the flagship is compute-bound
+    # and order-insensitive.
+    mnist_sps = bench_mnist()
+    flagship = bench_flagship()
+    mfu_pct = flagship["mfu"] * 100
     print(json.dumps({
-        "metric": "mnist_dist_train_steps_per_sec",
-        "value": round(sps, 2),
-        "unit": "steps/s",
-        "vs_baseline": round(sps / REFERENCE_STEPS_PER_SEC, 2),
+        "metric": "flagship_decoder_mfu",
+        "value": round(mfu_pct, 1),
+        "unit": "% MFU (335M decoder, 1 chip, bf16+flash)",
+        "vs_baseline": round(flagship["mfu"] / ROUND1_BEST_MFU, 2),
+        "flagship_tokens_per_sec": round(flagship["tokens_per_sec"]),
+        "flagship_step_ms": round(flagship["step_ms"], 1),
+        "mnist_steps_per_sec": round(mnist_sps, 2),
+        "mnist_vs_reference": round(mnist_sps / REFERENCE_STEPS_PER_SEC, 2),
     }))
 
 

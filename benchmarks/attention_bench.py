@@ -1,0 +1,99 @@
+"""Standalone attention microbench: flash (Pallas) vs XLA, fwd and fwd+bwd.
+
+Isolates the attention op from the full train step so kernel changes (block
+sizes, residual layout) can be measured directly on the real chip.
+
+Usage: python benchmarks/attention_bench.py [--batch 16 --seq 1024 --heads 8
+       --head-dim 128 --block-q 512 --block-k 1024]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_controller_tpu.ops.attention import mha_xla
+from kubeflow_controller_tpu.ops.flash_attention import flash_mha
+
+
+def bench(fn, *args, steps=20, warmup=5):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.tree.map(lambda x: x.block_until_ready(), out)
+    # value-fetch completion barrier (tunnel-safe): sum a scalar
+    float(jax.tree.leaves(out)[0].sum())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    float(jax.tree.leaves(out)[0].sum())
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--block-q", type=int, default=512)
+    p.add_argument("--block-k", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=20)
+    args = p.parse_args()
+
+    b, s, h, kvh, d = (
+        args.batch, args.seq, args.heads, args.kv_heads, args.head_dim
+    )
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, s, kvh, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, kvh, d), jnp.bfloat16)
+
+    flash = jax.jit(functools.partial(
+        flash_mha, block_q=args.block_q, block_k=args.block_k
+    ))
+    xla = jax.jit(mha_xla)
+
+    def loss_flash(q, k, v):
+        return flash_mha(
+            q, k, v, block_q=args.block_q, block_k=args.block_k
+        ).astype(jnp.float32).sum()
+
+    def loss_xla(q, k, v):
+        return mha_xla(q, k, v).astype(jnp.float32).sum()
+
+    grad_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+    grad_xla = jax.jit(jax.grad(loss_xla, argnums=(0, 1, 2)))
+
+    # causal attention flops: fwd 4*b*h*s^2*d/2 (qk + pv, halved by mask)
+    fwd_flops = 4 * b * h * s * s * d / 2
+    bwd_flops = 2.5 * fwd_flops  # recompute s/p + 3 grad matmuls
+
+    out = {"shape": f"B{b} S{s} H{h}/{kvh} D{d}",
+           "block_q": args.block_q, "block_k": args.block_k}
+    t = bench(flash, q, k, v, steps=args.steps)
+    out["flash_fwd_ms"] = round(t * 1e3, 3)
+    out["flash_fwd_tflops"] = round(fwd_flops / t / 1e12, 1)
+    t = bench(xla, q, k, v, steps=args.steps)
+    out["xla_fwd_ms"] = round(t * 1e3, 3)
+    out["xla_fwd_tflops"] = round(fwd_flops / t / 1e12, 1)
+    t = bench(grad_flash, q, k, v, steps=args.steps)
+    out["flash_fwdbwd_ms"] = round(t * 1e3, 3)
+    t = bench(grad_xla, q, k, v, steps=args.steps)
+    out["xla_fwdbwd_ms"] = round(t * 1e3, 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

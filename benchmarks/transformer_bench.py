@@ -27,23 +27,10 @@ import numpy as np
 import optax
 
 from kubeflow_controller_tpu.models import transformer as tfm
-
-# bf16 peak of one v5e chip; override with --peak-tflops for other parts.
-DEFAULT_PEAK_TFLOPS = 197.0
-
-
-def train_flops_per_token(cfg: tfm.TransformerConfig, seq: int) -> float:
-    """6*N matmul flops per token (fwd+bwd) + causal attention term."""
-    n_params = (
-        cfg.vocab_size * cfg.d_model * (1 if cfg.tie_embeddings else 2)
-        + cfg.n_layers * (
-            cfg.d_model * cfg.n_heads * cfg.head_dim * 2
-            + cfg.d_model * cfg.n_kv_heads * cfg.head_dim * 2
-            + 3 * cfg.d_model * cfg.d_ff
-        )
-    )
-    attn = 12 * cfg.n_layers * cfg.d_model * (seq / 2)  # causal halves it
-    return 6 * n_params + attn
+from kubeflow_controller_tpu.models.transformer import (
+    PEAK_TFLOPS_BF16_V5E as DEFAULT_PEAK_TFLOPS,
+    train_flops_per_token,
+)
 
 
 def main() -> None:

@@ -314,6 +314,8 @@ class RestWatchSource:
     def subscribe(self, listener, replay: bool = True) -> None:
         import threading
 
+        self._dead.discard(listener)  # re-subscribing revives a listener
+
         from kubeflow_controller_tpu.cluster.events import (
             EventType, WatchEvent,
         )

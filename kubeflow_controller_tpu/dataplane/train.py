@@ -125,6 +125,7 @@ def device_prefetch(
     batch_sharding_tree: Any,
     chunk: int = 16,
     size: int = 2,
+    yield_chunks: bool = False,
 ) -> Iterator[Any]:
     """Chunked host->device prefetch: stack up to ``chunk`` batches, ship them
     in ONE async transfer, then yield device-resident slices. Amortises
@@ -132,7 +133,11 @@ def device_prefetch(
     the input-pipeline design the TPU data path wants (and the polar opposite
     of the reference's per-step ``feed_dict`` marshalling,
     ``mnist_replica.py:255-258``). A final partial chunk of a finite stream is
-    shipped and yielded, not dropped."""
+    shipped and yielded, not dropped.
+
+    ``yield_chunks=True`` yields the whole device-resident ``[chunk, ...]``
+    stack instead of per-step slices — the input side of
+    ``TrainLoopConfig.steps_per_call`` (scan-dispatched multi-step)."""
     import numpy as np
 
     chunk_sh = jax.tree.map(
@@ -156,8 +161,11 @@ def device_prefetch(
                 return
 
     for n, item in _producer_stream(chunks, size):
-        for i in range(n):
-            yield jax.tree.map(lambda x: x[i], item)
+        if yield_chunks:
+            yield item
+        else:
+            for i in range(n):
+                yield jax.tree.map(lambda x: x[i], item)
 
 
 class TrainState(struct.PyTreeNode):
@@ -176,6 +184,13 @@ class TrainLoopConfig:
     checkpoint_every: int = 0      # 0 = only final
     keep_checkpoints: int = 3
     donate_state: bool = True
+    # > 1: dispatch this many steps per jit call as ONE lax.scan over a
+    # device-resident [K, ...] batch chunk (pair with
+    # ``device_prefetch(..., yield_chunks=True)``). Makes small-step
+    # workloads immune to per-dispatch host latency — on a tunneled chip a
+    # ~1 ms MNIST step is otherwise dominated by the round-trip.
+    # Checkpoint/eval/log cadences then land on K-step boundaries.
+    steps_per_call: int = 1
     # Periodic validation (parity with the reference's post-train validation
     # cross-entropy report, mnist_replica.py:266-269, made continuous):
     # every eval_every steps, run eval_fn over eval_batches batches from the
@@ -320,12 +335,30 @@ class TrainLoop:
             metrics = {"loss": loss, **metrics}
             return new_state, metrics
 
+        batch_sh = batch_sharding(self.mesh)
+        if cfg.steps_per_call > 1:
+            # Multi-step dispatch: ONE jit call scans `step` over a
+            # device-resident [K, ...] batch chunk. Per-step metrics come
+            # back stacked [K]; log sites average them.
+            def multi(state: TrainState, chunk: Any, rng: jax.Array):
+                return jax.lax.scan(
+                    lambda st, b: step(st, b, rng), state, chunk
+                )
+
+            fn, data_sh = multi, jax.tree.map(
+                lambda s: NamedSharding(s.mesh, P(None, *s.spec)),
+                batch_sh,
+            )
+        else:
+            fn, data_sh = step, batch_sh
+
         jitted = jax.jit(
-            step,
-            in_shardings=(self.state_shardings, batch_sharding(self.mesh), None),
+            fn,
+            in_shardings=(self.state_shardings, data_sh, None),
             out_shardings=(self.state_shardings, None),
             donate_argnums=(0,) if cfg.donate_state else (),
         )
+        self._data_sharding = data_sh
 
         # Trace-time code (MoE group alignment, shard-aware lookups) reads
         # the ambient abstract mesh; jit alone never establishes one, so the
@@ -440,31 +473,52 @@ class TrainLoop:
         # chip; the reference instead blocked every step on a gRPC sess.run,
         # mnist_replica.py:251-264).
         profiling = False
-        batch_sh = batch_sharding(self.mesh)
-        for py_step in range(start_step, cfg.total_steps):
-            if cfg.profile_dir and py_step == cfg.profile_start:
+        profile_done = False
+        spc = self.config.steps_per_call
+
+        def crossed(every: int, before: int, after: int) -> bool:
+            """Did (before, after] cross a multiple of ``every``?"""
+            return bool(every) and (before // every) != (after // every)
+
+        py_step = start_step
+        while py_step < cfg.total_steps:
+            if (
+                cfg.profile_dir and not profiling and not profile_done
+                and py_step >= cfg.profile_start
+            ):
                 jax.profiler.start_trace(cfg.profile_dir)
                 profiling = True
-            if profiling and py_step == cfg.profile_start + cfg.profile_steps:
+            if profiling and py_step >= cfg.profile_start + cfg.profile_steps:
                 jax.block_until_ready(self.state.params)
                 jax.profiler.stop_trace()
                 profiling = False
+                profile_done = True
             batch = next(data_iter)
-            lead = jax.tree.leaves(batch)[0].shape[0]
-            if lead % n_data:
+            leaves = jax.tree.leaves(batch)
+            if spc > 1:
+                # Chunked dispatch: batch is a [K, ...] stack; trim to the
+                # steps remaining so the counter lands exactly on total.
+                take = min(leaves[0].shape[0], cfg.total_steps - py_step)
+                if leaves[0].shape[0] != take:
+                    batch = jax.tree.map(lambda x: x[:take], batch)
+                per_step = leaves[0].shape[1]
+            else:
+                take = 1
+                per_step = leaves[0].shape[0]
+            if per_step % n_data:
                 raise ValueError(
-                    f"global batch {lead} not divisible by the mesh's "
+                    f"global batch {per_step} not divisible by the mesh's "
                     f"dp*fsdp={n_data} data shards; adjust batch size"
                 )
             self.state, metrics = self._step_fn(
-                self.state, host_to_global(batch, batch_sh), rng
+                self.state, host_to_global(batch, self._data_sharding), rng
             )
-            step = py_step + 1
-            if cfg.checkpoint_every and step % cfg.checkpoint_every == 0:
+            step = py_step + take
+            if crossed(cfg.checkpoint_every, py_step, step):
                 self.save(wait=True)
             if (
-                cfg.eval_every and self._eval_step is not None
-                and eval_iter is not None and step % cfg.eval_every == 0
+                self._eval_step is not None and eval_iter is not None
+                and crossed(cfg.eval_every, py_step, step)
             ):
                 self.last_eval = {
                     f"val_{k}": v
@@ -472,21 +526,26 @@ class TrainLoop:
                         eval_iter, cfg.eval_batches
                     ).items()
                 }
-            if on_metrics and (step % cfg.log_every == 0 or step == cfg.total_steps):
+            if on_metrics and (
+                crossed(cfg.log_every, py_step, step) or step == cfg.total_steps
+            ):
                 dt = time.perf_counter() - t0
                 sps = (step - window) / dt if dt > 0 else 0.0
-                extras = {
-                    k: float(v) for k, v in metrics.items() if k != "loss"
+                # Multi-step metrics come back stacked [K]; report the mean.
+                scalar = {
+                    k: float(jnp.mean(v)) for k, v in metrics.items()
                 }
+                extras = {k: v for k, v in scalar.items() if k != "loss"}
                 extras.update(self.last_eval)
                 on_metrics(StepMetrics(
                     step=step,
-                    loss=float(metrics["loss"]),
+                    loss=scalar["loss"],
                     extras=extras,
                     steps_per_sec=sps,
                 ))
                 t0 = time.perf_counter()
                 window = step
+            py_step = step
         if profiling:  # loop ended inside the profile window
             jax.block_until_ready(self.state.params)
             jax.profiler.stop_trace()

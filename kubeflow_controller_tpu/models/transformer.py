@@ -452,6 +452,29 @@ def forward(
     return _constrain(logits, P(BATCH_AXES, None, "tp"))
 
 
+# bf16 peak of one v5e chip — the shared denominator for MFU accounting
+# (bench.py and benchmarks/transformer_bench.py both read this so the two
+# can never drift; override per-part via transformer_bench --peak-tflops).
+PEAK_TFLOPS_BF16_V5E = 197.0
+
+
+def train_flops_per_token(cfg: TransformerConfig, seq: int) -> float:
+    """Model FLOPs per trained token: 6*N matmul flops (fwd+bwd) plus the
+    causal-attention term 12*L*d_model*seq/2. The standard MFU accounting
+    (PaLM appendix B convention); used by bench.py and
+    benchmarks/transformer_bench.py so the two always agree."""
+    n_params = (
+        cfg.vocab_size * cfg.d_model * (1 if cfg.tie_embeddings else 2)
+        + cfg.n_layers * (
+            cfg.d_model * cfg.n_heads * cfg.head_dim * 2
+            + cfg.d_model * cfg.n_kv_heads * cfg.head_dim * 2
+            + 3 * cfg.d_model * cfg.d_ff
+        )
+    )
+    attn = 12 * cfg.n_layers * cfg.d_model * (seq / 2)  # causal halves it
+    return 6 * n_params + attn
+
+
 # -- loss / glue for TrainLoop ------------------------------------------------
 
 def _select_target_logp(logp: jax.Array, targets: jax.Array) -> jax.Array:
@@ -479,6 +502,11 @@ def _chunked_nll_and_argmax(
     h = hidden.reshape(b, n_chunks, chunk, d).transpose(1, 0, 2, 3)
     t = targets.reshape(b, n_chunks, chunk).transpose(1, 0, 2)
 
+    # Remat the chunk body: without it, grad-of-scan saves each chunk's
+    # fp32 logits as a residual and peak memory is the FULL logits tensor
+    # again (observed: 18.7G > 15.75G HBM at B16 S2048 vocab 32k). With it,
+    # backward recomputes one chunk's logits at a time.
+    @jax.checkpoint
     def body(_, ht):
         hc, tc = ht
         logits = jnp.einsum(

@@ -170,10 +170,13 @@ class LocalRuntime:
         and must rebuild its world from the store — the level-trigger promise
         the reference's expectations race comment describes
         (``pkg/controller/controller.go:259-262``)."""
+        was_threaded = len(self.controller._threads)
         for inf in (self.job_informer, self.pod_informer, self.service_informer):
             inf.stop()
         self.controller.queue.shutdown()
         self._wire()
+        if was_threaded:  # threaded mode: the successor needs workers too
+            self.controller.run(was_threaded)
 
     # -- threaded drive ------------------------------------------------------
 

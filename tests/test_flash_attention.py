@@ -59,9 +59,21 @@ def test_non_divisor_block_shrinks_to_divisor():
 
 
 def test_undivisible_seq_rejected():
-    q, k, v = qkv(s=132)  # no divisor that is a multiple of 8
+    # Explicit blocks smaller than the sequence: s=132 has no divisor that
+    # is a multiple of 8, so the kernel must refuse rather than silently
+    # leave tail positions uncomputed.
+    q, k, v = qkv(s=132)
     with pytest.raises(ValueError, match="pad the sequence"):
-        flash_mha(q, k, v, interpret=True)
+        flash_mha(q, k, v, block_q=64, block_k=64, interpret=True)
+
+
+def test_undivisible_seq_single_block_fallback():
+    # With the (large) default blocks, a short undivisible sequence runs as
+    # ONE full-sequence block (the array-dim exception) and stays correct.
+    q, k, v = qkv(s=132)
+    ref = mha_xla(q, k, v, causal=True)
+    out = flash_mha(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
 
 
 @pytest.mark.parametrize("causal", [True, False])
