@@ -1,0 +1,101 @@
+"""Autoregressive decode benchmark: KV-cache generation throughput.
+
+The decode loop is one compiled ``lax.scan`` (models/generate.py), so this
+measures the real serving number — tokens/sec/chip with a static cache —
+not a Python-dispatch loop. The reference has no inference story at all
+(training-only data plane), so these are repo-established numbers
+(BASELINE.md discipline).
+
+Usage: python benchmarks/generate_bench.py [--batch 8 --prompt 128 --gen 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_controller_tpu.models import generate as gen
+from kubeflow_controller_tpu.models import transformer as tfm
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt", type=int, default=128)
+    p.add_argument("--gen", type=int, default=256)
+    p.add_argument("--d-model", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=16)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=8)
+    p.add_argument("--d-ff", type=int, default=4096)
+    p.add_argument("--vocab", type=int, default=32768)
+    p.add_argument("--trials", type=int, default=5)
+    args = p.parse_args()
+
+    max_seq = args.prompt + args.gen
+    cfg = tfm.TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_layers=args.layers,
+        n_heads=args.heads, n_kv_heads=args.kv_heads, d_ff=args.d_ff,
+        max_seq=max_seq, remat=False,
+    )
+    params = tfm.init_params(cfg, jax.random.key(0))
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (args.batch, args.prompt)
+        ),
+        jnp.int32,
+    )
+
+    def make_run(n_gen):
+        return jax.jit(
+            lambda params, prompt, key: gen.generate(
+                cfg, params, prompt, max_new_tokens=n_gen,
+                max_seq=max_seq, temperature=0.0, rng=key,
+            ),
+        )
+
+    def timed(run):
+        key = jax.random.key(1)
+        toks = run(params, prompt, key)     # compile (prefill + decode scan)
+        int(jnp.sum(toks))                  # value fetch = barrier
+        times = []
+        for _ in range(args.trials):
+            t0 = time.perf_counter()
+            toks = run(params, prompt, key)
+            int(jnp.sum(toks))
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[len(times) // 2]
+
+    # Two-point measurement so prefill (identical in both runs) cancels
+    # and the decode metric is PURE decode, not prefill-contaminated.
+    short = max(args.gen // 8, 1)
+    dt_full = timed(make_run(args.gen))
+    dt_short = timed(make_run(short))
+    per_step = (dt_full - dt_short) / (args.gen - short)
+
+    print(json.dumps({
+        "model_params": tfm.count_params(params),
+        "backend": jax.default_backend(),
+        "batch": args.batch,
+        "prompt": args.prompt,
+        "gen": args.gen,
+        "e2e_ms": round(dt_full * 1000, 1),
+        "e2e_tokens_per_sec": round(args.batch * args.gen / dt_full),
+        "decode_ms_per_step": round(per_step * 1000, 3),
+        "decode_tokens_per_sec": round(args.batch / per_step),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""ResNet-50 training throughput — the north-star metric (BASELINE.md:
+"TFJob images/sec/chip (ResNet-50)").
+
+Synthetic-data throughput in the MLPerf sense: one device-resident
+ImageNet-shaped batch is reused so the number measures the training step
+(conv/BN/GEMM on the MXU + optimizer), not host data generation. bf16
+compute, fp32 params/BN stats, SGD momentum.
+
+Usage: python benchmarks/resnet_bench.py [--batch 128 --steps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kubeflow_controller_tpu.models import resnet
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=6)
+    p.add_argument("--tiny", action="store_true")
+    args = p.parse_args()
+
+    model = resnet.resnet_tiny() if args.tiny else resnet.resnet50()
+    init_fn = resnet.make_init_fn(model, args.image_size)
+    loss_fn = resnet.make_loss_fn(model)
+    params, batch_stats = init_fn(jax.random.key(0))
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    opt = tx.init(params)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(rng.standard_normal(
+            (args.batch, args.image_size, args.image_size, 3)
+        ), jnp.bfloat16),
+        "label": jnp.asarray(rng.integers(
+            0, resnet.NUM_CLASSES, (args.batch,)
+        ), jnp.int32),
+    }
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(params, batch_stats, opt, batch):
+        (loss, (_, new_stats)), g = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params, batch_stats, batch, None)
+        u, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, u), new_stats, opt, loss
+
+    for _ in range(args.warmup):
+        params, batch_stats, opt, loss = step(params, batch_stats, opt, batch)
+    float(loss)  # value fetch = completion barrier (tunnel-safe)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, batch_stats, opt, loss = step(params, batch_stats, opt, batch)
+    float(loss)
+    dt = (time.perf_counter() - t0) / args.steps
+
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    print(json.dumps({
+        "model": "resnet_tiny" if args.tiny else "resnet50",
+        "model_params": int(n_params),
+        "backend": jax.default_backend(),
+        "batch": args.batch,
+        "image_size": args.image_size,
+        "step_ms": round(dt * 1000, 2),
+        "images_per_sec_per_chip": round(args.batch / dt),
+        "loss": round(float(loss), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from kubeflow_controller_tpu.ops.flash_attention import (
+    DEFAULT_BLOCK_Q,
+    _choose_block,
+)
+
 
 def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
     """Grouped-query attention: expand kv heads to match query heads."""
@@ -58,6 +63,17 @@ def mha_xla(
 
 
 @functools.lru_cache(None)
+def _flash_block_ok(s: int) -> bool:
+    """True iff the sequence tiles into flash blocks large enough to be
+    worth the kernel (>= 128); tiny divisor blocks would explode the
+    sequential grid."""
+    try:
+        return _choose_block(s, DEFAULT_BLOCK_Q) >= 128
+    except ValueError:
+        return False
+
+
+@functools.lru_cache(None)
 def _default_backend() -> str:
     try:
         return jax.default_backend()
@@ -79,16 +95,20 @@ def mha(
     (seq divisible by the kernel block), else the XLA path.
     """
     if impl == "auto":
-        # Flash wins when its tiles fill the MXU/lanes: head_dim >= 128.
-        # At head_dim 64 XLA's fused attention is faster end-to-end
-        # (measured in benchmarks/transformer_bench.py), so auto routes
-        # there.
+        # With 512x1024 blocks the Pallas kernel beats XLA end-to-end at
+        # head_dim 64, 128 (and standalone at 256): measured fwd+bwd
+        # 1.45-1.8x at hd64/hd128, S 1024-4096, and XLA OOMs first at long
+        # sequence (benchmarks/attention_bench.py, RESULTS.md). Smaller
+        # head_dims (test-scale models) underfill the 128-lane MXU tiles —
+        # keep those on XLA. The sequence must also tile into blocks >= 128
+        # (a seq like 8x<prime> would degrade to 8-wide blocks and a
+        # quadratically larger sequential grid — far slower than XLA).
         use_flash = (
             _default_backend() == "tpu"
+            and q.shape[1] == k.shape[1]    # kernel assumes q_len == k_len
             and q.shape[1] >= 256
-            and q.shape[1] % 128 == 0
-            and k.shape[1] % 128 == 0
-            and q.shape[3] in (128, 256)
+            and q.shape[3] in (64, 128, 256)
+            and _flash_block_ok(q.shape[1])
         )
         impl = "flash" if use_flash else "xla"
     if impl == "flash":

@@ -183,6 +183,72 @@ class TestThreadedRestart:
             rt.stop()
 
 
+class TestWireChaos:
+    def test_gang_survives_preemption_and_controller_swap_over_rest(self):
+        """Operator-topology chaos: a gang job driven ONLY over the REST
+        seam survives a slice preemption AND a full controller-process
+        replacement (old process dies mid-recovery, a new one connects to
+        the same apiserver and finishes the job)."""
+        import time as _time
+
+        from kubeflow_controller_tpu.cluster.rest_server import RestServer
+        from kubeflow_controller_tpu.runtime import RemoteRuntime
+        from kubeflow_controller_tpu.cluster.cluster import FakeCluster
+
+        cluster = FakeCluster(PodRunPolicy(start_delay=0.1, run_duration=60))
+        cluster.slice_pool.add_pool("v5p-8", 2)
+        server = RestServer(cluster).start()
+
+        def tick_until(predicate, deadline_s=30):
+            deadline = _time.time() + deadline_s
+            while _time.time() < deadline:
+                cluster.tick(0.05)
+                if predicate():
+                    return True
+                _time.sleep(0.02)
+            return predicate()
+
+        rt = RemoteRuntime(server.url, resync_period=0.5)
+        try:
+            rt.start(workers=2)
+            rt.client.create_job(worker_job("wire"))
+            assert tick_until(lambda: (
+                (j := rt.client.get_job("default", "wire")) is not None
+                and j.status.phase == JobPhase.RUNNING
+            ))
+            job = rt.client.get_job("default", "wire")
+            held = cluster.slice_pool.holdings(job.metadata.uid)
+            cluster.preempt_slice(held[0].name)
+            # give the doomed controller a moment to observe the failure,
+            # then kill it mid-recovery
+            tick_until(lambda: False, deadline_s=0.5)
+        finally:
+            rt.stop()
+
+        cluster.slice_pool.restore(held[0].name)
+        # jobs finish fast under the successor
+        cluster.default_policy = PodRunPolicy(start_delay=0.1, run_duration=0.3)
+        rt2 = RemoteRuntime(server.url, resync_period=0.5)
+        try:
+            rt2.start(workers=2)
+            assert tick_until(lambda: (
+                (j := rt2.client.get_job("default", "wire")) is not None
+                and j.status.phase == JobPhase.SUCCEEDED
+            ), deadline_s=30), rt2.client.get_job("default", "wire").status
+            job = rt2.client.get_job("default", "wire")
+            assert job.status.restarts >= 1
+            # every pod belongs to the final epoch; gang size exact
+            final = [
+                p for p in cluster.pods.list("default")
+                if p.metadata.labels.get(naming.LABEL_EPOCH)
+                == str(job.status.restarts)
+            ]
+            assert len(final) == 2
+        finally:
+            rt2.stop()
+            server.stop()
+
+
 class TestChaosSoak:
     """VERDICT item 6: a seeded random fault schedule — preemptions, pod
     crashes, create failures, admission delays, controller crashes, job
