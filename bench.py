@@ -50,36 +50,37 @@ def bench_mnist() -> float:
         batch_size = ((batch_size + n_data - 1) // n_data) * n_data
 
     model = mnist.MnistMLP()
-    # 25 steps per dispatch (lax.scan over a device-resident chunk): a ~1 ms
+    # 50 steps per dispatch (lax.scan over a device-resident chunk): a ~1 ms
     # MNIST step is dispatch-latency-bound over the tunneled chip, so the
     # per-step round-trip — not the TPU — would set the score otherwise.
+    # Prefetch depth 4 keeps uploads ahead of compute.
     loop = TrainLoop(
         mesh=mesh,
         init_fn=mnist.make_init_fn(model),
         loss_fn=mnist.make_loss_fn(model),
         optimizer=optax.adam(0.01),
         config=TrainLoopConfig(
-            total_steps=total_steps, log_every=10 ** 9, steps_per_call=25,
+            total_steps=total_steps, log_every=10 ** 9, steps_per_call=50,
         ),
     )
     bs = batch_sharding(mesh)
     data = device_prefetch(
         mnist.synthetic_mnist(batch_size, uint8=True),
         {"image": bs, "label": bs},
-        chunk=25,
-        size=3,
+        chunk=50,
+        size=4,
         yield_chunks=True,
     )
 
-    # Warm up: compile + enough steps to fill the async dispatch pipeline
-    # (the tunneled chip needs ~50 calls to reach steady state). Then time
-    # three windows and take the median — single-window numbers are noisy
-    # over the device tunnel. Completion of each window is forced by
-    # FETCHING the step counter's value: the donated state chain makes the
-    # fetch transitively wait for every dispatched step
-    # (block_until_ready alone is not trustworthy on remote-tunnel
-    # platforms, where it can return before execution finishes).
-    warm = 60
+    # Warm up: compile, then 4 full 50-step dispatch chunks to fill the
+    # async dispatch + upload pipeline. Then time three windows and take
+    # the median — single-window numbers are noisy over the device tunnel.
+    # Completion of each window is forced by FETCHING the step counter's
+    # value: the donated state chain makes the fetch transitively wait for
+    # every dispatched step (block_until_ready alone is not trustworthy on
+    # remote-tunnel platforms, where it can return before execution
+    # finishes).
+    warm = 200
     loop.config.total_steps = warm
     loop.run(data)
     int(loop.state.step)

@@ -1,0 +1,105 @@
+"""BERT-base MLM pretraining throughput (BASELINE.md config #4).
+
+Sequences/sec and MFU for the masked-LM objective, device-resident batch
+(throughput in the MLPerf-synthetic sense). BERT-base is head_dim 64, so
+this also exercises the flash kernel's hd64 path with bidirectional
+(non-causal) attention.
+
+Usage: python benchmarks/bert_bench.py [--batch 32 --seq 512]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kubeflow_controller_tpu.models import bert
+from kubeflow_controller_tpu.models.transformer import PEAK_TFLOPS_BF16_V5E
+
+
+def mlm_train_flops_per_seq(cfg: bert.BertConfig, seq: int) -> float:
+    """6*N (fwd+bwd matmuls) per token x seq + bidirectional attention term
+    (12*L*d*s per token — no causal halving in an encoder)."""
+    n_params = (
+        cfg.vocab_size * cfg.d_model          # tied embed/unembed, used twice
+        + cfg.max_seq * cfg.d_model           # position table (gather; small)
+        + cfg.n_layers * (4 * cfg.d_model ** 2 + 2 * cfg.d_model * cfg.d_ff)
+        + cfg.d_model ** 2                    # mlm dense
+    )
+    per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
+    return per_token * seq
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--seq", type=int, default=512)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=6)
+    p.add_argument("--attn", default="auto", choices=["auto", "xla", "flash"])
+    args = p.parse_args()
+
+    cfg = bert.bert_base_config(max_seq=args.seq, attn_impl=args.attn)
+    params = bert.init_params(cfg, jax.random.key(0))
+    loss_fn = bert.make_loss_fn(cfg)
+    tx = optax.adamw(1e-4)
+    opt = tx.init(params)
+
+    batch = next(bert.synthetic_mlm_batch(cfg, args.batch, args.seq))
+    # The synthetic stream has no padding; an all-ones attention_mask would
+    # become segment ids and force the XLA fallback in flash_mha, silently
+    # defeating --attn flash. Unpadded batches should carry no mask at all.
+    if "attention_mask" in batch and np.all(batch["attention_mask"] == 1):
+        del batch["attention_mask"]
+    batch = jax.tree.map(jnp.asarray, batch)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt, batch):
+        (loss, _), g = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch, None
+        )
+        u, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, u), opt, loss
+
+    for _ in range(args.warmup):
+        params, opt, loss = step(params, opt, batch)
+    float(loss)  # value fetch = completion barrier (tunnel-safe)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt, loss = step(params, opt, batch)
+    float(loss)
+    dt = (time.perf_counter() - t0) / args.steps
+
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    flops = mlm_train_flops_per_seq(cfg, args.seq) * args.batch
+    print(json.dumps({
+        "model": "bert-base",
+        "model_params": int(n_params),
+        "backend": jax.default_backend(),
+        "attn": args.attn,
+        "batch": args.batch,
+        "seq": args.seq,
+        "step_ms": round(dt * 1000, 2),
+        "sequences_per_sec": round(args.batch / dt, 1),
+        "tokens_per_sec": round(args.batch * args.seq / dt),
+        "mfu": round(flops / dt / (PEAK_TFLOPS_BF16_V5E * 1e12), 4),
+        "loss": round(float(loss), 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
