@@ -50,6 +50,9 @@ def main() -> None:
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=6)
     p.add_argument("--attn", default="auto", choices=["auto", "xla", "flash"])
+    # Padding masks are segment ids, fused into the flash kernel; --no-mask
+    # benches the maskless variant.
+    p.add_argument("--no-mask", action="store_true")
     args = p.parse_args()
 
     cfg = bert.bert_base_config(max_seq=args.seq, attn_impl=args.attn)
@@ -59,11 +62,14 @@ def main() -> None:
     opt = tx.init(params)
 
     batch = next(bert.synthetic_mlm_batch(cfg, args.batch, args.seq))
-    # The synthetic stream has no padding; an all-ones attention_mask would
-    # become segment ids and force the XLA fallback in flash_mha, silently
-    # defeating --attn flash. Unpadded batches should carry no mask at all.
-    if "attention_mask" in batch and np.all(batch["attention_mask"] == 1):
-        del batch["attention_mask"]
+    if args.no_mask and "attention_mask" in batch:
+        if np.all(batch["attention_mask"] == 1):
+            # Unpadded stream: drop the no-op mask (skips masking entirely).
+            del batch["attention_mask"]
+        else:
+            print("warning: --no-mask ignored (batch has real padding)",
+                  file=sys.stderr)
+    masked = "attention_mask" in batch
     batch = jax.tree.map(jnp.asarray, batch)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -91,6 +97,7 @@ def main() -> None:
         "model_params": int(n_params),
         "backend": jax.default_backend(),
         "attn": args.attn,
+        "masked": masked,
         "batch": args.batch,
         "seq": args.seq,
         "step_ms": round(dt * 1000, 2),

@@ -64,11 +64,32 @@ def _choose_block(s: int, requested: int) -> int:
 
 # -- forward kernel ----------------------------------------------------------
 
-def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref,
-    m_scr, l_scr, acc_scr,
-    *, causal: bool, sm_scale: float, block_q: int, block_k: int,
+def _block_mask(
+    qi, ki, seg_q, seg_k, causal: bool, block_q: int, block_k: int, shape,
 ):
+    """Combined causal + segment mask for one (q-block, k-block) tile, or
+    None when nothing masks. seg_q/seg_k are [BQ]/[BK] int32 or None."""
+    mask = None
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        mask = (qi * block_q + rows) >= (ki * block_k + cols)
+    if seg_q is not None:
+        seg = seg_q[:, None] == seg_k[None, :]
+        mask = seg if mask is None else mask & seg
+    return mask
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, *rest,
+    causal: bool, sm_scale: float, block_q: int, block_k: int,
+    has_segments: bool,
+):
+    if has_segments:
+        seg_q_ref, seg_k_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+        seg_q_ref = seg_k_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -81,6 +102,7 @@ def _fwd_kernel(
 
     # Causal: block is live unless every key position exceeds every query
     # position. (Python bool when not causal — no predication overhead.)
+    # Segment masking is elementwise inside the block; no block skipping.
     live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
 
     @pl.when(live)
@@ -94,10 +116,13 @@ def _fwd_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale                                   # [BQ, BK]
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = (qi * block_q + rows) >= (ki * block_k + cols)
+        mask = _block_mask(
+            qi, ki,
+            seg_q_ref[0, 0] if has_segments else None,
+            seg_k_ref[0, 0] if has_segments else None,
+            causal, block_q, block_k, s.shape,
+        )
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:, :1]                          # [BQ, 1]
@@ -105,7 +130,7 @@ def _fwd_kernel(
         m_cur = jnp.max(s, axis=1, keepdims=True)      # [BQ, 1]
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                         # [BQ, BK]
-        if causal:
+        if mask is not None:
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)                # [BQ, 1]
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
@@ -127,11 +152,35 @@ def _fwd_kernel(
         )
 
 
+def _seg_specs(block_q: int, block_k: int, ki_major: bool = False):
+    """BlockSpecs for the q-side and k-side segment-id vectors.
+
+    Segment ids ride as [B, 1, S] (the middle singleton keeps the block's
+    second-to-last dim equal to the array dim — Mosaic requires the last
+    two block dims be (8k, 128m) or exactly the array dims).
+
+    ``ki_major=True`` is for grids whose 3rd/4th axes are (ki, qi) — the
+    dkdv kernel — instead of the (qi, ki) of fwd/dq; using the wrong order
+    would silently mask with the wrong segments."""
+    if ki_major:
+        qmap = lambda b, h, ki, qi: (b, 0, qi)  # noqa: E731
+        kmap = lambda b, h, ki, qi: (b, 0, ki)  # noqa: E731
+    else:
+        qmap = lambda b, h, qi, ki: (b, 0, qi)  # noqa: E731
+        kmap = lambda b, h, qi, ki: (b, 0, ki)  # noqa: E731
+    return [
+        pl.BlockSpec((1, 1, block_q), qmap),
+        pl.BlockSpec((1, 1, block_k), kmap),
+    ]
+
+
 def _fwd_wide(
     q: jax.Array, k: jax.Array, v: jax.Array,
+    segment_ids: Optional[jax.Array],
     causal: bool, block_q: int, block_k: int, interpret: bool,
 ):
-    """q: [B,H,S,D]; k/v: [B,KVH,S,D] -> (o [B,H,S,D], lse [B,H,S,128])."""
+    """q: [B,H,S,D]; k/v: [B,KVH,S,D]; segment_ids [B,S] or None ->
+    (o [B,H,S,D], lse [B,H,S,128])."""
     b, h, s, d = q.shape
     kv_h = k.shape[1]
     rep = h // kv_h
@@ -142,22 +191,29 @@ def _fwd_wide(
     sm_scale = d ** -0.5
 
     grid = (b, h, nq, nk)
+    has_segments = segment_ids is not None
     kernel = functools.partial(
         _fwd_kernel, causal=causal, sm_scale=sm_scale,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, has_segments=has_segments,
     )
+    inputs = [q, k, v]
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
+        pl.BlockSpec(
+            (1, 1, block_k, d), lambda b, h, qi, ki: (b, h // rep, ki, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, block_k, d), lambda b, h, qi, ki: (b, h // rep, ki, 0)
+        ),
+    ]
+    if has_segments:
+        seg = segment_ids.astype(jnp.int32)[:, None, :]   # [B, 1, S]
+        inputs += [seg, seg]
+        in_specs += _seg_specs(block_q, block_k)
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec(
-                (1, 1, block_k, d), lambda b, h, qi, ki: (b, h // rep, ki, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, d), lambda b, h, qi, ki: (b, h // rep, ki, 0)
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec(
@@ -174,11 +230,12 @@ def _fwd_wide(
             pltpu.VMEM((block_q, d), jnp.float32),     # acc
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
 
 
 def _fwd(
     q: jax.Array, k: jax.Array, v: jax.Array,
+    segment_ids: Optional[jax.Array],
     causal: bool, block_q: int, block_k: int, interpret: bool,
 ):
     """q: [B,H,S,D]; k/v: [B,KVH,S,D] -> (o [B,H,S,D], lse [B,H,S]).
@@ -188,17 +245,24 @@ def _fwd(
     narrow [B,H,S] slice — 128x smaller (ADVICE r1: the broadcast residual
     was ~2x the attention output itself at head_dim 128 bf16).
     """
-    o, lse_wide = _fwd_wide(q, k, v, causal, block_q, block_k, interpret)
+    o, lse_wide = _fwd_wide(
+        q, k, v, segment_ids, causal, block_q, block_k, interpret
+    )
     return o, lse_wide[..., 0]
 
 
 # -- backward kernels --------------------------------------------------------
 
 def _bwd_dkdv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-    dk_ref, dv_ref, dk_scr, dv_scr,
-    *, causal: bool, sm_scale: float, block_q: int, block_k: int,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    causal: bool, sm_scale: float, block_q: int, block_k: int,
+    has_segments: bool,
 ):
+    if has_segments:
+        seg_q_ref, seg_k_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
+        seg_q_ref = seg_k_ref = None
     ki = pl.program_id(2)
     qi = pl.program_id(3)
     nq = pl.num_programs(3)
@@ -222,10 +286,13 @@ def _bwd_dkdv_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale                                    # [BQ, BK]
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = (qi * block_q + rows) >= (ki * block_k + cols)
+        mask = _block_mask(
+            qi, ki,
+            seg_q_ref[0, 0] if has_segments else None,
+            seg_k_ref[0, 0] if has_segments else None,
+            causal, block_q, block_k, s.shape,
+        )
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)                            # [BQ, BK]
         # dv += p^T @ do
@@ -252,10 +319,15 @@ def _bwd_dkdv_kernel(
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-    dq_ref, dq_scr,
-    *, causal: bool, sm_scale: float, block_q: int, block_k: int,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    causal: bool, sm_scale: float, block_q: int, block_k: int,
+    has_segments: bool,
 ):
+    if has_segments:
+        seg_q_ref, seg_k_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
+        seg_q_ref = seg_k_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -278,10 +350,13 @@ def _bwd_dq_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = (qi * block_q + rows) >= (ki * block_k + cols)
+        mask = _block_mask(
+            qi, ki,
+            seg_q_ref[0, 0] if has_segments else None,
+            seg_k_ref[0, 0] if has_segments else None,
+            causal, block_q, block_k, s.shape,
+        )
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
@@ -299,7 +374,7 @@ def _bwd_dq_kernel(
 
 
 def _bwd(
-    q, k, v, o, lse, do, causal, block_q, block_k, interpret,
+    q, k, v, o, lse, do, segment_ids, causal, block_q, block_k, interpret,
 ):
     b, h, s, d = q.shape
     kv_h = k.shape[1]
@@ -309,6 +384,7 @@ def _bwd(
     nq = s // block_q
     nk = s // block_k
     sm_scale = d ** -0.5
+    has_segments = segment_ids is not None
 
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
@@ -318,31 +394,39 @@ def _bwd(
     # [B,H,S,128] layout the kernels read (transient, fused by XLA).
     lse = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
 
+    seg_inputs = []
+    if has_segments:
+        seg = segment_ids.astype(jnp.int32)[:, None, :]   # [B, 1, S]
+        seg_inputs = [seg, seg]
+
     # dk/dv: one pass per k-block, q innermost. Heads stay un-grouped (dk for
     # a shared GQA head accumulates across its query heads afterwards).
     dkdv_kernel = functools.partial(
         _bwd_dkdv_kernel, causal=causal, sm_scale=sm_scale,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, has_segments=has_segments,
     )
+    dkdv_in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, ki, qi: (b, h, qi, 0)),
+        pl.BlockSpec(
+            (1, 1, block_k, d), lambda b, h, ki, qi: (b, h // rep, ki, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, block_k, d), lambda b, h, ki, qi: (b, h // rep, ki, 0)
+        ),
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, ki, qi: (b, h, qi, 0)),
+        pl.BlockSpec(
+            (1, 1, block_q, 128), lambda b, h, ki, qi: (b, h, qi, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, block_q, 128), lambda b, h, ki, qi: (b, h, qi, 0)
+        ),
+    ]
+    if has_segments:
+        dkdv_in_specs += _seg_specs(block_q, block_k, ki_major=True)
     dk, dv = pl.pallas_call(
         dkdv_kernel,
         grid=(b, h, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec(
-                (1, 1, block_k, d), lambda b, h, ki, qi: (b, h // rep, ki, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, d), lambda b, h, ki, qi: (b, h // rep, ki, 0)
-            ),
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec(
-                (1, 1, block_q, 128), lambda b, h, ki, qi: (b, h, qi, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, block_q, 128), lambda b, h, ki, qi: (b, h, qi, 0)
-            ),
-        ],
+        in_specs=dkdv_in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d), lambda b, h, ki, qi: (b, h, ki, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda b, h, ki, qi: (b, h, ki, 0)),
@@ -356,38 +440,41 @@ def _bwd(
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *seg_inputs)
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, has_segments=has_segments,
     )
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
+        pl.BlockSpec(
+            (1, 1, block_k, d), lambda b, h, qi, ki: (b, h // rep, ki, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, block_k, d), lambda b, h, qi, ki: (b, h // rep, ki, 0)
+        ),
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
+        pl.BlockSpec(
+            (1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)
+        ),
+    ]
+    if has_segments:
+        dq_in_specs += _seg_specs(block_q, block_k)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec(
-                (1, 1, block_k, d), lambda b, h, qi, ki: (b, h // rep, ki, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, d), lambda b, h, qi, ki: (b, h // rep, ki, 0)
-            ),
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec(
-                (1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)
-            ),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *seg_inputs)
 
     if rep > 1:  # fold query-head groups back onto shared kv heads
         dk = dk.reshape(b, kv_h, rep, s, d).sum(axis=2)
@@ -398,21 +485,24 @@ def _bwd(
 # -- public API (BSHD layout, custom vjp) ------------------------------------
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7)
 )
-def _flash_bhsd(q, k, v, causal, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
+def _flash_bhsd(q, k, v, segment_ids, causal, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, segment_ids, causal, block_q, block_k, interpret)
     return o
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_fwd_rule(q, k, v, segment_ids, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, segment_ids, causal, block_q, block_k, interpret)
+    return o, (q, k, v, segment_ids, o, lse)
 
 
 def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
-    q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret)
+    q, k, v, segment_ids, o, lse = res
+    dq, dk, dv = _bwd(
+        q, k, v, o, lse, do, segment_ids, causal, block_q, block_k, interpret
+    )
+    return dq, dk, dv, None   # segment ids are integers: no gradient
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -430,20 +520,19 @@ def flash_mha(
 ) -> jax.Array:
     """Flash attention, [B,S,H,D] in/out (BSHD, matching ops.attention.mha).
 
-    segment_ids is not fused yet — packed batches fall back to the XLA path
-    (the dispatcher in ops.attention already routes them there).
+    ``segment_ids`` [B,S] fuses packed-batch/padding masking into the
+    kernel: position i attends to j only when ``seg[i] == seg[j]`` (ANDed
+    with the causal mask when causal). No XLA fallback.
 
     ``interpret=None`` auto-selects: compiled Mosaic on TPU, interpreter
     elsewhere — so explicit ``impl='flash'`` works (slowly) on CPU meshes.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if segment_ids is not None:
-        from kubeflow_controller_tpu.ops.attention import mha_xla
-
-        return mha_xla(q, k, v, causal=causal, segment_ids=segment_ids)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash_bhsd(qt, kt, vt, causal, block_q, block_k, interpret)
+    out = _flash_bhsd(
+        qt, kt, vt, segment_ids, causal, block_q, block_k, interpret
+    )
     return out.transpose(0, 2, 1, 3)

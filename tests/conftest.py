@@ -20,7 +20,11 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# TPUJOB_TEST_PLATFORM=tpu leaves the real backend in place so the
+# @skipif-gated compiled-Mosaic tests run (e.g. the flash segment kernel);
+# default is the hermetic CPU mesh.
+if os.environ.get("TPUJOB_TEST_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 # Prefer an installed package (`pip install -e .` — see pyproject.toml);
 # fall back to the checkout root so the suite also runs uninstalled.
