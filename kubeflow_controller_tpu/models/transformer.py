@@ -523,16 +523,43 @@ def _chunked_nll_and_argmax(
     )
 
 
+def packed_positions(segment_ids: jax.Array) -> jax.Array:
+    """Per-document position ids for a packed batch: positions restart at 0
+    at every segment boundary (RoPE must not leak phase across documents).
+    segment_ids [B,S] -> positions [B,S] int32."""
+    b, s = segment_ids.shape
+    idx = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    is_start = jnp.concatenate(
+        [jnp.ones((b, 1), bool), segment_ids[:, 1:] != segment_ids[:, :-1]],
+        axis=1,
+    )
+    seg_start = lax.cummax(jnp.where(is_start, idx, 0), axis=1)
+    return idx - seg_start
+
+
 def next_token_loss(
     cfg: TransformerConfig, params: Params, batch: Dict[str, jax.Array],
     loss_chunk: int = 0,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Causal LM loss: predict tokens[1:] from tokens[:-1]. Ignores positions
     where ``batch['mask']`` (optional) is 0. loss_chunk > 0 streams the
-    vocab projection in sequence chunks of that size (bounds logits memory)."""
+    vocab projection in sequence chunks of that size (bounds logits memory).
+
+    Packed batches: ``batch['segment_ids']`` [B, S] (same length as tokens)
+    marks which document each token belongs to; id 0 means padding (the
+    same convention as models/bert.py). Attention is confined to the
+    document (fused into the flash kernel), RoPE positions restart per
+    document, and targets that cross a boundary or land in padding are
+    excluded from the loss."""
     tokens = batch["tokens"]
     targets = tokens[:, 1:]
-    hidden, aux = forward_hidden(cfg, params, tokens[:, :-1])
+    segs = batch.get("segment_ids")
+    seg_in = None if segs is None else segs[:, :-1]
+    hidden, aux = forward_hidden(
+        cfg, params, tokens[:, :-1],
+        positions=None if seg_in is None else packed_positions(seg_in),
+        segment_ids=seg_in,
+    )
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
@@ -557,9 +584,17 @@ def next_token_loss(
         nll = -_select_target_logp(logp, targets)
         am = logits.argmax(-1)
     mask = batch.get("mask")
+    mask = None if mask is None else mask[:, 1:].astype(jnp.float32)
+    if segs is not None:
+        # A target across a document boundary is not a real prediction, and
+        # segment id 0 is the padding convention (as in models/bert.py):
+        # pad->pad "predictions" must not train or score.
+        valid = (
+            (segs[:, 1:] == segs[:, :-1]) & (segs[:, 1:] != 0)
+        ).astype(jnp.float32)
+        mask = valid if mask is None else mask * valid
     hits = (am == targets).astype(jnp.float32)
     if mask is not None:
-        mask = mask[:, 1:].astype(jnp.float32)
         denom = jnp.maximum(mask.sum(), 1.0)
         loss = (nll * mask).sum() / denom
         acc = (hits * mask).sum() / denom

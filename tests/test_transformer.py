@@ -151,3 +151,60 @@ def test_chunked_loss_matches_dense(cfg, params):
     )(params)
     for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+class TestPackedSequences:
+    """batch['segment_ids'] packs documents into one row: attention confined
+    per document (fused in the kernel on TPU), RoPE restarts per document,
+    boundary targets excluded — so a packed row must reproduce EXACTLY the
+    token-weighted loss of its documents run separately."""
+
+    def test_packed_loss_matches_separate_documents(self):
+        cfg = tfm.tiny_config(max_seq=64)
+        params = tfm.init_params(cfg, jax.random.key(0))
+        r = np.random.default_rng(3)
+        doc_a = r.integers(0, cfg.vocab_size, 20)
+        doc_b = r.integers(0, cfg.vocab_size, 24)
+
+        packed = {
+            "tokens": jnp.asarray(
+                np.concatenate([doc_a, doc_b])[None], jnp.int32),
+            "segment_ids": jnp.asarray(
+                np.concatenate([np.ones(20), np.full(24, 2)])[None],
+                jnp.int32),
+        }
+        loss_p, _ = tfm.next_token_loss(cfg, params, packed)
+
+        la, _ = tfm.next_token_loss(
+            cfg, params, {"tokens": jnp.asarray(doc_a[None], jnp.int32)})
+        lb, _ = tfm.next_token_loss(
+            cfg, params, {"tokens": jnp.asarray(doc_b[None], jnp.int32)})
+        expected = (19 * float(la) + 23 * float(lb)) / 42
+        assert abs(float(loss_p) - expected) < 2e-5, (
+            float(loss_p), expected)
+
+    def test_padding_segment_excluded(self):
+        # pad (segment 0) tail must not contribute: [doc, pads] scores
+        # exactly like the doc alone
+        import numpy as np
+
+        cfg = tfm.tiny_config(max_seq=64)
+        params = tfm.init_params(cfg, jax.random.key(0))
+        doc = np.random.default_rng(5).integers(0, cfg.vocab_size, 20)
+        padded = {
+            "tokens": jnp.asarray(
+                np.concatenate([doc, np.zeros(12, np.int64)])[None],
+                jnp.int32),
+            "segment_ids": jnp.asarray(
+                np.concatenate([np.ones(20), np.zeros(12)])[None],
+                jnp.int32),
+        }
+        lp, _ = tfm.next_token_loss(cfg, params, padded)
+        la, _ = tfm.next_token_loss(
+            cfg, params, {"tokens": jnp.asarray(doc[None], jnp.int32)})
+        assert abs(float(lp) - float(la)) < 2e-5
+
+    def test_packed_positions_restart(self):
+        segs = jnp.asarray([[1, 1, 1, 2, 2, 3, 3, 3]], jnp.int32)
+        pos = tfm.packed_positions(segs)
+        assert pos.tolist() == [[0, 1, 2, 0, 1, 0, 1, 2]]
