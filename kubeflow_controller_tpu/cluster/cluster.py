@@ -26,7 +26,7 @@ from kubeflow_controller_tpu.cluster.slices import (
     SlicePool,
     TPUSlice,
 )
-from kubeflow_controller_tpu.cluster.store import ObjectStore
+from kubeflow_controller_tpu.cluster.store import NotFound, ObjectStore
 
 # Well-known annotations the controller stamps on pods it creates; the fake
 # scheduler reads them to drive gang admission. (The TPU analog of the
@@ -207,7 +207,12 @@ class FakeCluster:
             def bind(p: Pod, sl: TPUSlice = sl, hi: int = hi) -> None:
                 p.spec.assigned_slice = sl.name
                 p.status.host_ip = sl.hosts[hi % len(sl.hosts)]
-            self.pods.mutate(pod.metadata.namespace, pod.metadata.name, bind)
+            try:
+                self.pods.mutate(
+                    pod.metadata.namespace, pod.metadata.name, bind
+                )
+            except NotFound:
+                continue  # deleted mid-admission; re-gang next tick
             self._runtime(pod).scheduled_at = self.now
             self.append_pod_log(
                 pod.metadata.name,
@@ -233,9 +238,11 @@ class FakeCluster:
                     rt.started_at = self.now
                     self._transition(pod, PodPhase.RUNNING)
                     if policy.run_fn is not None:
-                        code = policy.run_fn(self.pods.get(
-                            pod.metadata.namespace, pod.metadata.name))
-                        self._finish(pod, code)
+                        cur = self.pods.try_get(
+                            pod.metadata.namespace, pod.metadata.name)
+                        if cur is None:
+                            continue  # deleted mid-transition: nothing to run
+                        self._finish(pod, policy.run_fn(cur))
             elif pod.status.phase == PodPhase.RUNNING:
                 if policy.run_fn is not None:
                     continue  # run_fn pods finish synchronously above
@@ -250,7 +257,10 @@ class FakeCluster:
             p.status.phase = phase
             if phase == PodPhase.RUNNING:
                 p.status.start_time = self.now
-        self.pods.mutate(pod.metadata.namespace, pod.metadata.name, mut)
+        try:
+            self.pods.mutate(pod.metadata.namespace, pod.metadata.name, mut)
+        except NotFound:
+            return  # deleted by the controller between list and mutate
         if phase == PodPhase.RUNNING:
             cmd = " ".join(pod.spec.main_container().command)
             self.append_pod_log(pod.metadata.name, f"started: {cmd}")
@@ -263,7 +273,10 @@ class FakeCluster:
             p.status.finish_time = self.now
             if phase == PodPhase.FAILED and not p.status.reason:
                 p.status.reason = f"ExitCode{exit_code}"
-        self.pods.mutate(pod.metadata.namespace, pod.metadata.name, mut)
+        try:
+            self.pods.mutate(pod.metadata.namespace, pod.metadata.name, mut)
+        except NotFound:
+            return  # deleted by the controller between list and mutate
         self.append_pod_log(
             pod.metadata.name, f"exited: code {exit_code} ({phase.value})"
         )
@@ -284,7 +297,12 @@ class FakeCluster:
                     p.status.reason = REASON_PREEMPTED
                     p.status.message = f"slice {slice_name} was preempted"
                     p.status.finish_time = self.now
-                self.pods.mutate(pod.metadata.namespace, pod.metadata.name, mut)
+                try:
+                    self.pods.mutate(
+                        pod.metadata.namespace, pod.metadata.name, mut
+                    )
+                except NotFound:
+                    continue  # deleted concurrently: nothing left to evict
                 failed.append(pod.metadata.name)
         self.record_event("Slice", slice_name, REASON_PREEMPTED,
                           f"evicted {len(failed)} pods")

@@ -183,6 +183,81 @@ class TestThreadedRestart:
             rt.stop()
 
 
+class TestThreadedSoak:
+    def test_threaded_workers_under_random_faults_converge(self):
+        """Wall-clock soak of the goroutine topology: 2 worker threads + a
+        ticker racing a seeded random fault schedule (preemptions, crashes,
+        controller swaps, job churn). Deterministic drain() cannot catch
+        informer-cache staleness under REAL concurrency — this does."""
+        import time as _time
+
+        rng = random.Random(0xBEEF)
+        rt = LocalRuntime(PodRunPolicy(start_delay=0.05, run_duration=0.4))
+        rt.cluster.slice_pool.add_pool("v5p-8", 3)
+        rt.start_threads(workers=2, tick_interval=0.02)
+        jobs = {}
+        counter = 0
+
+        def submit():
+            nonlocal counter
+            counter += 1
+            name = f"soak-{counter}"
+            kind = rng.choice(["gang", "loc"])
+            j = worker_job(name) if kind == "gang" else local_job(name)
+            jobs[name] = rt.submit(j)
+
+        try:
+            for _ in range(3):
+                submit()
+            end = _time.time() + 6.0
+            while _time.time() < end:
+                r = rng.random()
+                if r < 0.15:
+                    held = [s for s in rt.cluster.slice_pool.list()
+                            if s.holder]
+                    if held:
+                        s = rng.choice(held)
+                        rt.cluster.preempt_slice(s.name)
+                        rt.cluster.slice_pool.restore(s.name)
+                elif r < 0.30:
+                    running = [p for p in rt.cluster.pods.list("default")
+                               if p.status.phase == PodPhase.RUNNING]
+                    if running:
+                        p = rng.choice(running)
+                        try:
+                            rt.cluster.crash_pod("default", p.metadata.name)
+                        except Exception:
+                            pass  # finished/deleted under our feet: fine
+                elif r < 0.38:
+                    rt.restart_controller()
+                elif r < 0.5 and len(jobs) < 6:
+                    submit()
+                _time.sleep(rng.uniform(0.05, 0.2))
+
+            # storm over: everything must converge while threads keep running
+            deadline = _time.time() + 30
+            while _time.time() < deadline:
+                phases = [
+                    (j := rt.get_job("default", n)) and j.status.phase
+                    for n in jobs
+                ]
+                if all(p in (JobPhase.SUCCEEDED, JobPhase.FAILED)
+                       for p in phases):
+                    break
+                _time.sleep(0.1)
+            for n in jobs:
+                j = rt.get_job("default", n)
+                assert j is not None and j.status.phase in (
+                    JobPhase.SUCCEEDED, JobPhase.FAILED
+                ), (n, j and j.status.phase, j and j.status.reason)
+            # terminal jobs hold no slices; no pod survived its job's epoch
+            for n, j0 in jobs.items():
+                assert not rt.cluster.slice_pool.holdings(j0.metadata.uid)
+            assert not rt.cluster.services.list("default")
+        finally:
+            rt.stop()
+
+
 class TestWireChaos:
     def test_gang_survives_preemption_and_controller_swap_over_rest(self):
         """Operator-topology chaos: a gang job driven ONLY over the REST
