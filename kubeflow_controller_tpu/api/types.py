@@ -167,8 +167,12 @@ class TPUJobStatus:
     submit_time: float = 0.0
     all_running_time: float = 0.0
     completion_time: float = 0.0
-    # Count of gang restarts consumed (preemption recovery).
+    # Count of gang restarts consumed (preemption recovery). Every restart
+    # bumps this — it is the gang EPOCH counter (pod identity).
     restarts: int = 0
+    # How many of those restarts were voluntary spec resizes: they advance
+    # the epoch but must not consume the failure budget (max_restarts).
+    resizes: int = 0
 
     def set_condition(
         self,

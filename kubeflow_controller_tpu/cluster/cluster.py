@@ -192,7 +192,11 @@ class FakeCluster:
         except (InsufficientCapacity, KeyError) as e:
             self.record_event("Gang", group, "FailedScheduling", str(e))
             return
-        # Bind: pod (slice_index, host_index) -> slice host.
+        # Bind: pod (slice_index, host_index) -> slice host. All-or-nothing:
+        # if ANY member vanished (controller deleted it mid-admission), bind
+        # nobody — a partially-bound gang is exactly what this module exists
+        # to prevent. Slices stay held (allocate_gang is idempotent per
+        # uid); the next tick re-gangs the new epoch's pods.
         by_index = sorted(
             members,
             key=lambda p: (
@@ -200,6 +204,12 @@ class FakeCluster:
                 int(p.metadata.annotations.get(ANNOTATION_HOST_INDEX, 0)),
             ),
         )
+        if any(
+            self.pods.try_get(p.metadata.namespace, p.metadata.name) is None
+            for p in by_index
+        ):
+            return
+        bound: List[Pod] = []
         for pod in by_index:
             si = int(pod.metadata.annotations.get(ANNOTATION_SLICE_INDEX, 0))
             hi = int(pod.metadata.annotations.get(ANNOTATION_HOST_INDEX, 0))
@@ -212,11 +222,29 @@ class FakeCluster:
                     pod.metadata.namespace, pod.metadata.name, bind
                 )
             except NotFound:
-                continue  # deleted mid-admission; re-gang next tick
+                # A member vanished after the existence check: unwind the
+                # partial bind (no scheduled_at was set yet, so nothing has
+                # started) and retry from scratch next tick.
+                def unbind(p: Pod) -> None:
+                    p.spec.assigned_slice = ""
+                    p.status.host_ip = ""
+                for p2 in bound:
+                    try:
+                        self.pods.mutate(
+                            p2.metadata.namespace, p2.metadata.name, unbind
+                        )
+                    except NotFound:
+                        pass
+                return
+            bound.append(pod)
+        for pod in bound:
+            sl_name = self.pods.try_get(
+                pod.metadata.namespace, pod.metadata.name)
             self._runtime(pod).scheduled_at = self.now
             self.append_pod_log(
                 pod.metadata.name,
-                f"scheduled: slice {sl.name} host {hi % len(sl.hosts)}",
+                f"scheduled: slice "
+                f"{sl_name.spec.assigned_slice if sl_name else '?'}",
             )
         self.record_event(
             "Gang", group, "GangScheduled",

@@ -97,29 +97,47 @@ class SlicePool:
         ``controller.go:259-262`` applied to slices).
         """
         with self._lock:
+            # Holdings of a DIFFERENT accelerator type (spec change) are
+            # useless to this job: release them up front — before the
+            # capacity check — so they can never be leaked by an
+            # InsufficientCapacity exit, nor deadlock two type-swapping jobs.
+            for s in self._slices.values():
+                if (
+                    s.holder == job_uid
+                    and s.shape.accelerator_type != accelerator_type
+                ):
+                    s.holder = ""
             held = [
                 s for s in self._slices.values()
                 if s.holder == job_uid
                 and s.shape.accelerator_type == accelerator_type
                 and s.healthy
             ]
-            need = num_slices - len(held)
-            if need <= 0:
-                return held[:num_slices]
-            avail = [
-                s for s in self._slices.values()
-                if not s.holder and s.healthy
-                and s.shape.accelerator_type == accelerator_type
-            ]
-            if len(avail) < need:
-                raise InsufficientCapacity(
-                    f"need {need} more {accelerator_type} slices for job "
-                    f"{job_uid}, only {len(avail)} free"
-                )
-            granted = avail[:need]
-            for s in granted:
-                s.holder = job_uid
-            return held + granted
+            if len(held) >= num_slices:
+                keep = held[:num_slices]
+            else:
+                need = num_slices - len(held)
+                avail = [
+                    s for s in self._slices.values()
+                    if not s.holder and s.healthy
+                    and s.shape.accelerator_type == accelerator_type
+                ]
+                if len(avail) < need:
+                    raise InsufficientCapacity(
+                        f"need {need} more {accelerator_type} slices for job "
+                        f"{job_uid}, only {len(avail)} free"
+                    )
+                granted = avail[:need]
+                for s in granted:
+                    s.holder = job_uid
+                keep = held + granted
+            # Surplus same-type holdings (scale-down) go back to the pool —
+            # a resized gang must not leak capacity mid-job.
+            keep_names = {s.name for s in keep}
+            for s in self._slices.values():
+                if s.holder == job_uid and s.name not in keep_names:
+                    s.holder = ""
+            return keep
 
     def release(self, job_uid: str) -> int:
         """Free every slice a job holds; returns count released."""

@@ -286,6 +286,9 @@ class Controller:
             # rule on every future sync.
             def bump(j: TPUJob) -> None:
                 j.status.restarts += 1
+                if plan.resize:
+                    # voluntary: epoch advances, failure budget untouched
+                    j.status.resizes += 1
                 j.status.set_condition(
                     ConditionType.RECOVERING, ConditionStatus.TRUE,
                     "GangRestart", plan.restart_reason,

@@ -65,6 +65,9 @@ class Plan:
     # Gang restart initiated: controller bumps status.restarts + Recovering.
     gang_restart: bool = False
     restart_reason: str = ""
+    # This restart is a voluntary spec resize: bump status.resizes too so it
+    # does not count against the failure budget.
+    resize: bool = False
     # Terminal failure verdict (budget exhausted).
     fail_reason: str = ""
     # Job reached a terminal phase: release slices, delete services.
@@ -101,6 +104,17 @@ def _index_of(pod: Pod) -> int:
         return int(pod.metadata.labels.get(naming.LABEL_INDEX, "-1"))
     except ValueError:
         return -1
+
+
+def _gang_size_of(pod: Pod, default: int) -> int:
+    """Guarded like _epoch_of/_index_of: a corrupt annotation must degrade,
+    not wedge the job in requeue-backoff forever."""
+    try:
+        return int(pod.metadata.annotations.get(
+            ANNOTATION_GANG_SIZE, str(default)
+        ))
+    except ValueError:
+        return default
 
 
 def plan_job(job: TPUJob, pods: List[Pod], services: List[Service]) -> Plan:
@@ -157,7 +171,10 @@ def _plan_replicas(
             f"slice preempted ({len(preempted)} pods)" if preempted
             else f"{len(failed)} pod(s) failed"
         )
-        if epoch + 1 <= spec.max_restarts:
+        # Budget counts FAILURE restarts only: voluntary resizes advanced
+        # the epoch but must not make a later routine recovery terminal.
+        failures = epoch - job.status.resizes
+        if failures + 1 <= spec.max_restarts:
             # Gang restart: the whole epoch dies together. Slices are NOT
             # released — allocate_gang is idempotent per job uid, so healthy
             # held slices are reused warm and only the preempted one is
@@ -172,6 +189,34 @@ def _plan_replicas(
                 f"({spec.max_restarts} restarts)"
             )
             plan.note = f"terminal failure: {plan.fail_reason}"
+        return plan
+
+    # Spec resize: a gang whose pods were built for a different size or
+    # accelerator type cannot be patched incrementally — every pod's
+    # injected rendezvous contract (JAX_NUM_PROCESSES, slice/host ids, TPU
+    # resources, node selectors) is stale — so resize IS a gang restart.
+    # Detected from the annotations the pods were stamped with, or
+    # (scale-down) from any pod holding an out-of-range index. Voluntary:
+    # does not consume the failure budget (plan.resize).
+    accel = "" if is_local else spec.tpu.accelerator_type
+    stale_spec = [
+        p for p in current
+        if (not is_local and (
+            _gang_size_of(p, expected) != expected
+            or p.metadata.annotations.get(ANNOTATION_ACCELERATOR, accel)
+            != accel
+        )) or _index_of(p) >= expected
+    ]
+    if stale_spec:
+        reason = (
+            f"gang resized to {expected} pods on {accel or 'local'} "
+            f"({len(stale_spec)} pods built for the old spec)"
+        )
+        plan.gang_restart = True
+        plan.resize = True
+        plan.restart_reason = reason
+        plan.delete_pods.extend(p.metadata.name for p in current)
+        plan.note = f"gang restart (epoch {epoch} -> {epoch + 1}): {reason}"
         return plan
 
     # Healthy path: level-triggered completion toward the full gang.

@@ -380,3 +380,114 @@ class TestObservability:
             assert j.status.phase == JobPhase.SUCCEEDED
         finally:
             rt.stop()
+
+
+class TestResize:
+    def test_scale_down_restarts_gang_and_releases_surplus_slice(self):
+        """Editing the spec resizes the gang: every pod's injected
+        rendezvous contract (JAX_NUM_PROCESSES, slice ids) is stale, so
+        resize = gang restart — and surplus slices go back to the pool."""
+        rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=200))
+        rt.cluster.slice_pool.add_pool("v5p-8", 2)
+        rt.submit(worker_job(num_slices=2))
+        assert rt.wait_for_phase("default", "job", JobPhase.RUNNING, max_steps=10)
+        job = rt.get_job("default", "job")
+        assert len(rt.cluster.pods.list("default")) == 4
+        assert len(rt.cluster.slice_pool.holdings(job.metadata.uid)) == 2
+
+        job = rt.get_job("default", "job")
+        job.spec.replica_specs[0].tpu.num_slices = 1
+        rt.cluster.jobs.update(job)
+        assert rt.run_until(lambda: (
+            (j := rt.get_job("default", "job")) is not None
+            and j.status.restarts >= 1
+            and j.status.phase == JobPhase.RUNNING
+        ), max_steps=30)
+        job = rt.get_job("default", "job")
+        pods = [p for p in rt.cluster.pods.list("default")
+                if p.metadata.labels[naming.LABEL_EPOCH] == str(job.status.restarts)]
+        assert len(pods) == 2  # one v5p-8 slice = 2 hosts
+        for p in pods:
+            assert p.spec.containers[0].env["JAX_NUM_PROCESSES"] == "2"
+        assert len(rt.cluster.slice_pool.holdings(job.metadata.uid)) == 1
+        # the surplus slice is free for other jobs
+        assert len(rt.cluster.slice_pool.free("v5p-8")) == 1
+
+    def test_scale_up_restarts_gang(self):
+        rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=200))
+        rt.cluster.slice_pool.add_pool("v5p-8", 2)
+        rt.submit(worker_job(num_slices=1))
+        assert rt.wait_for_phase("default", "job", JobPhase.RUNNING, max_steps=10)
+
+        job = rt.get_job("default", "job")
+        job.spec.replica_specs[0].tpu.num_slices = 2
+        rt.cluster.jobs.update(job)
+        assert rt.run_until(lambda: (
+            (j := rt.get_job("default", "job")) is not None
+            and j.status.restarts >= 1
+            and j.status.phase == JobPhase.RUNNING
+        ), max_steps=30)
+        job = rt.get_job("default", "job")
+        pods = [p for p in rt.cluster.pods.list("default")
+                if p.metadata.labels[naming.LABEL_EPOCH] == str(job.status.restarts)]
+        assert len(pods) == 4
+        assert {p.spec.containers[0].env["TPU_SLICE_ID"] for p in pods} \
+            == {"0", "1"}
+        ev = [e[3] for e in rt.cluster.cluster_events]
+        assert "GangRestart" in ev
+
+    def test_resize_does_not_consume_failure_budget(self):
+        """A voluntary resize advances the epoch but must not make a later
+        routine preemption terminal (max_restarts counts failures only)."""
+        rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=200))
+        rt.cluster.slice_pool.add_pool("v5p-8", 3)
+        rt.submit(worker_job(num_slices=2, max_restarts=1))
+        assert rt.wait_for_phase("default", "job", JobPhase.RUNNING, max_steps=10)
+
+        job = rt.get_job("default", "job")
+        job.spec.replica_specs[0].tpu.num_slices = 1
+        rt.cluster.jobs.update(job)
+        assert rt.run_until(lambda: (
+            (j := rt.get_job("default", "job")) is not None
+            and j.status.restarts == 1 and j.status.phase == JobPhase.RUNNING
+        ), max_steps=30)
+        job = rt.get_job("default", "job")
+        assert job.status.resizes == 1
+
+        # now one real failure: still within budget (1 failure allowed)
+        held = rt.cluster.slice_pool.holdings(job.metadata.uid)[0].name
+        rt.cluster.preempt_slice(held)
+        rt.cluster.slice_pool.restore(held)
+        assert rt.run_until(lambda: (
+            (j := rt.get_job("default", "job")) is not None
+            and j.status.restarts == 2 and j.status.phase == JobPhase.RUNNING
+        ), max_steps=40), rt.get_job("default", "job").status.phase
+        job = rt.get_job("default", "job")
+        assert job.status.phase == JobPhase.RUNNING  # NOT Failed
+        assert job.status.resizes == 1
+
+    def test_accelerator_type_change_restarts_and_releases_old_slices(self):
+        rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=200))
+        rt.cluster.slice_pool.add_pool("v5p-8", 1)
+        rt.cluster.slice_pool.add_pool("v5e-8", 1)
+        rt.submit(worker_job(accel="v5p-8"))
+        assert rt.wait_for_phase("default", "job", JobPhase.RUNNING, max_steps=10)
+
+        job = rt.get_job("default", "job")
+        job.spec.replica_specs[0].tpu.accelerator_type = "v5e-8"
+        rt.cluster.jobs.update(job)
+        assert rt.run_until(lambda: (
+            (j := rt.get_job("default", "job")) is not None
+            and j.status.restarts >= 1 and j.status.phase == JobPhase.RUNNING
+        ), max_steps=40)
+        job = rt.get_job("default", "job")
+        held = rt.cluster.slice_pool.holdings(job.metadata.uid)
+        assert [s.shape.accelerator_type for s in held] == ["v5e-8"]
+        # the old v5p slice went back to the pool, not leaked
+        assert len(rt.cluster.slice_pool.free("v5p-8")) == 1
+        pods = [p for p in rt.cluster.pods.list("default")
+                if p.metadata.labels[naming.LABEL_EPOCH] == str(job.status.restarts)]
+        assert all(
+            p.spec.node_selector["cloud.google.com/gke-tpu-accelerator"]
+            == "v5e-8" for p in pods
+        )
