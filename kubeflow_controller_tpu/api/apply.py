@@ -1,0 +1,41 @@
+"""kubectl-apply semantics, shared by every server/client topology.
+
+Apply = create-or-update-SPEC-only: status and the stamped ``runtime_id``
+are controller-owned and must survive a re-applied manifest (a spec change
+on a live job then triggers the planner's voluntary gang resize). The
+read-merge-write loop retries on resourceVersion conflicts — the
+controller writes status concurrently, which is exactly the window a
+single-shot update would lose (reference punts with an unguarded
+whole-object PUT, ``pkg/controller/controller.go:630-636``).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Optional
+
+from kubeflow_controller_tpu.api.types import TPUJob
+from kubeflow_controller_tpu.cluster.store import Conflict
+
+
+def apply_job_spec(
+    get: Callable[[], Optional[TPUJob]],
+    create: Callable[[TPUJob], TPUJob],
+    update: Callable[[TPUJob], TPUJob],
+    new: TPUJob,
+    retries: int = 10,
+) -> TPUJob:
+    """Create ``new`` if absent, else replace the existing job's spec with
+    ``new.spec`` (keeping the stamped runtime id). Conflict-retried."""
+    for _ in range(retries):
+        cur = get()
+        if cur is None:
+            return create(new)
+        rid = cur.spec.runtime_id
+        cur.spec = copy.deepcopy(new.spec)
+        cur.spec.runtime_id = rid
+        try:
+            return update(cur)
+        except Conflict:
+            continue
+    raise Conflict(f"apply of {new.metadata.name}: retries exhausted")

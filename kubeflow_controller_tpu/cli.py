@@ -64,7 +64,7 @@ def _make_handler(rt: LocalRuntime):
             try:
                 parts = [p for p in self.path.split("/") if p]
                 body = {}
-                if method in ("POST", "DELETE"):
+                if method in ("POST", "PUT", "DELETE"):
                     n = int(self.headers.get("Content-Length") or 0)
                     if n:
                         body = json.loads(self.rfile.read(n))
@@ -94,6 +94,19 @@ def _make_handler(rt: LocalRuntime):
                 ns, name = parts[1], parts[2]
                 if method == "GET":
                     return job_to_dict(cluster.jobs.get(ns, name))
+                if method == "PUT":
+                    from kubeflow_controller_tpu.api.apply import (
+                        apply_job_spec,
+                    )
+
+                    new = job_from_dict(body)
+                    validate_job(new)
+                    return job_to_dict(apply_job_spec(
+                        get=lambda: cluster.jobs.try_get(ns, name),
+                        create=rt.submit,
+                        update=cluster.jobs.update,
+                        new=new,
+                    ))
                 if method == "DELETE":
                     rt.delete_job(ns, name)
                     return {"deleted": f"{ns}/{name}"}
@@ -164,6 +177,9 @@ def _make_handler(rt: LocalRuntime):
 
         def do_DELETE(self):
             self._route("DELETE")
+
+        def do_PUT(self):
+            self._route("PUT")
 
     return Handler
 
@@ -289,6 +305,17 @@ def cmd_submit(args) -> int:
     job = _load_manifest(args.filename)
     out = _req(args, "POST", "/jobs", job_to_dict(job))
     print(f"tpujob {out['metadata']['namespace']}/{out['metadata']['name']} created")
+    return 0
+
+
+def cmd_apply(args) -> int:
+    """Create-or-update from a manifest (kubectl-apply analog). A spec
+    change on a live job triggers a voluntary gang restart (resize)."""
+    job = _load_manifest(args.filename)
+    ns = job.metadata.namespace or "default"
+    out = _req(args, "PUT", f"/jobs/{ns}/{job.metadata.name}",
+               job_to_dict(job))
+    print(f"tpujob {out['metadata']['namespace']}/{out['metadata']['name']} applied")
     return 0
 
 
@@ -486,6 +513,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--pod-start-delay", type=float, default=1.0)
     s.add_argument("--pod-run-duration", type=float, default=10.0)
     s.set_defaults(fn=cmd_apiserver)
+
+    s = add_parser("apply", help="create-or-update a TPUJob from a manifest "
+                                 "(spec change on a live job = gang resize)")
+    s.add_argument("-f", "--filename", required=True)
+    s.set_defaults(fn=cmd_apply)
 
     s = add_parser("submit", help="submit a TPUJob manifest")
     s.add_argument("-f", "--filename", required=True)

@@ -209,7 +209,7 @@ class FakeCluster:
             for p in by_index
         ):
             return
-        bound: List[Pod] = []
+        bound: List[tuple] = []   # (pod, slice, host index)
         for pod in by_index:
             si = int(pod.metadata.annotations.get(ANNOTATION_SLICE_INDEX, 0))
             hi = int(pod.metadata.annotations.get(ANNOTATION_HOST_INDEX, 0))
@@ -228,7 +228,7 @@ class FakeCluster:
                 def unbind(p: Pod) -> None:
                     p.spec.assigned_slice = ""
                     p.status.host_ip = ""
-                for p2 in bound:
+                for p2, _, _ in bound:
                     try:
                         self.pods.mutate(
                             p2.metadata.namespace, p2.metadata.name, unbind
@@ -236,15 +236,12 @@ class FakeCluster:
                     except NotFound:
                         pass
                 return
-            bound.append(pod)
-        for pod in bound:
-            sl_name = self.pods.try_get(
-                pod.metadata.namespace, pod.metadata.name)
+            bound.append((pod, sl, hi))
+        for pod, sl, hi in bound:
             self._runtime(pod).scheduled_at = self.now
             self.append_pod_log(
                 pod.metadata.name,
-                f"scheduled: slice "
-                f"{sl_name.spec.assigned_slice if sl_name else '?'}",
+                f"scheduled: slice {sl.name} host {hi % len(sl.hosts)}",
             )
         self.record_event(
             "Gang", group, "GangScheduled",

@@ -251,6 +251,20 @@ class RestClusterClient:
         )
         return job_from_dict(out)
 
+    def apply_job(self, job: TPUJob) -> TPUJob:
+        """kubectl-apply over the wire: create-or-update-spec-only with
+        conflict retry (shared semantics: api.apply.apply_job_spec)."""
+        from kubeflow_controller_tpu.api.apply import apply_job_spec
+
+        return apply_job_spec(
+            get=lambda: self.get_job(
+                job.metadata.namespace, job.metadata.name
+            ),
+            create=self.create_job,
+            update=self.update_job,
+            new=job,
+        )
+
     # -- framework extensions ------------------------------------------------
 
     def record_event(self, kind: str, name: str, reason: str, message: str) -> None:

@@ -111,3 +111,30 @@ def test_logs_verb(daemon, manifest, capsys):
     assert rc == 0, out
     assert "scheduled: slice" in out
     assert "started:" in out
+
+
+def test_apply_creates_then_resizes(daemon, tmp_path, capsys):
+    """kubectl-apply analog: first apply creates; a spec edit on a live job
+    triggers a voluntary gang resize (new gang, new env contract)."""
+    import time
+
+    port = str(daemon)
+    p = tmp_path / "apply.yml"
+    p.write_text(JOB_YML)
+    assert cli.main(["apply", "--port", port, "-f", str(p)]) == 0
+    assert "applied" in capsys.readouterr().out
+
+    # live resize: 1 slice -> 2 slices
+    p.write_text(JOB_YML.replace("numSlices: 1", "numSlices: 2"))
+    deadline = time.time() + 20
+    ok = False
+    while time.time() < deadline and not ok:
+        assert cli.main(["apply", "--port", port, "-f", str(p)]) == 0
+        capsys.readouterr()
+        cli.main(["get", "clitest", "--port", port])
+        out = capsys.readouterr().out
+        import json as _json
+        j = _json.loads(out)
+        ok = (j.get("status", {}).get("resizes", 0) >= 1)
+        time.sleep(0.2)
+    assert ok, out

@@ -283,3 +283,40 @@ def test_controller_over_the_wire_local_job(cluster):
     finally:
         rt.stop()
         server.stop()
+
+
+def test_apply_job_over_rest(client, cluster):
+    """kubectl-apply semantics at the REST seam: create-or-update SPEC only
+    — status and runtime id survive, conflicts retried client-side."""
+    from kubeflow_controller_tpu.api import (
+        Container as C, ObjectMeta as OM, PodSpec as PS,
+        PodTemplateSpec, ReplicaSpec, ReplicaType, TPUJob, TPUJobSpec,
+        TPUSliceSpec,
+    )
+
+    def manifest(num_slices):
+        return TPUJob(
+            metadata=OM(name="apl", namespace="default"),
+            spec=TPUJobSpec(replica_specs=[ReplicaSpec(
+                replica_type=ReplicaType.WORKER,
+                template=PodTemplateSpec(spec=PS(containers=[
+                    C(name="t", image="i")
+                ])),
+                tpu=TPUSliceSpec(
+                    accelerator_type="v5p-8", num_slices=num_slices),
+            )]),
+        )
+
+    created = client.apply_job(manifest(1))
+    assert created.spec.replica_specs[0].tpu.num_slices == 1
+
+    # controller-side writes land in between: runtime id + status
+    j = cluster.jobs.get("default", "apl")
+    j.spec.runtime_id = "rid42"
+    j.status.restarts = 1
+    cluster.jobs.update(j)
+
+    updated = client.apply_job(manifest(2))
+    assert updated.spec.replica_specs[0].tpu.num_slices == 2
+    assert updated.spec.runtime_id == "rid42"      # controller-owned: kept
+    assert updated.status.restarts == 1            # status untouched
