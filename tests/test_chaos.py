@@ -11,6 +11,7 @@ and restart with total amnesia — and assert the level-trigger invariants
 hold throughout.
 """
 
+import os
 import random
 
 import pytest
@@ -330,8 +331,11 @@ class TestChaosSoak:
     churn — over thousands of simulated seconds, with invariants checked
     every tick and full convergence required once the storm stops."""
 
-    SEED = 0xC0FFEE
-    ITERATIONS = 500
+    # Scale the storm with TPUJOB_SOAK_ITERS (CI default keeps the suite
+    # fast; overnight/driver runs can go much longer). Both parse as plain
+    # decimal ints.
+    SEED = int(os.environ.get("TPUJOB_SOAK_SEED", str(0xC0FFEE)))
+    ITERATIONS = int(os.environ.get("TPUJOB_SOAK_ITERS", "500"))
 
     def check_invariants(self, rt, live_jobs):
         pods = rt.cluster.pods.list("default")
@@ -439,8 +443,10 @@ class TestChaosSoak:
             rt.step()
             self.check_invariants(rt, live_jobs)
 
-        # the schedule actually exercised every fault class
-        assert restarts and preemptions and crashes
+        # the schedule actually exercised every fault class (only a run
+        # long enough to make that statistically certain asserts it)
+        if self.ITERATIONS >= 300:
+            assert restarts and preemptions and crashes
 
         # storm over: clear faults, heal the pool, require convergence
         rt.cluster.faults.fail_pod_creates = 0
