@@ -40,14 +40,46 @@ CONFIGS = {
 
 def synthetic_lm(
     vocab_size: int, batch_size: int, seq_len: int, seed: int = 0,
+    pack: bool = False,
 ) -> Iterator[Dict[str, np.ndarray]]:
     """Deterministic repeating-pattern token stream (no egress here); same
-    shapes/dtypes as a tokenised corpus pipeline."""
+    shapes/dtypes as a tokenised corpus pipeline.
+
+    ``pack=True`` emits packed rows: several variable-length "documents"
+    per row with ``segment_ids`` (id 0 = tail padding), the shape a packed
+    pretraining pipeline produces. The model confines attention per
+    document, restarts RoPE, and masks boundary targets
+    (``transformer.next_token_loss``)."""
     rng = np.random.default_rng(seed)
     while True:
-        start = rng.integers(0, vocab_size, (batch_size, 1))
-        toks = (start + np.arange(seq_len + 1)) % vocab_size
-        yield {"tokens": toks.astype(np.int32)}
+        if not pack:
+            start = rng.integers(0, vocab_size, (batch_size, 1))
+            toks = (start + np.arange(seq_len + 1)) % vocab_size
+            yield {"tokens": toks.astype(np.int32)}
+            continue
+        if seq_len < 32:
+            raise ValueError("pack=True needs seq_len >= 32 (documents are "
+                             "at least 8 tokens; shorter rows would be "
+                             "mostly or entirely padding)")
+        toks = np.zeros((batch_size, seq_len + 1), np.int32)
+        segs = np.zeros((batch_size, seq_len + 1), np.int32)
+        for b in range(batch_size):
+            pos, seg = 0, 1
+            while pos < seq_len + 1:
+                doc_len = min(
+                    int(rng.integers(max(8, seq_len // 4), seq_len)),
+                    seq_len + 1 - pos,
+                )
+                if doc_len < 8:   # short tail: leave as padding
+                    break
+                start = int(rng.integers(0, vocab_size))
+                toks[b, pos:pos + doc_len] = (
+                    start + np.arange(doc_len)
+                ) % vocab_size
+                segs[b, pos:pos + doc_len] = seg
+                pos += doc_len
+                seg += 1
+        yield {"tokens": toks, "segment_ids": segs}
 
 
 def train(
@@ -61,6 +93,7 @@ def train(
     attn: str = "auto",
     model_dir: str = "",
     checkpoint_every: int = 0,
+    pack: bool = False,
 ) -> Dict[str, float]:
     ctx = ctx or ProcessContext.from_env()
     mlog = metrics_sink.from_context(ctx)
@@ -93,9 +126,12 @@ def train(
             lambda s: NamedSharding(mesh, s), tfm.param_specs(cfg)
         ),
     )
+    batch_sh = {"tokens": batch_sharding(mesh)}
+    if pack:
+        batch_sh["segment_ids"] = batch_sharding(mesh)
     data = device_prefetch(
-        synthetic_lm(cfg.vocab_size, global_batch, seq_len),
-        {"tokens": batch_sharding(mesh)},
+        synthetic_lm(cfg.vocab_size, global_batch, seq_len, pack=pack),
+        batch_sh,
         chunk=8,
     )
     last: Dict[str, float] = {}
@@ -133,6 +169,8 @@ def main(argv=None) -> int:
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--fsdp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--pack", action="store_true",
+                   help="packed documents per row (segment_ids; id 0 = pad)")
     args = p.parse_args(argv)
     ctx = initialize_from_env()
     metrics = train(
@@ -144,6 +182,7 @@ def main(argv=None) -> int:
         learning_rate=args.lr,
         mesh_config=MeshConfig(fsdp=args.fsdp, sp=args.sp, tp=args.tp),
         attn=args.attn,
+        pack=args.pack,
     )
     return 0 if metrics.get("final_step", 0) > 0 else 1
 

@@ -451,3 +451,23 @@ class TestMultiStepDispatch:
         # total 24 with K=7 chunks: 7+7+7+3 — the trim path
         state, _ = self._train(7, total=24)
         assert int(state.step) == 24
+
+
+def test_synthetic_lm_packed_stream_shape():
+    from kubeflow_controller_tpu.dataplane.entrypoints.lm import synthetic_lm
+
+    batch = next(synthetic_lm(256, 4, 64, pack=True))
+    toks, segs = batch["tokens"], batch["segment_ids"]
+    assert toks.shape == segs.shape == (4, 65)
+    for b in range(4):
+        row = segs[b]
+        nonzero = row[row > 0]
+        # documents are contiguous ascending ids starting at 1
+        assert list(np.unique(nonzero)) == list(range(1, nonzero.max() + 1))
+        # padding (id 0) appears only as a tail
+        zeros = np.where(row == 0)[0]
+        if len(zeros):
+            assert zeros[0] + len(zeros) == len(row)
+        # every document is long enough to train on
+        for s in np.unique(nonzero):
+            assert (row == s).sum() >= 8
