@@ -46,6 +46,8 @@ def main() -> None:
     p.add_argument("--d-ff", type=int, default=4096)
     p.add_argument("--vocab", type=int, default=32768)
     p.add_argument("--attn", default="auto", choices=["auto", "xla", "flash"])
+    p.add_argument("--moe-experts", type=int, default=0)
+    p.add_argument("--moe-top-k", type=int, default=2)
     p.add_argument("--peak-tflops", type=float, default=DEFAULT_PEAK_TFLOPS)
     p.add_argument("--no-remat", action="store_true")
     p.add_argument("--loss-chunk", type=int, default=0)
@@ -55,6 +57,7 @@ def main() -> None:
         vocab_size=args.vocab, d_model=args.d_model, n_layers=args.layers,
         n_heads=args.heads, n_kv_heads=args.kv_heads, d_ff=args.d_ff,
         max_seq=args.seq, attn_impl=args.attn, remat=not args.no_remat,
+        moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
     )
     params = tfm.init_params(cfg, jax.random.key(0))
     n_params = tfm.count_params(params)
@@ -102,6 +105,8 @@ def main() -> None:
         "devices": n_dev,
         "backend": jax.default_backend(),
         "attn": args.attn,
+        "moe_experts": args.moe_experts,
+        "moe_top_k": args.moe_top_k if args.moe_experts else 0,
         "seq": args.seq,
         "global_batch": args.batch,
         "loss_chunk": args.loss_chunk,

@@ -459,20 +459,31 @@ PEAK_TFLOPS_BF16_V5E = 197.0
 
 
 def train_flops_per_token(cfg: TransformerConfig, seq: int) -> float:
-    """Model FLOPs per trained token: 6*N matmul flops (fwd+bwd) plus the
-    causal-attention term 12*L*d_model*seq/2. The standard MFU accounting
-    (PaLM appendix B convention); used by bench.py and
-    benchmarks/transformer_bench.py so the two always agree."""
-    n_params = (
+    """Model FLOPs per trained token: 6*N_active matmul flops (fwd+bwd)
+    plus the causal-attention term 12*L*d_model*seq/2. The standard MFU
+    accounting (PaLM appendix B convention); used by bench.py and
+    benchmarks/transformer_bench.py so the two always agree.
+
+    MoE: only the routed top_k experts' FFN weights are ACTIVE per token
+    (plus the router matmul) — counting the full expert bank would inflate
+    MFU by E/top_k."""
+    if cfg.moe_experts:
+        ffn = (
+            cfg.moe_top_k * 3 * cfg.d_model * cfg.d_ff
+            + cfg.d_model * cfg.moe_experts      # router
+        )
+    else:
+        ffn = 3 * cfg.d_model * cfg.d_ff
+    n_active = (
         cfg.vocab_size * cfg.d_model * (1 if cfg.tie_embeddings else 2)
         + cfg.n_layers * (
             cfg.d_model * cfg.n_heads * cfg.head_dim * 2
             + cfg.d_model * cfg.n_kv_heads * cfg.head_dim * 2
-            + 3 * cfg.d_model * cfg.d_ff
+            + ffn
         )
     )
     attn = 12 * cfg.n_layers * cfg.d_model * (seq / 2)  # causal halves it
-    return 6 * n_params + attn
+    return 6 * n_active + attn
 
 
 # -- loss / glue for TrainLoop ------------------------------------------------
