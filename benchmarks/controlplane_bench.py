@@ -1,0 +1,111 @@
+"""Control-plane scale benchmark: N gang jobs through one controller.
+
+The reference's operational envelope was 2 reconcile workers against a
+handful of jobs (`cmd/controller/main.go:54`); it published no control-
+plane numbers at all. This measures the rebuild's reconcile machinery at
+scale on the deterministic fake cluster: submit `--jobs` gang jobs against
+a pool with capacity for all of them, tick the cluster, and report
+
+- submit -> gang-running latency percentiles (simulated seconds) — the
+  BASELINE.md north-star metric #2,
+- wall-clock reconcile throughput (syncs/sec) and per-sync latency from
+  the controller's own traces.
+
+Deterministic: simulated time, seeded names; wall numbers vary with host.
+
+Usage: python benchmarks/controlplane_bench.py [--jobs 100 --slices-each 1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from kubeflow_controller_tpu.api.core import (
+    Container, ObjectMeta, PodSpec, PodTemplateSpec,
+)
+from kubeflow_controller_tpu.api.types import (
+    JobPhase, ReplicaSpec, ReplicaType, TPUJob, TPUJobSpec, TPUSliceSpec,
+)
+from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
+from kubeflow_controller_tpu.runtime import LocalRuntime
+
+
+def make_job(i: int, num_slices: int) -> TPUJob:
+    return TPUJob(
+        metadata=ObjectMeta(name=f"scale-{i:04d}", namespace="default"),
+        spec=TPUJobSpec(replica_specs=[ReplicaSpec(
+            replica_type=ReplicaType.WORKER,
+            template=PodTemplateSpec(spec=PodSpec(containers=[
+                Container(name="trainer", image="jax:latest")
+            ])),
+            tpu=TPUSliceSpec(
+                accelerator_type="v5p-8", num_slices=num_slices),
+        )]),
+    )
+
+
+def pctile(xs, p):
+    """Nearest-rank percentile: smallest x with >= p% of samples <= x."""
+    xs = sorted(xs)
+    rank = max(1, -(-p * len(xs) // 100))   # ceil(p/100 * n), 1-based
+    return xs[min(len(xs), rank) - 1]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--jobs", type=int, default=100)
+    ap.add_argument("--slices-each", type=int, default=1)
+    ap.add_argument("--max-sim-steps", type=int, default=2000)
+    args = ap.parse_args()
+
+    rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=10 ** 9))
+    rt.cluster.slice_pool.add_pool(
+        "v5p-8", args.jobs * args.slices_each)
+
+    t_wall = time.perf_counter()
+    for i in range(args.jobs):
+        rt.submit(make_job(i, args.slices_each))
+
+    def all_running():
+        for i in range(args.jobs):
+            j = rt.get_job("default", f"scale-{i:04d}")
+            if j is None or j.status.phase != JobPhase.RUNNING:
+                return False
+        return True
+
+    ok = rt.run_until(all_running, dt=1.0, max_steps=args.max_sim_steps)
+    wall = time.perf_counter() - t_wall
+
+    lat = []
+    if ok:   # all_running_time defaults to 0.0 until a gang actually runs
+        for i in range(args.jobs):
+            j = rt.get_job("default", f"scale-{i:04d}")
+            lat.append(j.status.all_running_time - j.status.submit_time)
+    else:
+        lat = [float("nan")]
+    n_syncs = rt.controller.sync_count
+    print(json.dumps({
+        "jobs": args.jobs,
+        "slices_each": args.slices_each,
+        "all_running": ok,
+        "pods": len(rt.cluster.pods.list("default")),
+        "submit_to_running_sim_s": {
+            "p50": pctile(lat, 50), "p90": pctile(lat, 90),
+            "p100": pctile(lat, 100),
+        },
+        "syncs_total": n_syncs,
+        "wall_s": round(wall, 2),
+        "syncs_per_wall_sec": round(n_syncs / wall),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -85,8 +85,14 @@ class FakeCluster:
     def __init__(self, default_policy: Optional[PodRunPolicy] = None):
         # All stores stamp creation timestamps on the cluster's simulated
         # clock so control-plane latency metrics are internally consistent.
-        self.pods = ObjectStore("Pod", now_fn=lambda: self.now)
-        self.services = ObjectStore("Service", now_fn=lambda: self.now)
+        # Pods/services are indexed by owning-job label so per-job selector
+        # lists stay O(own pods) at any cluster size.
+        from kubeflow_controller_tpu.tpu.naming import LABEL_JOB
+
+        self.pods = ObjectStore(
+            "Pod", now_fn=lambda: self.now, index_labels=(LABEL_JOB,))
+        self.services = ObjectStore(
+            "Service", now_fn=lambda: self.now, index_labels=(LABEL_JOB,))
         self.jobs = ObjectStore("TPUJob", now_fn=lambda: self.now)
         self.slice_pool = SlicePool()
         self.faults = FaultInjector()

@@ -45,13 +45,42 @@ class ObjectStore:
     ``.deepcopy()``. Keys are ``namespace/name``.
     """
 
-    def __init__(self, kind: str, now_fn: Callable[[], float] = time.time):
+    def __init__(
+        self,
+        kind: str,
+        now_fn: Callable[[], float] = time.time,
+        index_labels: tuple = (),
+    ):
         self.kind = kind
         self._now_fn = now_fn
         self._lock = threading.RLock()
         self._objects: Dict[str, Any] = {}
         self._rv = 0
         self._listeners: List[Listener] = []
+        # Label indexes (client-go Indexer analog): selector lists on an
+        # indexed key touch only matching objects instead of scanning the
+        # namespace — the difference between O(jobs) and O(jobs^2) total
+        # reconcile work at controller scale (benchmarks/controlplane_bench).
+        self._index_labels = tuple(index_labels)
+        self._index: Dict[str, Dict[str, set]] = {
+            lk: {} for lk in self._index_labels
+        }
+
+    def _index_add(self, key: str, obj: Any) -> None:
+        for lk in self._index_labels:
+            v = obj.metadata.labels.get(lk)
+            if v is not None:
+                self._index[lk].setdefault(v, set()).add(key)
+
+    def _index_remove(self, key: str, obj: Any) -> None:
+        for lk in self._index_labels:
+            v = obj.metadata.labels.get(lk)
+            if v is not None:
+                bucket = self._index[lk].get(v)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del self._index[lk][v]
 
     # -- watch ---------------------------------------------------------------
 
@@ -109,6 +138,7 @@ class ObjectStore:
                 meta.creation_timestamp = self._now_fn()
             stored = obj.deepcopy()
             self._objects[key] = stored
+            self._index_add(key, stored)
             self._emit(
                 WatchEvent(EventType.ADDED, self.kind, stored.deepcopy())
             )
@@ -146,7 +176,9 @@ class ObjectStore:
             obj.metadata.resource_version = self._rv
             old = cur
             stored = obj.deepcopy()
+            self._index_remove(key, old)
             self._objects[key] = stored
+            self._index_add(key, stored)
             self._emit(WatchEvent(
                 EventType.MODIFIED, self.kind,
                 stored.deepcopy(), old.deepcopy(),
@@ -170,6 +202,7 @@ class ObjectStore:
             obj = self._objects.pop(key, None)
             if obj is None:
                 raise NotFound(f"{self.kind} {key}")
+            self._index_remove(key, obj)
             self._rv += 1
             self._emit(WatchEvent(EventType.DELETED, self.kind, obj.deepcopy()))
             return obj
@@ -182,8 +215,17 @@ class ObjectStore:
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[Any]:
         with self._lock:
+            candidates = self._objects
+            if label_selector:
+                for lk in self._index_labels:
+                    if lk in label_selector:
+                        keys = self._index[lk].get(label_selector[lk], set())
+                        candidates = {
+                            k: self._objects[k] for k in keys
+                        }
+                        break
             out = []
-            for key, obj in self._objects.items():
+            for key, obj in candidates.items():
                 if namespace is not None and obj.metadata.namespace != namespace:
                     continue
                 if label_selector and not selector_matches(label_selector, obj.metadata.labels):

@@ -98,7 +98,9 @@ class Controller:
 
         self.queue = make_queue()
         self.expectations = make_expectations()
-        self.traces: List[SyncTrace] = []
+        self.traces: List[SyncTrace] = []   # ring buffer (last 1000)
+        self.sync_count = 0                 # total syncs, never truncated
+        self._count_lock = threading.Lock()
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
 
@@ -193,6 +195,8 @@ class Controller:
         finally:
             self.queue.done(key)
             trace.duration = self.opts.now_fn() - trace.start
+            with self._count_lock:   # worker threads increment concurrently
+                self.sync_count += 1
             self.traces.append(trace)
             del self.traces[:-1000]
 
