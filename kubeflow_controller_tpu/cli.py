@@ -350,6 +350,12 @@ def cmd_describe(args) -> int:
     print(f"Name:       {meta['name']}\nNamespace:  {meta.get('namespace')}")
     print(f"Phase:      {st.get('phase')}    Reason: {st.get('reason', '')}")
     print(f"RuntimeID:  {j['spec'].get('runtimeId', '')}")
+    restarts = st.get("restarts", 0)
+    resizes = st.get("resizes", 0)
+    if restarts or resizes:
+        print(f"Restarts:   {restarts} total "
+              f"({resizes} voluntary resizes, "
+              f"{restarts - resizes} failure recoveries)")
     sub, run = st.get("submitTime"), st.get("allRunningTime")
     if sub and run:
         print(f"Submit -> all-running: {run - sub:.2f}s"
@@ -567,7 +573,15 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # `tpujobctl ... | head` closing the pipe is not an error; mimic
+        # well-behaved CLIs (suppress the traceback, exit 0).
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
 
 
 if __name__ == "__main__":

@@ -78,50 +78,58 @@ def _gang_env(process_env: dict, port: int) -> dict:
     return env
 
 
-def test_two_process_gang_rendezvous_and_training():
-    job = TPUJob(
-        metadata=ObjectMeta(name="mnist-dist", namespace="default"),
+def _make_job(name: str, runtime_id: str, num_slices: int) -> TPUJob:
+    return TPUJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
         spec=TPUJobSpec(
-            runtime_id="r2test",
-            replica_specs=[
-                ReplicaSpec(
-                    replica_type=ReplicaType.WORKER,
-                    template=PodTemplateSpec(spec=PodSpec(containers=[
-                        Container(name="trainer", image="jax:latest")
-                    ])),
-                    # v5p-8 = 2 host VMs -> a 2-process gang.
-                    tpu=TPUSliceSpec(accelerator_type="v5p-8", num_slices=1),
-                )
-            ],
+            runtime_id=runtime_id,
+            replica_specs=[ReplicaSpec(
+                replica_type=ReplicaType.WORKER,
+                template=PodTemplateSpec(spec=PodSpec(containers=[
+                    Container(name="trainer", image="jax:latest")
+                ])),
+                # v5p-8 = 2 host VMs per slice.
+                tpu=TPUSliceSpec(
+                    accelerator_type="v5p-8", num_slices=num_slices),
+            )],
         ),
     )
+
+
+def _run_gang(job: TPUJob, num_slices: int) -> dict:
+    """Spawn the full gang as REAL subprocesses (slice-major rank order,
+    matching coordinator_env's process_id = slice_id*hosts + host_id) and
+    return {rank: parsed RESULT}."""
     shape = slice_shape("v5p-8")
-    assert shape.num_hosts == 2
     port = _free_port()
-
     procs = []
-    for host_id in range(shape.num_hosts):
-        env = _gang_env(
-            coordinator_env(job, shape, num_slices=1, slice_id=0,
-                            host_id=host_id),
-            port,
-        )
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", WORKER],
-            env=env, cwd=REPO_ROOT,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        ))
-
+    for slice_id in range(num_slices):
+        for host_id in range(shape.num_hosts):
+            env = _gang_env(
+                coordinator_env(job, shape, num_slices=num_slices,
+                                slice_id=slice_id, host_id=host_id),
+                port,
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", WORKER],
+                env=env, cwd=REPO_ROOT,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            ))
     results = {}
-    for host_id, p in enumerate(procs):
+    for rank, p in enumerate(procs):
         out, err = p.communicate(timeout=280)
         assert p.returncode == 0, (
-            f"process {host_id} rc={p.returncode}\nstdout:\n{out[-2000:]}\n"
+            f"rank {rank} rc={p.returncode}\nstdout:\n{out[-2000:]}\n"
             f"stderr:\n{err[-4000:]}"
         )
         line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
         assert line, out[-2000:]
-        results[host_id] = json.loads(line[-1][len("RESULT "):])
+        results[rank] = json.loads(line[-1][len("RESULT "):])
+    return results
+
+
+def test_two_process_gang_rendezvous_and_training():
+    results = _run_gang(_make_job("mnist-dist", "r2test", 1), num_slices=1)
 
     # Rank identity flowed through: env -> ProcessContext -> jax.distributed.
     assert results[0]["process_id"] == 0
@@ -133,3 +141,26 @@ def test_two_process_gang_rendezvous_and_training():
     # Data-parallel training is rank-consistent: every process computed the
     # same replicated loss from the same global batches.
     assert results[0]["loss"] == pytest.approx(results[1]["loss"], rel=1e-6)
+
+
+def test_four_process_multislice_rendezvous():
+    """2 slices x 2 hosts = a 4-process MULTI-SLICE gang: slice/host ids
+    map onto the global process ids the controller computes, MEGASCALE env
+    is present, and all four ranks train rank-consistently — the executed
+    proof behind BASELINE config #5's topology (the dryrun only compiles
+    it single-process)."""
+    job = _make_job("ms", "r4test", 2)
+    shape = slice_shape("v5p-8")
+    # the MEGASCALE contract is part of what this test proves
+    env = coordinator_env(job, shape, num_slices=2, slice_id=1, host_id=0)
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+
+    results = _run_gang(job, num_slices=2)
+
+    for rank in range(4):
+        assert results[rank]["process_id"] == rank   # slice-major order
+        assert results[rank]["process_count"] == 4
+        assert results[rank]["device_count"] == 8
+        assert results[rank]["final_step"] == 10
+    losses = {r["loss"] for r in results.values()}
+    assert len(losses) == 1 or max(losses) - min(losses) < 1e-6
