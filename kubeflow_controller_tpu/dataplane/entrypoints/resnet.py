@@ -64,7 +64,7 @@ def train(
     bs = batch_sharding(mesh)
     data = device_prefetch(
         resnet.synthetic_imagenet(
-            global_batch, image_size, model.num_classes
+            global_batch, image_size, model.num_classes, uint8=True,
         ),
         {"image": bs, "label": bs},
         chunk=4,

@@ -162,3 +162,32 @@ class TestBert:
         np.testing.assert_allclose(
             np.asarray(ref), np.asarray(out), atol=2e-4
         )
+
+
+def test_resnet_uint8_wire_format():
+    """uint8 byte images normalize on device (in fp32) and match the
+    float path's logits for the same underlying pixel values."""
+    import jax
+    import numpy as np
+
+    from kubeflow_controller_tpu.models import resnet
+
+    model = resnet.resnet_tiny()
+    rng = np.random.default_rng(0)
+    u8 = rng.integers(0, 256, (2, 32, 32, 3), dtype=np.uint8)
+    f32 = u8.astype(np.float32) / 127.5 - 1.0
+    variables = model.init(jax.random.key(0), jnp.asarray(f32), train=False)
+    out_f = model.apply(variables, jnp.asarray(f32), train=False)
+    out_u = model.apply(variables, jnp.asarray(u8), train=False)
+    np.testing.assert_allclose(
+        np.asarray(out_f), np.asarray(out_u), rtol=1e-3, atol=1e-3
+    )
+    # and the uint8 stream trains through the stateful loss
+    batch = next(resnet.synthetic_imagenet(4, 32, 10, uint8=True))
+    assert batch["image"].dtype == np.uint8
+    loss_fn = resnet.make_loss_fn(model)
+    params, bstats = resnet.make_init_fn(model, 32)(jax.random.key(0))
+    (loss, _), g = jax.value_and_grad(loss_fn, has_aux=True)(
+        params, bstats, jax.tree.map(jnp.asarray, batch), None
+    )
+    assert np.isfinite(float(loss))
