@@ -167,11 +167,6 @@ class TestBert:
 def test_resnet_uint8_wire_format():
     """uint8 byte images normalize on device (in fp32) and match the
     float path's logits for the same underlying pixel values."""
-    import jax
-    import numpy as np
-
-    from kubeflow_controller_tpu.models import resnet
-
     model = resnet.resnet_tiny()
     rng = np.random.default_rng(0)
     u8 = rng.integers(0, 256, (2, 32, 32, 3), dtype=np.uint8)

@@ -1,0 +1,176 @@
+"""Direct unit tests for the two pure decision modules.
+
+The checker table mirrors the reference's ONLY test
+(``pkg/checker/checker_test.go:10-38``, table-driven IsLocalJob); the
+updater tests exercise ``compute_status`` as a pure function — the
+reference's ShouldUpdate logic (``pkg/controller/updater``) had no tests
+at all, and phases like Failed were unreachable there (SURVEY.md §8).
+"""
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodTemplateSpec,
+)
+from kubeflow_controller_tpu.api.types import (
+    ChiefSpec,
+    ConditionStatus,
+    ConditionType,
+    JobPhase,
+    ReplicaSpec,
+    ReplicaState,
+    ReplicaType,
+    TerminationPolicySpec,
+    TPUJob,
+    TPUJobSpec,
+    TPUSliceSpec,
+)
+from kubeflow_controller_tpu.checker import checker
+from kubeflow_controller_tpu.cluster.slices import TPUSlice
+from kubeflow_controller_tpu.api.topology import slice_shape
+from kubeflow_controller_tpu.tpu import naming
+from kubeflow_controller_tpu.updater import compute_status
+
+
+def job(rtype=ReplicaType.WORKER, chief=None, num_slices=1):
+    tp = TerminationPolicySpec(chief=chief) if chief else None
+    spec = ReplicaSpec(
+        replica_type=rtype,
+        template=PodTemplateSpec(spec=PodSpec(containers=[
+            Container(name="t", image="i")])),
+        termination_policy=tp,
+    )
+    if rtype == ReplicaType.WORKER:
+        spec.tpu = TPUSliceSpec(accelerator_type="v5p-8",
+                                num_slices=num_slices)
+    return TPUJob(
+        metadata=ObjectMeta(name="j", namespace="d", creation_timestamp=5.0),
+        spec=TPUJobSpec(runtime_id="rid", replica_specs=[spec]),
+    )
+
+
+def pod(index, phase, epoch=0, reason="", slice_name="s0"):
+    p = Pod(metadata=ObjectMeta(name=f"p{index}", namespace="d", labels={
+        naming.LABEL_INDEX: str(index),
+        naming.LABEL_EPOCH: str(epoch),
+    }))
+    p.status.phase = phase
+    p.status.reason = reason
+    p.spec.assigned_slice = slice_name
+    return p
+
+
+# -- checker (parity table: the reference's entire test surface) -------------
+
+@pytest.mark.parametrize("rtype,expected", [
+    (ReplicaType.LOCAL, True),
+    (ReplicaType.WORKER, False),
+])
+def test_is_local_job(rtype, expected):
+    assert checker.is_local_job(job(rtype)) is expected
+
+
+def test_assess_health_classification():
+    sick = TPUSlice(name="s-bad", shape=slice_shape("v5p-8"), healthy=False)
+    ok = TPUSlice(name="s-ok", shape=slice_shape("v5p-8"))
+    pods = [
+        pod(0, PodPhase.FAILED, reason="Preempted"),
+        pod(1, PodPhase.FAILED, reason="ExitCode1"),
+        pod(2, PodPhase.RUNNING, slice_name="s-bad"),   # at risk
+        pod(3, PodPhase.RUNNING, slice_name="s-ok"),    # healthy
+    ]
+    r = checker.assess_health(pods, [sick, ok])
+    assert r.preempted_pods == ["p0"]
+    assert r.failed_pods == ["p1"]
+    assert r.unhealthy_slices == ["s-bad"]
+    assert r.at_risk_pods == ["p2"]
+    assert r.needs_recovery
+    assert not checker.assess_health([pods[3]], [ok]).needs_recovery
+
+
+# -- updater ------------------------------------------------------------------
+
+def test_pending_then_running_then_succeeded():
+    j = job()   # v5p-8 x1 = 2 worker pods expected
+    assert compute_status(j, [pod(0, PodPhase.PENDING, slice_name="")], 10.0)
+    assert j.status.phase == JobPhase.PENDING
+    assert j.status.submit_time == 5.0   # creation timestamp
+    assert j.status.get_condition(ConditionType.GANG_SCHEDULED).status \
+        == ConditionStatus.FALSE
+
+    pods = [pod(0, PodPhase.RUNNING), pod(1, PodPhase.RUNNING)]
+    assert compute_status(j, pods, 12.0)
+    assert j.status.phase == JobPhase.RUNNING
+    assert j.status.all_running_time == 12.0
+    assert j.status.get_condition(ConditionType.READY).status \
+        == ConditionStatus.TRUE
+    hist = j.status.replica_statuses[0]
+    assert hist.state == ReplicaState.RUNNING
+    assert hist.states == {ReplicaState.RUNNING: 2}
+
+    pods = [pod(0, PodPhase.SUCCEEDED), pod(1, PodPhase.SUCCEEDED)]
+    assert compute_status(j, pods, 20.0)
+    assert j.status.phase == JobPhase.SUCCEEDED
+    assert j.status.completion_time == 20.0
+    # terminal is sticky: a later pod change cannot resurrect the job
+    assert not compute_status(j, [pod(0, PodPhase.RUNNING)], 30.0) or \
+        j.status.phase == JobPhase.SUCCEEDED
+
+
+def test_fail_reason_reaches_failed_phase():
+    j = job()
+    compute_status(j, [pod(0, PodPhase.FAILED, reason="ExitCode9")], 9.0,
+                   fail_reason="restart budget exhausted")
+    assert j.status.phase == JobPhase.FAILED
+    assert "budget" in j.status.reason
+    assert j.status.completion_time == 9.0
+
+
+def test_chief_policy_decides_success():
+    j = job(chief=ChiefSpec(replica_name="Worker", replica_index=0))
+    pods = [pod(0, PodPhase.SUCCEEDED), pod(1, PodPhase.RUNNING)]
+    compute_status(j, pods, 10.0)
+    assert j.status.phase == JobPhase.SUCCEEDED
+
+
+def test_recovering_sticky_until_new_gang_runs():
+    j = job()
+    compute_status(j, [pod(0, PodPhase.RUNNING), pod(1, PodPhase.RUNNING)],
+                   5.0)
+    compute_status(j, [pod(0, PodPhase.FAILED, reason="Preempted")], 6.0,
+                   recovering=True)
+    assert j.status.phase == JobPhase.RECOVERING
+    j.status.restarts = 1
+    # new epoch's gang still pending: Recovering holds (not Pending)
+    compute_status(j, [pod(0, PodPhase.PENDING, epoch=1, slice_name="")], 7.0)
+    assert j.status.phase == JobPhase.RECOVERING
+    # full new gang running: healthy again
+    compute_status(
+        j, [pod(0, PodPhase.RUNNING, epoch=1),
+            pod(1, PodPhase.RUNNING, epoch=1)], 8.0)
+    assert j.status.phase == JobPhase.RUNNING
+    assert j.status.get_condition(ConditionType.RECOVERING).status \
+        == ConditionStatus.FALSE
+
+
+def test_no_change_returns_false():
+    j = job()
+    pods = [pod(0, PodPhase.RUNNING), pod(1, PodPhase.RUNNING)]
+    assert compute_status(j, pods, 10.0) is True
+    # identical inputs: nothing changed, no write should happen
+    assert compute_status(j, pods, 10.0) is False
+
+
+def test_stale_epoch_pods_ignored():
+    j = job()
+    j.status.restarts = 2
+    old = [pod(0, PodPhase.FAILED, epoch=0), pod(1, PodPhase.FAILED, epoch=1)]
+    compute_status(j, old, 10.0)
+    # no current-epoch pods at all: histogram empty, phase pending
+    assert j.status.phase == JobPhase.PENDING
+    assert j.status.replica_statuses[0].states == {}
