@@ -387,15 +387,45 @@ def cmd_delete(args) -> int:
 
 
 def cmd_logs(args) -> int:
-    items = _req(
-        args, "GET", f"/logs/{args.namespace}/{args.name}"
-    )["items"]
-    if not items:
+    def fetch():
+        return _req(
+            args, "GET", f"/logs/{args.namespace}/{args.name}"
+        )["items"]
+
+    items = fetch()
+    if not items and not args.follow:
         print(f"no logs for {args.namespace}/{args.name}")
         return 1
     for e in items:
         print(f"t={e['time']:.1f} {e['line']}")
-    return 0
+    if not args.follow:
+        return 0
+    # -f: kubectl-logs-style follow. The server aggregates multi-pod logs
+    # re-sorted by time each fetch, so index-tracking would drop or repeat
+    # lines when a slower pod's line sorts in earlier — dedupe by the
+    # (time, line) pair instead. Stop on Ctrl-C or once the job is gone
+    # and the stream has drained.
+    seen = {(e["time"], e["line"]) for e in items}
+    idle = 0
+    try:
+        while True:
+            time.sleep(args.poll_interval)
+            new = 0
+            for e in fetch():
+                key = (e["time"], e["line"])
+                if key not in seen:
+                    seen.add(key)
+                    new += 1
+                    print(f"t={e['time']:.1f} {e['line']}", flush=True)
+            idle = 0 if new else idle + 1
+            if idle >= 10:
+                try:
+                    _req(args, "GET",
+                         f"/jobs/{args.namespace}/{args.name}")
+                except SystemExit:
+                    return 0   # job deleted and log stream drained
+    except KeyboardInterrupt:
+        return 0
 
 
 def cmd_events(args) -> int:
@@ -541,6 +571,11 @@ def build_parser() -> argparse.ArgumentParser:
         s = add_parser(nm, help=hp)
         s.add_argument("name")
         s.add_argument("-n", "--namespace", default="default")
+        if nm == "logs":
+            s.add_argument("-f", "--follow", action="store_true",
+                           help="stream new lines until Ctrl-C "
+                                "(or the job is deleted)")
+            s.add_argument("--poll-interval", type=float, default=0.5)
         s.set_defaults(fn=fn)
 
     add_parser("events", help="recent cluster events").set_defaults(

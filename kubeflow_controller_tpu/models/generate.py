@@ -187,17 +187,51 @@ def prefill(
     return logits, cache
 
 
+def _filter_logits(
+    logits: jax.Array, top_k: int = 0, top_p: float = 1.0,
+) -> jax.Array:
+    """Nucleus/top-k filtering, static shapes (jit-safe).
+
+    top_k > 0 keeps only the k highest logits; top_p < 1 keeps the smallest
+    set of tokens whose softmax mass reaches p (always at least the argmax).
+    Filtered positions go to -inf so sampling never picks them."""
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose PRECEDING mass is < p; the top token is always
+        # kept explicitly so p -> 0 degenerates to greedy, not to -inf-
+        # everywhere (which would sample token 0)
+        keep_sorted = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1], bool),
+             cum[..., :-1] < top_p], axis=-1,
+        )
+        # threshold = smallest kept logit
+        thresh = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+            keepdims=True,
+        )
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return logits
+
+
 def generate(
     cfg: TransformerConfig,
     params: Params,
     prompt: jax.Array,          # [B, S_prompt] int32
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     rng: Optional[jax.Array] = None,
     max_seq: Optional[int] = None,
 ) -> jax.Array:
-    """Greedy (temperature 0) or sampled generation. Returns [B, new] int32.
-    Jit-compatible: fixed trip counts, static shapes."""
+    """Greedy (temperature 0) or sampled generation with optional top-k /
+    nucleus (top-p) filtering. Returns [B, new] int32. Jit-compatible:
+    fixed trip counts, static shapes."""
     b, s_prompt = prompt.shape
     max_seq = max_seq or cfg.max_seq
     if s_prompt + max_new_tokens > max_seq:
@@ -211,7 +245,13 @@ def generate(
     def pick(logits, key):
         if temperature <= 0.0:
             return logits.argmax(-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits / temperature, axis=-1)
+        # Temperature first, THEN nucleus/top-k: the p-mass must be
+        # computed on the distribution actually sampled from (matches the
+        # standard implementations callers tune against).
+        logits = _filter_logits(
+            logits / temperature, top_k=top_k, top_p=top_p
+        )
+        return jax.random.categorical(key, logits, axis=-1)
 
     def body(carry, key):
         logits, cache = carry

@@ -138,3 +138,31 @@ def test_apply_creates_then_resizes(daemon, tmp_path, capsys):
         ok = (j.get("status", {}).get("resizes", 0) >= 1)
         time.sleep(0.2)
     assert ok, out
+
+
+def test_logs_follow_streams_and_exits_on_delete(daemon, manifest, capsys):
+    """logs -f: prints lines as they appear and returns once the job is
+    deleted and the stream drains."""
+    import threading as _threading
+    import time
+
+    port = str(daemon)
+    assert cli.main(["submit", "--port", port, "-f", manifest]) == 0
+    capsys.readouterr()
+
+    rc = {}
+    t = _threading.Thread(target=lambda: rc.update(code=cli.main(
+        ["logs", "clitest", "--port", port, "-f", "--poll-interval", "0.05"]
+    )))
+    t.start()
+    # let the job run to completion, then delete it -> follower must exit
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        out = capsys.readouterr().out  # drain target: scheduled+exited lines
+        if "exited" in out:
+            break
+        time.sleep(0.1)
+    cli.main(["delete", "clitest", "--port", port])
+    t.join(timeout=15)
+    assert not t.is_alive(), "follower did not exit after job deletion"
+    assert rc.get("code") == 0

@@ -107,3 +107,36 @@ def test_inference_params_cast():
     t1 = gen.generate(cfg, cast, prompt, max_new_tokens=8)
     # bf16 compute dominates either way; greedy tokens must agree
     assert (t0 == t1).mean() > 0.9
+
+
+def test_filter_logits_top_k():
+    logits = jnp.asarray([[1.0, 3.0, 2.0, 0.0]])
+    out = gen._filter_logits(logits, top_k=2)
+    assert out[0, 1] == 3.0 and out[0, 2] == 2.0
+    assert np.isneginf(np.asarray(out)[0, [0, 3]]).all()
+
+
+def test_filter_logits_top_p():
+    # softmax of [0, big, 0, 0] concentrates mass on index 1: tiny p keeps
+    # ONLY the argmax; p=1 keeps everything
+    logits = jnp.asarray([[0.0, 10.0, 0.0, 0.0]])
+    out = gen._filter_logits(logits, top_p=0.5)
+    keep = np.isfinite(np.asarray(out))[0]
+    assert keep.tolist() == [False, True, False, False]
+    out_all = gen._filter_logits(logits, top_p=1.0)
+    assert np.isfinite(np.asarray(out_all)).all()
+
+
+def test_sampled_generation_respects_top_k():
+    cfg = tfm.tiny_config(max_seq=64)
+    params = tfm.init_params(cfg, jax.random.key(0))
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    greedy = gen.generate(cfg, params, prompt, max_new_tokens=8)
+    # top_k=1 sampling IS greedy regardless of temperature
+    k1 = gen.generate(cfg, params, prompt, max_new_tokens=8,
+                      temperature=1.0, top_k=1, rng=jax.random.key(7))
+    assert (np.asarray(greedy) == np.asarray(k1)).all()
+    # unconstrained hot sampling diverges from greedy somewhere
+    hot = gen.generate(cfg, params, prompt, max_new_tokens=8,
+                       temperature=5.0, rng=jax.random.key(7))
+    assert (np.asarray(hot) != np.asarray(greedy)).any()
