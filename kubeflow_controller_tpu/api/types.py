@@ -173,6 +173,9 @@ class TPUJobStatus:
     # How many of those restarts were voluntary spec resizes: they advance
     # the epoch but must not consume the failure budget (max_restarts).
     resizes: int = 0
+    # When the last gang restart fired (controller clock) — drives the
+    # exponential failure-restart backoff.
+    last_restart_time: float = 0.0
 
     def set_condition(
         self,

@@ -402,21 +402,26 @@ def cmd_logs(args) -> int:
         return 0
     # -f: kubectl-logs-style follow. The server aggregates multi-pod logs
     # re-sorted by time each fetch, so index-tracking would drop or repeat
-    # lines when a slower pod's line sorts in earlier — dedupe by the
-    # (time, line) pair instead. Stop on Ctrl-C or once the job is gone
-    # and the stream has drained.
-    seen = {(e["time"], e["line"]) for e in items}
+    # lines when a slower pod's line sorts in earlier. Track per-(time,
+    # line) COUNTS instead — a legitimately repeated identical line (same
+    # coarse timestamp) must still print once per occurrence. Stop on
+    # Ctrl-C or once the job is gone and the stream has drained.
+    from collections import Counter
+
+    emitted = Counter((e["time"], e["line"]) for e in items)
     idle = 0
     try:
         while True:
             time.sleep(args.poll_interval)
             new = 0
+            running = Counter()
             for e in fetch():
                 key = (e["time"], e["line"])
-                if key not in seen:
-                    seen.add(key)
+                running[key] += 1
+                if running[key] > emitted[key]:
                     new += 1
                     print(f"t={e['time']:.1f} {e['line']}", flush=True)
+            emitted = running
             idle = 0 if new else idle + 1
             if idle >= 10:
                 try:

@@ -59,6 +59,15 @@ class ControllerOptions:
     resync_period: float = 30.0           # reference: 30s informers
     now_fn: Callable[[], float] = time.time
     rng: Optional[random.Random] = None
+    # Exponential backoff between FAILURE gang restarts (in now_fn units):
+    # a crash-looping workload must not re-gang as fast as reconcile can
+    # run. First restart is immediate; the Nth failure waits
+    # min(base * 2^(N-1), max). Voluntary resizes are never delayed.
+    restart_backoff_base: float = 10.0
+    restart_backoff_max: float = 300.0
+    # Wall-clock requeue cadence while a backoff is pending (now_fn may be
+    # a simulated clock, so the queue polls and re-checks it).
+    backoff_poll: float = 0.05
 
 
 @dataclass
@@ -284,6 +293,35 @@ class Controller:
         acted = False
         ns = job.metadata.namespace
 
+        if plan.gang_restart and not plan.resize:
+            # Failure-restart backoff: a crash-looping workload re-gangs on
+            # an exponential schedule, not at reconcile speed. (Voluntary
+            # resizes skip this.) The whole restart — including deletion of
+            # the failed epoch — defers, so the evidence stays visible.
+            st = job.status
+            failures = st.restarts - st.resizes
+            if failures > 0 and st.last_restart_time:
+                # exponent capped before materializing 2**N: huge
+                # max_restarts must saturate at the max, not overflow
+                backoff = min(
+                    self.opts.restart_backoff_base
+                    * (2 ** min(failures - 1, 60)),
+                    self.opts.restart_backoff_max,
+                )
+                remaining = (
+                    st.last_restart_time + backoff - self.opts.now_fn()
+                )
+                if remaining > 0:
+                    # Real clock: the queue's delay IS the same timebase, so
+                    # requeue exactly once. Simulated clock: poll and
+                    # re-check it.
+                    delay = (
+                        remaining if self.opts.now_fn is time.time
+                        else min(remaining, self.opts.backoff_poll)
+                    )
+                    self.queue.add_after(key, delay)
+                    return False
+
         if plan.gang_restart:
             # Persist the epoch bump FIRST so a crash between delete and
             # create cannot strand the job: stale-epoch pods are deleted by
@@ -291,8 +329,11 @@ class Controller:
             def bump(j: TPUJob) -> None:
                 j.status.restarts += 1
                 if plan.resize:
-                    # voluntary: epoch advances, failure budget untouched
+                    # voluntary: epoch advances; failure budget AND the
+                    # failure-backoff clock stay untouched
                     j.status.resizes += 1
+                else:
+                    j.status.last_restart_time = self.opts.now_fn()
                 j.status.set_condition(
                     ConditionType.RECOVERING, ConditionStatus.TRUE,
                     "GangRestart", plan.restart_reason,

@@ -194,6 +194,8 @@ class TestThreadedSoak:
 
         rng = random.Random(0xBEEF)
         rt = LocalRuntime(PodRunPolicy(start_delay=0.05, run_duration=0.4))
+        rt.controller.opts.restart_backoff_base = 0.2
+        rt.controller.opts.backoff_poll = 0.005
         rt.cluster.slice_pool.add_pool("v5p-8", 3)
         rt.start_threads(workers=2, tick_interval=0.02)
         jobs = {}
@@ -379,6 +381,9 @@ class TestChaosSoak:
     def test_randomized_fault_soak_converges(self):
         rng = random.Random(self.SEED)
         rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=6))
+        # repeated-failure jobs must still converge inside the test budget
+        rt.controller.opts.restart_backoff_base = 0.5
+        rt.controller.opts.backoff_poll = 0.005
         rt.cluster.slice_pool.add_pool("v5p-8", 4)
 
         live_jobs = {}

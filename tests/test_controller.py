@@ -491,3 +491,63 @@ class TestResize:
             p.spec.node_selector["cloud.google.com/gke-tpu-accelerator"]
             == "v5e-8" for p in pods
         )
+
+
+class TestRestartBackoff:
+    def test_crash_loop_restarts_follow_exponential_schedule(self):
+        """Failure restarts back off exponentially (sim clock): restart 1
+        fires immediately, restart 2 waits >= base, restart 3 >= 2*base."""
+        rt = LocalRuntime(PodRunPolicy(start_delay=0, run_duration=1,
+                                       exit_code=1))
+        rt.controller.opts.restart_backoff_base = 4.0
+        rt.controller.opts.backoff_poll = 0.005
+        rt.cluster.slice_pool.add_pool("v5p-8", 1)
+        rt.submit(worker_job(max_restarts=3))
+
+        times = {}
+
+        def capture():
+            j = rt.get_job("default", "job")
+            if j and j.status.restarts not in times and j.status.restarts:
+                times[j.status.restarts] = j.status.last_restart_time
+            return j is not None and j.status.phase == JobPhase.FAILED
+
+        assert rt.run_until(capture, dt=0.5, max_steps=400)
+        assert set(times) == {1, 2, 3}
+        # restart 2 waited >= base after restart 1; restart 3 >= 2*base
+        assert times[2] - times[1] >= 4.0
+        assert times[3] - times[2] >= 8.0
+
+    def test_resize_not_delayed_by_backoff(self):
+        """A resize fires immediately even while a FAILURE backoff window
+        is pending (the gate must exempt plan.resize, not just rely on
+        failures==0)."""
+        rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=200))
+        rt.controller.opts.restart_backoff_base = 1000.0  # huge
+        rt.cluster.slice_pool.add_pool("v5p-8", 2)
+        rt.submit(worker_job(num_slices=2))
+        assert rt.wait_for_phase("default", "job", JobPhase.RUNNING, max_steps=10)
+
+        # one real failure restart first, so the backoff clock is armed
+        job = rt.get_job("default", "job")
+        held = rt.cluster.slice_pool.holdings(job.metadata.uid)[0].name
+        rt.cluster.preempt_slice(held)
+        rt.cluster.slice_pool.restore(held)
+        assert rt.run_until(lambda: (
+            (j := rt.get_job("default", "job")) is not None
+            and j.status.restarts == 1 and j.status.phase == JobPhase.RUNNING
+        ), max_steps=30)
+
+        job = rt.get_job("default", "job")
+        failure_restart_at = job.status.last_restart_time
+        job.spec.replica_specs[0].tpu.num_slices = 1
+        rt.cluster.jobs.update(job)
+        # voluntary resize fires without waiting out the (huge) backoff
+        assert rt.run_until(lambda: (
+            (j := rt.get_job("default", "job")) is not None
+            and j.status.resizes == 1 and j.status.phase == JobPhase.RUNNING
+        ), max_steps=30)
+        # and the failure-backoff clock was NOT restarted by the resize
+        j = rt.get_job("default", "job")
+        assert j.status.restarts == 2
+        assert j.status.last_restart_time == failure_restart_at
