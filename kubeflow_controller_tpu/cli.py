@@ -386,58 +386,91 @@ def cmd_delete(args) -> int:
     return 0
 
 
+def _follow(fetch, key, show, poll_interval, initial, on_idle=None) -> int:
+    """Shared poll-follow loop for logs/events -f.
+
+    The server re-sorts aggregated streams each fetch and returns a
+    bounded tail, so index-tracking would drop or repeat entries; track
+    per-key COUNTS so a legitimately repeated identical entry still prints
+    once per occurrence. ``on_idle`` (if given) is called after 10 quiet
+    polls and may return an exit code to stop."""
+    from collections import Counter
+
+    emitted = Counter(key(e) for e in initial)
+    idle = 0
+    try:
+        while True:
+            time.sleep(poll_interval)
+            new = 0
+            running = Counter()
+            for e in fetch():
+                running[key(e)] += 1
+                if running[key(e)] > emitted[key(e)]:
+                    new += 1
+                    show(e)
+            emitted = running
+            idle = 0 if new else idle + 1
+            if idle >= 10 and on_idle is not None:
+                rc = on_idle()
+                if rc is not None:
+                    return rc
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_logs(args) -> int:
     def fetch():
         return _req(
             args, "GET", f"/logs/{args.namespace}/{args.name}"
         )["items"]
 
+    def show(e):
+        print(f"t={e['time']:.1f} {e['line']}", flush=True)
+
     items = fetch()
     if not items and not args.follow:
         print(f"no logs for {args.namespace}/{args.name}")
         return 1
     for e in items:
-        print(f"t={e['time']:.1f} {e['line']}")
+        show(e)
     if not args.follow:
         return 0
-    # -f: kubectl-logs-style follow. The server aggregates multi-pod logs
-    # re-sorted by time each fetch, so index-tracking would drop or repeat
-    # lines when a slower pod's line sorts in earlier. Track per-(time,
-    # line) COUNTS instead — a legitimately repeated identical line (same
-    # coarse timestamp) must still print once per occurrence. Stop on
-    # Ctrl-C or once the job is gone and the stream has drained.
-    from collections import Counter
 
-    emitted = Counter((e["time"], e["line"]) for e in items)
-    idle = 0
-    try:
-        while True:
-            time.sleep(args.poll_interval)
-            new = 0
-            running = Counter()
-            for e in fetch():
-                key = (e["time"], e["line"])
-                running[key] += 1
-                if running[key] > emitted[key]:
-                    new += 1
-                    print(f"t={e['time']:.1f} {e['line']}", flush=True)
-            emitted = running
-            idle = 0 if new else idle + 1
-            if idle >= 10:
-                try:
-                    _req(args, "GET",
-                         f"/jobs/{args.namespace}/{args.name}")
-                except SystemExit:
-                    return 0   # job deleted and log stream drained
-    except KeyboardInterrupt:
-        return 0
+    def on_idle():
+        try:
+            _req(args, "GET", f"/jobs/{args.namespace}/{args.name}")
+            return None
+        except SystemExit:
+            return 0   # job deleted and log stream drained
+
+    return _follow(
+        fetch, lambda e: (e["time"], e["line"]), show,
+        args.poll_interval, items, on_idle,
+    )
 
 
 def cmd_events(args) -> int:
-    for e in _req(args, "GET", "/events")["items"]:
+    def fetch():
+        items = _req(args, "GET", "/events")["items"]
+        if args.name:
+            items = [e for e in items if args.name in e["name"]]
+        return items
+
+    def show(e):
         print(f"t={e['time']:.1f} [{e['kind']}/{e['name']}] "
-              f"{e['reason']}: {e['message']}")
-    return 0
+              f"{e['reason']}: {e['message']}", flush=True)
+
+    items = fetch()
+    for e in items:
+        show(e)
+    if not args.follow:
+        return 0
+    # -f: the kubectl get events --watch analog.
+    return _follow(
+        fetch,
+        lambda e: (e["time"], e["kind"], e["name"], e["reason"]),
+        show, args.poll_interval, items,
+    )
 
 
 def cmd_traces(args) -> int:
@@ -583,8 +616,12 @@ def build_parser() -> argparse.ArgumentParser:
             s.add_argument("--poll-interval", type=float, default=0.5)
         s.set_defaults(fn=fn)
 
-    add_parser("events", help="recent cluster events").set_defaults(
-        fn=cmd_events)
+    s = add_parser("events", help="recent cluster events")
+    s.add_argument("name", nargs="?", default="",
+                   help="only events whose object name contains this")
+    s.add_argument("-f", "--follow", action="store_true")
+    s.add_argument("--poll-interval", type=float, default=0.5)
+    s.set_defaults(fn=cmd_events)
     add_parser("traces", help="recent reconcile traces").set_defaults(
         fn=cmd_traces)
     add_parser("pools", help="TPU slice inventory").set_defaults(
