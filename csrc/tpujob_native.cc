@@ -142,13 +142,30 @@ class WorkQueue {
   }
 
  private:
+  bool InFifoLocked(const std::string& key) const {
+    for (const auto& k : fifo_) {
+      if (k == key) return true;
+    }
+    return false;
+  }
+
   void AddLocked(const std::string& key) {
     if (shutdown_) return;
     if (processing_.count(key)) {
       redo_.insert(key);
       return;
     }
-    if (queued_.count(key)) return;
+    if (queued_.count(key)) {
+      // Parked in the delayed heap (AddAfter): an immediate add BEATS the
+      // pending delay — k8s workqueue semantics. Without this, a key
+      // parked for a long TTL/backoff swallows event-driven re-enqueues
+      // until the delay fires.
+      if (!InFifoLocked(key)) {
+        fifo_.push_back(key);
+        cv_.notify_one();
+      }
+      return;
+    }
     queued_.insert(key);
     fifo_.push_back(key);
     cv_.notify_one();
@@ -165,7 +182,8 @@ class WorkQueue {
         if (processing_.count(key)) {
           redo_.insert(key);
           queued_.erase(key);
-        } else {
+        } else if (!InFifoLocked(key)) {
+          // (an immediate Add may have promoted it already)
           fifo_.push_back(key);
         }
       }

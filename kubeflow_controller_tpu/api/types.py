@@ -137,6 +137,11 @@ class TPUJobSpec:
     log_dir: str = ""
     export_dir: str = ""
     replica_specs: List[ReplicaSpec] = field(default_factory=list)
+    # Auto-delete the job (and thus its pods/services, via the deleted-job
+    # cleanup path) this many controller-clock seconds after it reaches a
+    # terminal phase. None = keep forever (the k8s Job / training-operator
+    # ttlSecondsAfterFinished semantics).
+    ttl_seconds_after_finished: Optional[int] = None
 
 
 @dataclass

@@ -30,6 +30,10 @@ def validate_job(job: types.TPUJob) -> None:
     if not job.metadata.namespace:
         errs.append("metadata.namespace is required")
 
+    ttl = job.spec.ttl_seconds_after_finished
+    if ttl is not None and ttl < 0:
+        errs.append("spec.ttlSecondsAfterFinished must be >= 0")
+
     specs = job.spec.replica_specs
     if not specs:
         errs.append("spec.replicaSpecs must not be empty")

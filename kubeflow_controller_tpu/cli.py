@@ -465,10 +465,13 @@ def cmd_events(args) -> int:
         show(e)
     if not args.follow:
         return 0
-    # -f: the kubectl get events --watch analog.
+    # -f: the kubectl get events --watch analog. The key includes the
+    # message (like logs -f includes the line) so distinct events sharing a
+    # timestamp/kind/name/reason still count separately.
     return _follow(
         fetch,
-        lambda e: (e["time"], e["kind"], e["name"], e["reason"]),
+        lambda e: (e["time"], e["kind"], e["name"], e["reason"],
+                   e["message"]),
         show, args.poll_interval, items,
     )
 

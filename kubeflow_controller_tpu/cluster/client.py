@@ -35,6 +35,7 @@ class ClusterClient(Protocol):
 
     def get_job(self, namespace: str, name: str) -> Optional[TPUJob]: ...
     def update_job(self, job: TPUJob) -> TPUJob: ...
+    def delete_job(self, namespace: str, name: str) -> None: ...
 
     def record_event(self, kind: str, name: str, reason: str, message: str) -> None: ...
     def release_slices(self, job_uid: str) -> int: ...
@@ -101,6 +102,11 @@ class FakeClusterClient:
 
     def update_job(self, job: TPUJob) -> TPUJob:
         return self.cluster.jobs.update(job)
+
+    def delete_job(self, namespace: str, name: str) -> None:
+        self.cluster.jobs.delete(namespace, name)
+        self.record_event("TPUJob", name, "SuccessfulDelete",
+                          f"deleted job {name}")
 
     # -- misc ---------------------------------------------------------------
 

@@ -269,6 +269,26 @@ class Controller:
         )
         if plan.recycle or plan.fail_reason:
             self.client.release_slices(job.metadata.uid)
+
+        # ttlSecondsAfterFinished: auto-delete terminal jobs after the TTL
+        # (k8s Job / training-operator semantics). Deletion flows through
+        # the deleted-job cleanup path, removing pods/services too.
+        ttl = job.spec.ttl_seconds_after_finished
+        if ttl is not None and job.is_done():
+            cur = self.client.get_job(namespace, name)
+            # guard on the phase, not on completion_time's truthiness —
+            # t=0.0 is a legitimate completion time on a simulated clock
+            if cur is not None and cur.is_done():
+                remaining = cur.status.completion_time + ttl - now
+                if remaining <= 0:
+                    try:
+                        self.client.delete_job(namespace, name)
+                    except NotFound:
+                        pass
+                    trace.outcome = "ttl-deleted"
+                    return
+                self._requeue_after(key, remaining)
+
         if trace.outcome == "":
             trace.outcome = "executed" if executed else "steady"
         trace.note = plan.note
@@ -312,14 +332,7 @@ class Controller:
                     st.last_restart_time + backoff - self.opts.now_fn()
                 )
                 if remaining > 0:
-                    # Real clock: the queue's delay IS the same timebase, so
-                    # requeue exactly once. Simulated clock: poll and
-                    # re-check it.
-                    delay = (
-                        remaining if self.opts.now_fn is time.time
-                        else min(remaining, self.opts.backoff_poll)
-                    )
-                    self.queue.add_after(key, delay)
+                    self._requeue_after(key, remaining)
                     return False
 
         if plan.gang_restart:
@@ -390,6 +403,17 @@ class Controller:
             self.client.record_event(
                 "TPUJob", job.metadata.name, "JobFailed", plan.fail_reason)
         return acted
+
+    def _requeue_after(self, key: str, remaining: float) -> None:
+        """Requeue a key once ``remaining`` now_fn-seconds elapse. With the
+        real clock the queue's monotonic delay is the same timebase, so one
+        exact requeue suffices; a simulated clock cannot be slept on, so
+        poll at backoff_poll wall-seconds and re-check."""
+        delay = (
+            remaining if self.opts.now_fn is time.time
+            else min(remaining, self.opts.backoff_poll)
+        )
+        self.queue.add_after(key, delay)
 
     def _mutate_job(self, ns: str, name: str, fn: Callable[[TPUJob], None]) -> None:
         """Conflict-retried read-modify-write against the job store."""

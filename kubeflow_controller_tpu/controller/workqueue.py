@@ -50,6 +50,13 @@ class RateLimitingQueue:
                 self._redo.add(item)
                 return
             if item in self._queued:
+                if item not in self._queue:
+                    # Parked in the delayed heap (add_after): an immediate
+                    # add BEATS the pending delay — k8s workqueue semantics.
+                    # Without this, a key parked for a long TTL/backoff
+                    # would swallow event-driven re-enqueues until it fires.
+                    self._queue.append(item)
+                    self._cond.notify()
                 return
             self._queued.add(item)
             self._queue.append(item)
@@ -96,7 +103,8 @@ class RateLimitingQueue:
                 if item in self._processing:
                     self._redo.add(item)
                     self._queued.discard(item)
-                else:
+                elif item not in self._queue:
+                    # (an immediate add may have promoted it already)
                     self._queue.append(item)
         return (self._delayed[0][0] - now) if self._delayed else None
 

@@ -551,3 +551,66 @@ class TestRestartBackoff:
         j = rt.get_job("default", "job")
         assert j.status.restarts == 2
         assert j.status.last_restart_time == failure_restart_at
+
+
+class TestTTLAfterFinished:
+    def test_terminal_job_auto_deleted_after_ttl(self):
+        rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=2))
+        rt.controller.opts.backoff_poll = 0.005
+        rt.cluster.slice_pool.add_pool("v5p-8", 1)
+        j = worker_job()
+        j.spec.ttl_seconds_after_finished = 10
+        rt.submit(j)
+        assert rt.wait_for_phase("default", "job", JobPhase.SUCCEEDED)
+        done_at = rt.cluster.now
+        # still present shortly after completion
+        rt.step(steps=2)
+        assert rt.get_job("default", "job") is not None
+        # gone once the TTL elapses; pods cleaned up via the deletion path
+        assert rt.run_until(
+            lambda: rt.get_job("default", "job") is None, max_steps=60,
+        ), rt.cluster.now
+        assert rt.cluster.now - done_at >= 10
+        rt.step(steps=3)
+        assert not rt.cluster.pods.list("default")
+        assert not rt.cluster.services.list("default")
+
+    def test_no_ttl_keeps_job(self):
+        rt = LocalRuntime(PodRunPolicy(start_delay=0, run_duration=1))
+        rt.submit(local_job())
+        assert rt.wait_for_phase("default", "mnist", JobPhase.SUCCEEDED)
+        rt.step(steps=30)
+        assert rt.get_job("default", "mnist") is not None
+
+    def test_ttl_zero_deletes_immediately(self):
+        rt = LocalRuntime(PodRunPolicy(start_delay=0, run_duration=1))
+        j = local_job()
+        j.spec.ttl_seconds_after_finished = 0
+        rt.submit(j)
+        assert rt.run_until(
+            lambda: rt.get_job("default", "mnist") is None, max_steps=40,
+        )
+
+    def test_negative_ttl_rejected(self):
+        from kubeflow_controller_tpu.api.validation import (
+            ValidationError, validate_job,
+        )
+        j = local_job()
+        j.spec.ttl_seconds_after_finished = -1
+        with pytest.raises(ValidationError, match="ttlSecondsAfterFinished"):
+            validate_job(j)
+
+
+def test_add_beats_pending_add_after():
+    """k8s workqueue semantics: an immediate add() promotes a key parked
+    in the delayed heap (long TTL/backoff) instead of being swallowed —
+    otherwise a deleted job's cleanup would wait out the full delay."""
+    q = RateLimitingQueue()
+    q.add_after("k", 3600.0)
+    assert q.get(timeout=0.05) is None   # parked
+    q.add("k")                           # event arrives: promote NOW
+    assert q.get(timeout=0.5) == "k"
+    q.done("k")
+    # the stale heap entry must not double-deliver later
+    q.add("k2"); assert q.get(timeout=0.5) == "k2"; q.done("k2")
+    assert q.get(timeout=0.05) is None

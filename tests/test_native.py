@@ -174,3 +174,16 @@ def test_native_queue_throughput_sanity():
     t_native = drive(NativeRateLimitingQueue())
     t_py = drive(RateLimitingQueue())
     assert t_native < t_py * 3, (t_native, t_py)
+
+
+@pytest.mark.parametrize("Queue", queue_impls())
+def test_add_beats_pending_add_after(Queue):
+    """k8s semantics in BOTH implementations: an immediate add promotes a
+    key parked in the delayed heap instead of being swallowed."""
+    q = Queue()
+    q.add_after("k", 3600.0)
+    assert q.get(timeout=0.05) is None
+    q.add("k")
+    assert q.get(timeout=0.5) == "k"
+    q.done("k")
+    assert q.get(timeout=0.05) is None
