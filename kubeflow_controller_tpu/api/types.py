@@ -53,6 +53,9 @@ class JobPhase(str, enum.Enum):
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
     RECOVERING = "Recovering"
+    # Voluntarily paused via spec.suspend (Kueue-style): pods deleted,
+    # slices released, checkpoint kept; unsuspending re-gangs and resumes.
+    SUSPENDED = "Suspended"
 
 
 class ConditionType(str, enum.Enum):
@@ -62,6 +65,9 @@ class ConditionType(str, enum.Enum):
     GANG_SCHEDULED = "GangScheduled"
     READY = "Ready"
     RECOVERING = "Recovering"
+    # Voluntarily paused via spec.suspend (Kueue-style): pods deleted,
+    # slices released, checkpoint kept; unsuspending re-gangs and resumes.
+    SUSPENDED = "Suspended"
     RECYCLING = "Recycling"
 
 
@@ -137,6 +143,10 @@ class TPUJobSpec:
     log_dir: str = ""
     export_dir: str = ""
     replica_specs: List[ReplicaSpec] = field(default_factory=list)
+    # Pause the job without deleting it (k8s Job / training-operator
+    # spec.suspend): pods are torn down and slices released; flipping back
+    # re-gangs the same epoch and resumes from the model_dir checkpoint.
+    suspend: bool = False
     # Auto-delete the job (and thus its pods/services, via the deleted-job
     # cleanup path) this many controller-clock seconds after it reaches a
     # terminal phase. None = keep forever (the k8s Job / training-operator

@@ -110,6 +110,17 @@ def _make_handler(rt: LocalRuntime):
                 if method == "DELETE":
                     rt.delete_job(ns, name)
                     return {"deleted": f"{ns}/{name}"}
+            if (
+                parts[:1] == ["jobs"] and len(parts) == 4
+                and method == "POST" and parts[3] in ("suspend", "resume")
+            ):
+                ns, name, verb = parts[1], parts[2], parts[3]
+
+                def set_suspend(j, want=(verb == "suspend")):
+                    j.spec.suspend = want
+                return job_to_dict(
+                    cluster.jobs.mutate(ns, name, set_suspend)
+                )
             if parts[:1] == ["pods"] and method == "GET":
                 ns = parts[1] if len(parts) > 1 else None
                 return {"items": [
@@ -449,6 +460,20 @@ def cmd_logs(args) -> int:
     )
 
 
+def cmd_suspend(args) -> int:
+    out = _req(args, "POST",
+               f"/jobs/{args.namespace}/{args.name}/suspend")
+    print(f"tpujob {args.namespace}/{args.name} suspended "
+          f"(runtimeId {out['spec'].get('runtimeId', '')})")
+    return 0
+
+
+def cmd_resume(args) -> int:
+    _req(args, "POST", f"/jobs/{args.namespace}/{args.name}/resume")
+    print(f"tpujob {args.namespace}/{args.name} resumed")
+    return 0
+
+
 def cmd_events(args) -> int:
     def fetch():
         items = _req(args, "GET", "/events")["items"]
@@ -608,6 +633,9 @@ def build_parser() -> argparse.ArgumentParser:
         ("describe", cmd_describe, "human-readable job status"),
         ("delete", cmd_delete, "delete a job"),
         ("logs", cmd_logs, "pod (or whole-job) logs"),
+        ("suspend", cmd_suspend,
+         "pause a job (pods torn down, slices released, checkpoint kept)"),
+        ("resume", cmd_resume, "unsuspend: re-gang and resume"),
     ):
         s = add_parser(nm, help=hp)
         s.add_argument("name")

@@ -266,8 +266,14 @@ class Controller:
             namespace, name, pods, now,
             fail_reason=plan.fail_reason,
             recovering=plan.gang_restart,
+            suspended=plan.suspend,
         )
-        if plan.recycle or plan.fail_reason:
+        # Suspend releases slices only on a sync that actually acted (or
+        # once no pods remain): with expectations unsatisfied the deletes
+        # were skipped, and freeing slices still occupied by live pods
+        # would invite double-occupancy.
+        suspend_released = plan.suspend and (satisfied or not pods)
+        if plan.recycle or plan.fail_reason or suspend_released:
             self.client.release_slices(job.metadata.uid)
 
         # ttlSecondsAfterFinished: auto-delete terminal jobs after the TTL
@@ -430,7 +436,7 @@ class Controller:
 
     def _update_status(
         self, ns: str, name: str, pods: List[Pod], now: float,
-        fail_reason: str, recovering: bool,
+        fail_reason: str, recovering: bool, suspended: bool = False,
     ) -> None:
         # Write only when something changed (the reference's ShouldUpdate
         # contract) — an unconditional write would emit MODIFIED, re-enqueue
@@ -440,7 +446,8 @@ class Controller:
             if job is None:
                 return
             changed = compute_status(
-                job, pods, now, fail_reason=fail_reason, recovering=recovering
+                job, pods, now, fail_reason=fail_reason,
+                recovering=recovering, suspended=suspended,
             )
             if not changed:
                 return

@@ -72,6 +72,8 @@ class Plan:
     fail_reason: str = ""
     # Job reached a terminal phase: release slices, delete services.
     recycle: bool = False
+    # spec.suspend: tear down pods/services, release slices, keep the job.
+    suspend: bool = False
     needs_runtime_id: bool = False
     note: str = ""
 
@@ -125,6 +127,15 @@ def plan_job(job: TPUJob, pods: List[Pod], services: List[Service]) -> Plan:
 
     if job.is_done():
         return _plan_recycle(job, pods, services)
+
+    if job.spec.suspend:
+        # Voluntary pause (k8s Job / training-operator spec.suspend): tear
+        # everything down but keep the job object and its checkpoint;
+        # unsuspending replans the same epoch's gang from scratch.
+        plan = Plan(suspend=True, note="suspended by spec")
+        plan.delete_pods = [p.metadata.name for p in pods]
+        plan.delete_services = [s.metadata.name for s in services]
+        return plan
 
     local = job.local_spec()
     if local is not None:

@@ -59,6 +59,7 @@ def compute_status(
     now: float,
     fail_reason: str = "",
     recovering: bool = False,
+    suspended: bool = False,
 ) -> bool:
     """Recompute ``job.status`` in place from current-epoch pods.
 
@@ -141,6 +142,11 @@ def compute_status(
             st.set_condition(
                 ConditionType.RECYCLING, ConditionStatus.TRUE,
                 "JobSucceeded", "releasing slices and services", now=now)
+        elif suspended:
+            st.phase = JobPhase.SUSPENDED
+            st.set_condition(
+                ConditionType.SUSPENDED, ConditionStatus.TRUE,
+                "SpecSuspended", "pods torn down, slices released", now=now)
         elif recovering:
             st.phase = JobPhase.RECOVERING
             st.set_condition(
@@ -159,8 +165,15 @@ def compute_status(
                 st.phase = JobPhase.RECOVERING
             else:
                 st.phase = JobPhase.PENDING
+        if not suspended:
+            sus = st.get_condition(ConditionType.SUSPENDED)
+            if sus is not None and sus.status == ConditionStatus.TRUE:
+                st.set_condition(
+                    ConditionType.SUSPENDED, ConditionStatus.FALSE,
+                    "Resumed", now=now)
 
-    if st.phase in (JobPhase.PENDING, JobPhase.RUNNING, JobPhase.RECOVERING):
+    if st.phase in (JobPhase.PENDING, JobPhase.RUNNING, JobPhase.RECOVERING,
+                    JobPhase.SUSPENDED):
         st.set_condition(
             ConditionType.GANG_SCHEDULED,
             ConditionStatus.TRUE if gang_scheduled else ConditionStatus.FALSE,

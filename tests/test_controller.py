@@ -614,3 +614,79 @@ def test_add_beats_pending_add_after():
     # the stale heap entry must not double-deliver later
     q.add("k2"); assert q.get(timeout=0.5) == "k2"; q.done("k2")
     assert q.get(timeout=0.05) is None
+
+
+class TestSuspendResume:
+    def test_suspend_tears_down_and_resume_regangs(self):
+        rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=200))
+        rt.cluster.slice_pool.add_pool("v5p-8", 1)
+        rt.submit(worker_job())
+        assert rt.wait_for_phase("default", "job", JobPhase.RUNNING, max_steps=10)
+        job = rt.get_job("default", "job")
+        assert len(rt.cluster.slice_pool.holdings(job.metadata.uid)) == 1
+
+        # suspend: pods + services gone, slice released, phase Suspended
+        job.spec.suspend = True
+        rt.cluster.jobs.update(job)
+        assert rt.wait_for_phase("default", "job", JobPhase.SUSPENDED, max_steps=20)
+        rt.step(steps=3)
+        assert not rt.cluster.pods.list("default")
+        assert not rt.cluster.services.list("default")
+        assert not rt.cluster.slice_pool.holdings(job.metadata.uid)
+        job = rt.get_job("default", "job")
+        assert job.status.get_condition(ConditionType.SUSPENDED).status \
+            == ConditionStatus.TRUE
+        # the freed slice is usable by another job while suspended
+        rt.submit(worker_job("intruder"))
+        assert rt.wait_for_phase("default", "intruder", JobPhase.RUNNING, max_steps=10)
+
+        # resume: waits for capacity, re-gangs once the intruder finishes
+        job = rt.get_job("default", "job")
+        job.spec.suspend = False
+        rt.cluster.jobs.update(job)
+        rt.step(steps=3)
+        assert rt.get_job("default", "job").status.phase == JobPhase.PENDING
+        rt.delete_job("default", "intruder")
+        assert rt.wait_for_phase("default", "job", JobPhase.RUNNING, max_steps=30)
+        job = rt.get_job("default", "job")
+        assert job.status.get_condition(ConditionType.SUSPENDED).status \
+            == ConditionStatus.FALSE
+        assert job.status.restarts == 0   # same epoch, not a failure restart
+        pods = [p for p in rt.cluster.pods.list("default")
+                if p.metadata.labels.get(naming.LABEL_JOB) == "job"]
+        assert len(pods) == 2
+
+    def test_suspended_job_ignores_terminal_ttl(self):
+        # suspend is not terminal: TTL must not delete a suspended job
+        rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=200))
+        rt.controller.opts.backoff_poll = 0.005
+        rt.cluster.slice_pool.add_pool("v5p-8", 1)
+        j = worker_job()
+        j.spec.ttl_seconds_after_finished = 2
+        rt.submit(j)
+        assert rt.wait_for_phase("default", "job", JobPhase.RUNNING, max_steps=10)
+        j = rt.get_job("default", "job")
+        j.spec.suspend = True
+        rt.cluster.jobs.update(j)
+        assert rt.wait_for_phase("default", "job", JobPhase.SUSPENDED, max_steps=20)
+        rt.step(steps=15)
+        assert rt.get_job("default", "job") is not None
+
+    def test_suspended_conditions_recomputed(self):
+        rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=200))
+        rt.cluster.slice_pool.add_pool("v5p-8", 1)
+        rt.submit(worker_job())
+        assert rt.wait_for_phase("default", "job", JobPhase.RUNNING, max_steps=10)
+        j = rt.get_job("default", "job")
+        assert j.status.get_condition(ConditionType.READY).status \
+            == ConditionStatus.TRUE
+        j.spec.suspend = True
+        rt.cluster.jobs.update(j)
+        assert rt.wait_for_phase("default", "job", JobPhase.SUSPENDED, max_steps=20)
+        rt.step(steps=3)
+        j = rt.get_job("default", "job")
+        # Ready/GangScheduled must not stay frozen at TRUE with zero pods
+        assert j.status.get_condition(ConditionType.READY).status \
+            == ConditionStatus.FALSE
+        assert j.status.get_condition(ConditionType.GANG_SCHEDULED).status \
+            == ConditionStatus.FALSE
