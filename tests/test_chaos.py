@@ -439,6 +439,16 @@ class TestChaosSoak:
                 del live_jobs[name]
                 deleted.append(name)
                 rt.delete_job("default", name)
+            elif r < 0.31 and live_jobs:
+                # toggle suspend on a random live job
+                name = rng.choice(sorted(live_jobs))
+                j = rt.get_job("default", name)
+                if j is not None and not j.is_done():
+                    j.spec.suspend = not j.spec.suspend
+                    try:
+                        rt.cluster.jobs.update(j)
+                    except Exception:
+                        pass  # conflict with the controller: fine
 
             for sname, due in list(restore_at.items()):
                 if i >= due:
@@ -453,12 +463,18 @@ class TestChaosSoak:
         if self.ITERATIONS >= 300:
             assert restarts and preemptions and crashes
 
-        # storm over: clear faults, heal the pool, require convergence
+        # storm over: clear faults, heal the pool, unsuspend everything,
+        # require convergence
         rt.cluster.faults.fail_pod_creates = 0
         rt.cluster.faults.gang_admission_delay = 0.0
         for s in rt.cluster.slice_pool.list():
             if not s.healthy:
                 rt.cluster.slice_pool.restore(s.name)
+        for name in live_jobs:
+            j = rt.get_job("default", name)
+            if j is not None and j.spec.suspend:
+                j.spec.suspend = False
+                rt.cluster.jobs.update(j)
 
         def all_settled():
             for name in live_jobs:
