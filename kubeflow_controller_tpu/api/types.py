@@ -147,6 +147,10 @@ class TPUJobSpec:
     # spec.suspend): pods are torn down and slices released; flipping back
     # re-gangs the same epoch and resumes from the model_dir checkpoint.
     suspend: bool = False
+    # Gang admission priority: when slices free up, higher-priority pending
+    # gangs admit first (ties: submission order). Ordering only — running
+    # jobs are never preempted by priority.
+    priority: int = 0
     # Auto-delete the job (and thus its pods/services, via the deleted-job
     # cleanup path) this many controller-clock seconds after it reaches a
     # terminal phase. None = keep forever (the k8s Job / training-operator

@@ -33,6 +33,8 @@ def validate_job(job: types.TPUJob) -> None:
     ttl = job.spec.ttl_seconds_after_finished
     if ttl is not None and ttl < 0:
         errs.append("spec.ttlSecondsAfterFinished must be >= 0")
+    if type(job.spec.priority) is not int:
+        errs.append("spec.priority must be an integer")
 
     specs = job.spec.replica_specs
     if not specs:

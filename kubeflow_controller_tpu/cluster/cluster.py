@@ -36,6 +36,11 @@ ANNOTATION_ACCELERATOR = "tpu.kubeflow.dev/accelerator-type"
 ANNOTATION_NUM_SLICES = "tpu.kubeflow.dev/num-slices"
 ANNOTATION_SLICE_INDEX = "tpu.kubeflow.dev/slice-index"
 ANNOTATION_HOST_INDEX = "tpu.kubeflow.dev/host-index"
+ANNOTATION_PRIORITY = "tpu.kubeflow.dev/priority"
+# Job-level submission time: the FIFO tie-break must survive pod recreation
+# (suspend/resume, gang restarts), so it rides an annotation rather than
+# deriving from pod creation timestamps.
+ANNOTATION_SUBMITTED = "tpu.kubeflow.dev/submitted-at"
 
 REASON_PREEMPTED = "Preempted"
 
@@ -170,8 +175,38 @@ class FakeCluster:
             else:
                 gangs.setdefault(group, []).append(pod)
 
-        for group, members in gangs.items():
-            self._try_admit_gang(group, members)
+        def _rank(item):
+            """Higher priority first; ties by JOB submission order (the
+            submitted-at annotation survives pod recreation across
+            suspend/resume and restarts). Ordering only — no preemption of
+            running jobs."""
+            ann = item[1][0].metadata.annotations
+
+            def num(key, default):
+                try:
+                    return float(ann.get(key, default))
+                except ValueError:
+                    return float(default)
+
+            members = item[1]
+            fallback = min(
+                p.metadata.creation_timestamp or 0.0 for p in members
+            )
+            return (-num(ANNOTATION_PRIORITY, 0),
+                    num(ANNOTATION_SUBMITTED, fallback))
+
+        # Head-of-line guard: once a HIGHER-priority gang fails allocation
+        # for an accelerator type, lower-ranked gangs wanting the same type
+        # must not leapfrog it this tick — otherwise a stream of small
+        # low-priority gangs starves a large high-priority one forever.
+        blocked_types: set = set()
+        for group, members in sorted(gangs.items(), key=_rank):
+            accel = members[0].metadata.annotations.get(
+                ANNOTATION_ACCELERATOR, "")
+            if accel in blocked_types:
+                continue
+            if self._try_admit_gang(group, members) is False:
+                blocked_types.add(accel)
 
     def _bind_local(self, pod: Pod) -> None:
         rt = self._runtime(pod)
@@ -180,16 +215,18 @@ class FakeCluster:
             self.record_event("Pod", pod.metadata.name, "Scheduled", "bound to local node")
             self.append_pod_log(pod.metadata.name, "scheduled: local node")
 
-    def _try_admit_gang(self, group: str, members: List[Pod]) -> None:
+    def _try_admit_gang(self, group: str, members: List[Pod]) -> Optional[bool]:
+        """None = not yet eligible (incomplete/delayed); True = admitted;
+        False = eligible but out of capacity (head-of-line relevant)."""
         expected = int(members[0].metadata.annotations.get(ANNOTATION_GANG_SIZE, 0))
         if expected <= 0 or len(members) < expected:
-            return  # gang incomplete: nothing is admitted (all-or-nothing)
+            return None  # gang incomplete: nothing is admitted (all-or-nothing)
         rt0 = self._runtime(members[0])
         if rt0.gang_waiting_since is None:
             for m in members:
                 self._runtime(m).gang_waiting_since = self.now
         if self.now - rt0.gang_waiting_since < self.faults.gang_admission_delay:
-            return
+            return None
         accel = members[0].metadata.annotations.get(ANNOTATION_ACCELERATOR, "")
         num_slices = int(members[0].metadata.annotations.get(ANNOTATION_NUM_SLICES, 1))
         job_uid = group
@@ -197,7 +234,12 @@ class FakeCluster:
             slices = self.slice_pool.allocate_gang(job_uid, accel, num_slices)
         except (InsufficientCapacity, KeyError) as e:
             self.record_event("Gang", group, "FailedScheduling", str(e))
-            return
+            # Infeasible request (wants more slices than the pool OWNS, not
+            # merely more than are free): it can never run, so it must not
+            # head-of-line-block feasible gangs of the same type forever.
+            if num_slices > len(self.slice_pool.list(accel)):
+                return None
+            return False
         # Bind: pod (slice_index, host_index) -> slice host. All-or-nothing:
         # if ANY member vanished (controller deleted it mid-admission), bind
         # nobody — a partially-bound gang is exactly what this module exists
@@ -214,7 +256,7 @@ class FakeCluster:
             self.pods.try_get(p.metadata.namespace, p.metadata.name) is None
             for p in by_index
         ):
-            return
+            return None
         bound: List[tuple] = []   # (pod, slice, host index)
         for pod in by_index:
             si = int(pod.metadata.annotations.get(ANNOTATION_SLICE_INDEX, 0))
@@ -241,7 +283,7 @@ class FakeCluster:
                         )
                     except NotFound:
                         pass
-                return
+                return None
             bound.append((pod, sl, hi))
         for pod, sl, hi in bound:
             self._runtime(pod).scheduled_at = self.now
@@ -253,6 +295,7 @@ class FakeCluster:
             "Gang", group, "GangScheduled",
             f"{len(members)} pods on {num_slices}x{accel}",
         )
+        return True
 
     # -- kubelet -------------------------------------------------------------
 

@@ -46,7 +46,9 @@ from kubeflow_controller_tpu.cluster.cluster import (
     ANNOTATION_GANG_SIZE,
     ANNOTATION_HOST_INDEX,
     ANNOTATION_NUM_SLICES,
+    ANNOTATION_PRIORITY,
     ANNOTATION_SLICE_INDEX,
+    ANNOTATION_SUBMITTED,
 )
 from kubeflow_controller_tpu.tpu import naming
 
@@ -210,12 +212,25 @@ def _plan_replicas(
     # (scale-down) from any pod holding an out-of-range index. Voluntary:
     # does not consume the failure budget (plan.resize).
     accel = "" if is_local else spec.tpu.accelerator_type
+    prio = str(job.spec.priority)
+    # A priority edit only matters while the gang is still QUEUED (the
+    # scheduler reads the annotation at admission time); recreating the
+    # pods of a running job for it would be a de-facto self-preemption.
+    gang_unscheduled = bool(current) and all(
+        p.status.phase == PodPhase.PENDING and not p.spec.assigned_slice
+        for p in current
+    )
     stale_spec = [
         p for p in current
         if (not is_local and (
             _gang_size_of(p, expected) != expected
             or p.metadata.annotations.get(ANNOTATION_ACCELERATOR, accel)
             != accel
+            or (
+                gang_unscheduled
+                and p.metadata.annotations.get(ANNOTATION_PRIORITY, prio)
+                != prio
+            )
         )) or _index_of(p) >= expected
     ]
     if stale_spec:
@@ -312,6 +327,13 @@ def _build_pod(
             ANNOTATION_NUM_SLICES: str(spec.tpu.num_slices),
             ANNOTATION_SLICE_INDEX: str(slice_id),
             ANNOTATION_HOST_INDEX: str(host_id),
+            ANNOTATION_PRIORITY: str(job.spec.priority),
+            # job-level submission time: the scheduler's FIFO tie-break
+            # must survive pod recreation (suspend/resume, restarts)
+            ANNOTATION_SUBMITTED: str(
+                job.status.submit_time
+                or job.metadata.creation_timestamp or 0.0
+            ),
         }
         # Gang id = job uid: the slice pool allocates per holder uid, making
         # re-admission after partial observation idempotent.

@@ -300,3 +300,137 @@ class TestServicesAndDNS:
         c.services.create(svc)
         assert c.resolve("job-worker-0.ml.svc").metadata.name == "job-worker-0"
         assert c.resolve("missing.ml.svc") is None
+
+
+def test_gang_admission_is_fifo_under_contention():
+    """Two gangs contending for one slice: the earlier submission wins when
+    capacity frees — no starvation by dict/hash order."""
+    from tests.test_controller import worker_job
+    from kubeflow_controller_tpu.api.types import JobPhase
+    from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
+    from kubeflow_controller_tpu.runtime import LocalRuntime
+
+    rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=5))
+    rt.cluster.slice_pool.add_pool("v5p-8", 1)
+    rt.submit(worker_job("holder"))
+    assert rt.wait_for_phase("default", "holder", JobPhase.RUNNING, max_steps=10)
+    rt.submit(worker_job("first"))
+    rt.step(steps=2)
+    rt.submit(worker_job("second"))
+    # holder finishes; the slice must go to "first"
+    assert rt.wait_for_phase("default", "first", JobPhase.RUNNING, max_steps=30)
+    assert rt.get_job("default", "second").status.phase == JobPhase.PENDING
+
+
+def test_priority_orders_gang_admission():
+    """A higher-priority gang submitted LATER wins the freed slice over an
+    earlier lower-priority one (ordering only; no preemption of running)."""
+    from tests.test_controller import worker_job
+    from kubeflow_controller_tpu.api.types import JobPhase
+    from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
+    from kubeflow_controller_tpu.runtime import LocalRuntime
+
+    rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=5))
+    rt.cluster.slice_pool.add_pool("v5p-8", 1)
+    rt.submit(worker_job("holder"))
+    assert rt.wait_for_phase("default", "holder", JobPhase.RUNNING, max_steps=10)
+
+    rt.submit(worker_job("low"))
+    rt.step(steps=2)
+    vip = worker_job("vip")
+    vip.spec.priority = 100
+    rt.submit(vip)
+    # the running holder is NOT preempted by the high-priority arrival
+    assert rt.get_job("default", "holder").status.phase == JobPhase.RUNNING
+    # holder finishes; vip outranks the earlier "low"
+    assert rt.wait_for_phase("default", "vip", JobPhase.RUNNING, max_steps=30)
+    assert rt.get_job("default", "low").status.phase == JobPhase.PENDING
+
+
+def test_priority_edit_on_pending_job_takes_effect():
+    """Raising spec.priority on a queued job must reach the scheduler (the
+    pending pods are recreated with the new annotation)."""
+    from tests.test_controller import worker_job
+    from kubeflow_controller_tpu.api.types import JobPhase
+    from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
+    from kubeflow_controller_tpu.runtime import LocalRuntime
+
+    rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=5))
+    rt.cluster.slice_pool.add_pool("v5p-8", 1)
+    rt.submit(worker_job("holder"))
+    rt.step(steps=2)
+    rt.submit(worker_job("first"))
+    rt.step(steps=2)
+    rt.submit(worker_job("expedited"))
+    rt.step(steps=2)
+    j = rt.get_job("default", "expedited")
+    j.spec.priority = 50
+    rt.cluster.jobs.update(j)
+    assert rt.wait_for_phase("default", "expedited", JobPhase.RUNNING,
+                             max_steps=40)
+    assert rt.get_job("default", "first").status.phase == JobPhase.PENDING
+
+
+def test_high_priority_large_gang_not_starved_by_small_gangs():
+    """Head-of-line guard: a 2-slice high-priority gang must not be
+    leapfrogged forever by a stream of 1-slice low-priority gangs."""
+    from tests.test_controller import worker_job
+    from kubeflow_controller_tpu.api.types import JobPhase
+    from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
+    from kubeflow_controller_tpu.runtime import LocalRuntime
+
+    rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=4))
+    rt.cluster.slice_pool.add_pool("v5p-8", 2)
+    rt.submit(worker_job("small-0"))
+    rt.submit(worker_job("small-1"))
+    rt.step(steps=2)
+    vip = worker_job("vip", num_slices=2)
+    vip.spec.priority = 10
+    rt.submit(vip)
+    # keep feeding small jobs; without the guard each freed slice would be
+    # re-taken and the vip never assembles 2 slices
+    for i in range(2, 8):
+        rt.submit(worker_job(f"small-{i}"))
+        rt.step(steps=2)
+    # vip assembled both slices mid-storm (it may already have finished)
+    assert rt.run_until(lambda: (
+        (j := rt.get_job("default", "vip")) is not None
+        and j.status.phase in (JobPhase.RUNNING, JobPhase.SUCCEEDED)
+    ), max_steps=40)
+
+
+def test_priority_edit_on_running_job_does_not_restart_it():
+    from tests.test_controller import worker_job
+    from kubeflow_controller_tpu.api.types import JobPhase
+    from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
+    from kubeflow_controller_tpu.runtime import LocalRuntime
+
+    rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=200))
+    rt.cluster.slice_pool.add_pool("v5p-8", 1)
+    rt.submit(worker_job("run"))
+    assert rt.wait_for_phase("default", "run", JobPhase.RUNNING, max_steps=10)
+    j = rt.get_job("default", "run")
+    j.spec.priority = 99
+    rt.cluster.jobs.update(j)
+    rt.step(steps=5)
+    j = rt.get_job("default", "run")
+    assert j.status.phase == JobPhase.RUNNING
+    assert j.status.restarts == 0   # no self-preemption for a priority edit
+
+
+def test_infeasible_high_priority_gang_does_not_block_others():
+    from tests.test_controller import worker_job
+    from kubeflow_controller_tpu.api.types import JobPhase
+    from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
+    from kubeflow_controller_tpu.runtime import LocalRuntime
+
+    rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=5))
+    rt.cluster.slice_pool.add_pool("v5p-8", 2)
+    impossible = worker_job("impossible", num_slices=3)   # pool owns only 2
+    impossible.spec.priority = 100
+    rt.submit(impossible)
+    rt.step(steps=2)
+    rt.submit(worker_job("feasible"))
+    assert rt.wait_for_phase("default", "feasible", JobPhase.SUCCEEDED,
+                             max_steps=40)
+    assert rt.get_job("default", "impossible").status.phase == JobPhase.PENDING
