@@ -396,6 +396,7 @@ class TestChaosSoak:
             name = f"{kind}-{counter}"
             if kind == "gang":
                 j = worker_job(name, num_slices=rng.choice([1, 1, 2]))
+                j.spec.priority = rng.choice([0, 0, 0, 5, 10])
             else:
                 j = local_job(name)
             live_jobs[name] = rt.submit(j)
