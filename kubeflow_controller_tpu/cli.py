@@ -332,14 +332,19 @@ def cmd_apply(args) -> int:
 
 def cmd_list(args) -> int:
     items = _req(args, "GET", "/jobs")["items"]
-    rows = [("NAMESPACE", "NAME", "PHASE", "AGE")]
+    rows = [("NAMESPACE", "NAME", "PHASE", "RESTARTS", "PRIO", "AGE")]
     now = _req(args, "GET", "/healthz")["now"]
     for j in items:
         st = j.get("status", {})
+        restarts = st.get("restarts", 0)
+        resizes = st.get("resizes", 0)
         rows.append((
             j["metadata"].get("namespace", ""),
             j["metadata"].get("name", ""),
             st.get("phase", ""),
+            (f"{restarts - resizes}"
+             + (f"+{resizes}rs" if resizes else "")),
+            str(j.get("spec", {}).get("priority", 0)),
             f"{now - j['metadata'].get('creationTimestamp', now):.0f}s",
         ))
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
