@@ -3,7 +3,12 @@
 
 PY ?= python
 
-.PHONY: all native test test-fast bench clean
+.PHONY: all native test test-fast bench clean stamp
+
+# Build-stamp analog of the reference's ldflags version injection
+# (/root/reference/Makefile:23-26): export the sha for build_version().
+stamp:
+	@echo "export TPUJOB_GIT_SHA=$$(git rev-parse --short HEAD)"
 
 all: native
 

@@ -15,7 +15,7 @@ import copy
 from typing import Callable, Optional
 
 from kubeflow_controller_tpu.api.types import TPUJob
-from kubeflow_controller_tpu.cluster.store import Conflict
+from kubeflow_controller_tpu.cluster.store import AlreadyExists, Conflict
 
 
 def apply_job_spec(
@@ -30,7 +30,12 @@ def apply_job_spec(
     for _ in range(retries):
         cur = get()
         if cur is None:
-            return create(new)
+            try:
+                return create(new)
+            except AlreadyExists:
+                # A concurrent creator won the race between get() and
+                # create(); the next iteration takes the update path.
+                continue
         rid = cur.spec.runtime_id
         cur.spec = copy.deepcopy(new.spec)
         cur.spec.runtime_id = rid

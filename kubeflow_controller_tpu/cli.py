@@ -81,7 +81,7 @@ def _make_handler(rt: LocalRuntime):
             if parts == ["healthz"]:
                 return {"ok": True, "now": cluster.now}
             if parts == ["version"]:
-                return {"version": pkg.__version__}
+                return {"version": pkg.build_version()}
             if parts == ["jobs"] and method == "POST":
                 job = job_from_dict(body)
                 validate_job(job)
@@ -579,7 +579,7 @@ def cmd_run(args) -> int:
 
 
 def cmd_version(args) -> int:
-    print(pkg.__version__)
+    print(pkg.build_version())
     return 0
 
 

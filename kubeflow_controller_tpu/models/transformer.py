@@ -460,9 +460,13 @@ PEAK_TFLOPS_BF16_V5E = 197.0
 
 def train_flops_per_token(cfg: TransformerConfig, seq: int) -> float:
     """Model FLOPs per trained token: 6*N_active matmul flops (fwd+bwd)
-    plus the causal-attention term 12*L*d_model*seq/2. The standard MFU
-    accounting (PaLM appendix B convention); used by bench.py and
-    benchmarks/transformer_bench.py so the two always agree.
+    plus the causal-attention term 12*L*(n_heads*head_dim)*seq/2 — the
+    attention width, which equals d_model for every config this
+    TransformerConfig can express (head_dim is derived as
+    d_model // n_heads) but is the dimension the score/value matmuls
+    actually run at. The standard MFU accounting (PaLM appendix B
+    convention); used by bench.py and benchmarks/transformer_bench.py so
+    the two always agree.
 
     MoE: only the routed top_k experts' FFN weights are ACTIVE per token
     (plus the router matmul) — counting the full expert bank would inflate
@@ -482,7 +486,8 @@ def train_flops_per_token(cfg: TransformerConfig, seq: int) -> float:
             + ffn
         )
     )
-    attn = 12 * cfg.n_layers * cfg.d_model * (seq / 2)  # causal halves it
+    # Score/value matmuls run at the attention width n_heads * head_dim.
+    attn = 12 * cfg.n_layers * cfg.n_heads * cfg.head_dim * (seq / 2)  # causal halves it
     return 6 * n_active + attn
 
 

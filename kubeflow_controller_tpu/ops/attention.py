@@ -63,12 +63,14 @@ def mha_xla(
 
 
 @functools.lru_cache(None)
-def _flash_block_ok(s: int) -> bool:
+def _flash_block_ok(s: int, has_segments: bool = False) -> bool:
     """True iff the sequence tiles into flash blocks large enough to be
     worth the kernel (>= 128); tiny divisor blocks would explode the
-    sequential grid."""
+    sequential grid. With segment ids the block must additionally satisfy
+    the lane-axis tile rule (128-multiple or the full sequence) — the
+    segment BlockSpec carries the sequence on the lane axis."""
     try:
-        return _choose_block(s, DEFAULT_BLOCK_Q) >= 128
+        return _choose_block(s, DEFAULT_BLOCK_Q, lane_aligned=has_segments) >= 128
     except ValueError:
         return False
 
@@ -108,7 +110,7 @@ def mha(
             and q.shape[1] == k.shape[1]    # kernel assumes q_len == k_len
             and q.shape[1] >= 256
             and q.shape[3] in (64, 128, 256)
-            and _flash_block_ok(q.shape[1])
+            and _flash_block_ok(q.shape[1], segment_ids is not None)
         )
         impl = "flash" if use_flash else "xla"
     if impl == "flash":

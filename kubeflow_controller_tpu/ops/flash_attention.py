@@ -40,7 +40,7 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
 
 
-def _choose_block(s: int, requested: int) -> int:
+def _choose_block(s: int, requested: int, lane_aligned: bool = False) -> int:
     """Largest block <= requested that tiles the sequence exactly.
 
     The grid is ``s // block`` with no tail handling, so a non-divisor block
@@ -48,17 +48,34 @@ def _choose_block(s: int, requested: int) -> int:
     multiple of 8 (fp32 sublane tile) unless the block IS the full sequence
     (the array-dim exception); sequences with no such divisor are rejected —
     pad the sequence to a multiple of 8 first.
+
+    ``lane_aligned`` tightens the tile rule to the LANE axis (multiple of
+    128, or the full array dim): the segment-id BlockSpecs are (1, 1, block)
+    with the sequence on the lane axis, where Mosaic requires 128m — a
+    block like 320 (fine on the sublane axis) would fail to lower there.
     """
     requested = min(requested, s)
-    if s % requested == 0 and (requested % 8 == 0 or requested == s):
+    quantum = 128 if lane_aligned else 8
+    if lane_aligned and requested < quantum:
+        # A sub-quantum request can never be lane-legal; the nearest legal
+        # block is the quantum itself (or the whole, shorter sequence).
+        requested = min(quantum, s)
+    if s % requested == 0 and (requested % quantum == 0 or requested == s):
         return requested
-    for b in range(requested, 7, -1):
-        if s % b == 0 and b % 8 == 0:
+    for b in range(requested, quantum - 1, -1):
+        if s % b == 0 and b % quantum == 0:
             return b
+    if lane_aligned and s % 8 == 0 and s <= requested:
+        # No 128-multiple divisor, but the whole (short) sequence is a legal
+        # block (array-dim exception) — the grid degenerates to one block.
+        # Only when s fits the request: an unbounded full-sequence block
+        # would blow VMEM (the [BQ,BK] score tile is s*s*4 bytes), so long
+        # divisor-less sequences are rejected and auto-dispatch keeps XLA.
+        return s
     raise ValueError(
         f"flash attention: seq_len {s} has no block divisor that is a "
-        f"multiple of 8; pad the sequence (e.g. to {-(-s // 8) * 8}) or "
-        "use the XLA attention path"
+        f"multiple of {quantum}; pad the sequence or use the XLA "
+        "attention path"
     )
 
 
@@ -184,14 +201,14 @@ def _fwd_wide(
     b, h, s, d = q.shape
     kv_h = k.shape[1]
     rep = h // kv_h
-    block_q = _choose_block(s, block_q)
-    block_k = _choose_block(s, block_k)
+    has_segments = segment_ids is not None
+    block_q = _choose_block(s, block_q, lane_aligned=has_segments)
+    block_k = _choose_block(s, block_k, lane_aligned=has_segments)
     nq = s // block_q
     nk = s // block_k
     sm_scale = d ** -0.5
 
     grid = (b, h, nq, nk)
-    has_segments = segment_ids is not None
     kernel = functools.partial(
         _fwd_kernel, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, has_segments=has_segments,
@@ -379,12 +396,12 @@ def _bwd(
     b, h, s, d = q.shape
     kv_h = k.shape[1]
     rep = h // kv_h
-    block_q = _choose_block(s, block_q)
-    block_k = _choose_block(s, block_k)
+    has_segments = segment_ids is not None
+    block_q = _choose_block(s, block_q, lane_aligned=has_segments)
+    block_k = _choose_block(s, block_k, lane_aligned=has_segments)
     nq = s // block_q
     nk = s // block_k
     sm_scale = d ** -0.5
-    has_segments = segment_ids is not None
 
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1

@@ -124,13 +124,14 @@ def test_bf16_inputs():
 @pytest.mark.parametrize("causal", [True, False])
 def test_segment_ids_fused_matches_dense(causal):
     # Packed batch: two documents (plus a distinct pad segment) per row —
-    # fused in-kernel since r2 (previously an XLA fallback).
-    q, k, v = qkv(b=2, s=128, h=4, kv_h=2)
+    # fused in-kernel since r2 (previously an XLA fallback). s=256 so the
+    # lane-aligned segment blocks (128) still give a multi-block grid.
+    q, k, v = qkv(b=2, s=256, h=4, kv_h=2)
     segs = jnp.asarray(
         np.concatenate([
-            np.zeros((2, 40), np.int32) + 1,
-            np.zeros((2, 56), np.int32) + 2,
-            np.zeros((2, 32), np.int32),     # pad segment
+            np.zeros((2, 72), np.int32) + 1,
+            np.zeros((2, 120), np.int32) + 2,
+            np.zeros((2, 64), np.int32),     # pad segment
         ], axis=1)
     )
     ref = mha_xla(q, k, v, causal=causal, segment_ids=segs)
@@ -138,12 +139,34 @@ def test_segment_ids_fused_matches_dense(causal):
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
 
 
+def test_segment_ids_lane_aligned_blocks():
+    # ADVICE r2: with segment ids the chosen block must satisfy the LANE
+    # tile rule (multiple of 128 or the full sequence). S=640's largest
+    # divisor block under the default 512 request is 320 — illegal on the
+    # lane axis — so the chooser must land on 128 instead.
+    from kubeflow_controller_tpu.ops.flash_attention import _choose_block
+
+    assert _choose_block(640, 512) == 320                      # plain rule
+    assert _choose_block(640, 512, lane_aligned=True) == 128   # 640 = 5*128
+    assert _choose_block(1024, 512, lane_aligned=True) == 512
+    # No 128-multiple divisor at all: the full sequence is the one legal block.
+    assert _choose_block(136, 512, lane_aligned=True) == 136
+
+    q, k, v = qkv(b=1, s=640, h=2, kv_h=2)
+    segs = jnp.asarray(np.repeat(
+        np.arange(5, dtype=np.int32)[None, :], 128, axis=0
+    ).T.reshape(1, 640))
+    ref = mha_xla(q, k, v, causal=True, segment_ids=segs)
+    out = flash_mha(q, k, v, causal=True, segment_ids=segs, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
 def test_segment_ids_grads_match_dense():
-    q, k, v = qkv(b=1, s=128, h=2, kv_h=2)
+    q, k, v = qkv(b=1, s=256, h=2, kv_h=2)
     segs = jnp.asarray(
         np.concatenate([
-            np.ones((1, 48), np.int32),
-            np.full((1, 80), 2, np.int32),
+            np.ones((1, 96), np.int32),
+            np.full((1, 160), 2, np.int32),
         ], axis=1)
     )
 
@@ -168,22 +191,25 @@ def test_segment_ids_compiled_on_tpu():
     """The compiled lowering of the (1,1,block) segment BlockSpecs — the
     interpret-mode tests cannot catch a Mosaic-only regression here."""
     r = np.random.default_rng(0)
-    b, s, h, d = 2, 1024, 4, 128
-    mk = lambda: jnp.asarray(r.standard_normal((b, s, h, d)), jnp.bfloat16)  # noqa: E731
-    q, k, v = mk(), mk(), mk()
-    segs = jnp.asarray(
-        np.repeat(r.integers(1, 4, (b, s // 128)), 128, axis=1), jnp.int32
-    )
-    for causal in (True, False):
-        ref = mha_xla(q, k, v, causal=causal, segment_ids=segs)
-        out = jax.jit(
-            lambda q, k, v: flash_mha(q, k, v, causal=causal, segment_ids=segs)
-        )(q, k, v)
-        np.testing.assert_allclose(
-            np.asarray(ref, np.float32), np.asarray(out, np.float32), atol=3e-2
+    # s=640 covers the ADVICE-r2 case: no divisor of 640 in [129, 512] is a
+    # 128-multiple, so the lane-aligned chooser must drop to 128 blocks for
+    # the compiled segment specs rather than picking an unloadable 320.
+    for b, s, h, d in ((2, 1024, 4, 128), (2, 640, 4, 128)):
+        mk = lambda: jnp.asarray(r.standard_normal((b, s, h, d)), jnp.bfloat16)  # noqa: E731
+        q, k, v = mk(), mk(), mk()
+        segs = jnp.asarray(
+            np.repeat(r.integers(1, 4, (b, s // 128)), 128, axis=1), jnp.int32
         )
-        g = jax.jit(jax.grad(lambda q: (
-            flash_mha(q, k, v, causal=causal, segment_ids=segs)
-            .astype(jnp.float32) ** 2
-        ).sum()))(q)
-        assert np.isfinite(np.asarray(g, np.float32)).all()
+        for causal in (True, False):
+            ref = mha_xla(q, k, v, causal=causal, segment_ids=segs)
+            out = jax.jit(
+                lambda q, k, v: flash_mha(q, k, v, causal=causal, segment_ids=segs)
+            )(q, k, v)
+            np.testing.assert_allclose(
+                np.asarray(ref, np.float32), np.asarray(out, np.float32), atol=3e-2
+            )
+            g = jax.jit(jax.grad(lambda q: (
+                flash_mha(q, k, v, causal=causal, segment_ids=segs)
+                .astype(jnp.float32) ** 2
+            ).sum()))(q)
+            assert np.isfinite(np.asarray(g, np.float32)).all()
