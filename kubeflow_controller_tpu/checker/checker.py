@@ -15,7 +15,6 @@ from typing import List, Sequence
 from kubeflow_controller_tpu.api.core import Pod, PodPhase
 from kubeflow_controller_tpu.api.types import ReplicaType, TPUJob
 from kubeflow_controller_tpu.cluster.cluster import REASON_PREEMPTED
-from kubeflow_controller_tpu.cluster.slices import TPUSlice
 
 
 def is_local_job(job: TPUJob) -> bool:
@@ -44,9 +43,23 @@ class HealthReport:
         )
 
 
-def assess_health(pods: Sequence[Pod], held_slices: Sequence[TPUSlice]) -> HealthReport:
+def _slice_health(s) -> tuple:
+    """(name, healthy) from a TPUSlice or its wire-JSON dict — the REST
+    client's ``job_slices`` returns the latter, the in-process client the
+    former; the checker must read both so the controller stays
+    backend-agnostic."""
+    if isinstance(s, dict):
+        return s.get("name", ""), bool(s.get("healthy", True))
+    return s.name, s.healthy
+
+
+def assess_health(pods: Sequence[Pod], held_slices: Sequence) -> HealthReport:
     report = HealthReport()
-    sick = {s.name for s in held_slices if not s.healthy}
+    sick = set()
+    for s in held_slices:
+        name, healthy = _slice_health(s)
+        if not healthy:
+            sick.add(name)
     report.unhealthy_slices = sorted(sick)
     for pod in pods:
         if pod.status.phase == PodPhase.FAILED:
@@ -54,6 +67,12 @@ def assess_health(pods: Sequence[Pod], held_slices: Sequence[TPUSlice]) -> Healt
                 report.preempted_pods.append(pod.metadata.name)
             else:
                 report.failed_pods.append(pod.metadata.name)
-        elif pod.spec.assigned_slice in sick:
+        elif (
+            pod.status.phase in (PodPhase.PENDING, PodPhase.RUNNING)
+            and pod.spec.assigned_slice in sick
+        ):
+            # Only live pods are at risk: a SUCCEEDED pod on a since-degraded
+            # slice already finished its work — restarting it would re-run a
+            # completed gang.
             report.at_risk_pods.append(pod.metadata.name)
     return report

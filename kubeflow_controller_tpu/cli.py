@@ -164,6 +164,15 @@ def _make_handler(rt: LocalRuntime):
                     }
                     for tr in rt.controller.traces[-200:]
                 ]}
+            if parts[:1] == ["slices"] and method == "GET" and len(parts) == 2:
+                from kubeflow_controller_tpu.cluster.slices import (
+                    slice_to_dict,
+                )
+
+                return {"items": [
+                    slice_to_dict(s)
+                    for s in cluster.slice_pool.holdings(parts[1])
+                ]}
             if parts == ["pools"] and method == "GET":
                 return {"items": [
                     {
@@ -387,6 +396,13 @@ def cmd_describe(args) -> int:
         print("Pods:")
         for p in mine:
             print(f"  {p['name']}  {p['phase']}  slice={p['slice'] or '-'}")
+    held = _req(args, "GET", f"/slices/{meta.get('uid', '')}")["items"]
+    if held:
+        print("Slices:")
+        for s in held:
+            health = "healthy" if s["healthy"] else "UNHEALTHY"
+            print(f"  {s['name']}  {s['accelerator']}  {health}"
+                  f"  hosts={len(s['hosts'])}")
     evs = _req(args, "GET", "/events")["items"]
     mine_ev = [e for e in evs if meta["name"] in e["name"]][-10:]
     if mine_ev:

@@ -382,6 +382,16 @@ class FakeCluster:
                           f"evicted {len(failed)} pods")
         return failed
 
+    def degrade_slice(self, slice_name: str) -> str:
+        """Mark a slice unhealthy WITHOUT failing its pods — the state the
+        checker detects proactively (contrast ``preempt_slice``, where the
+        kubelet already knows). Returns the holder uid."""
+        holder = self.slice_pool.mark_unhealthy(slice_name)
+        self.record_event(
+            "Slice", slice_name, "Unhealthy",
+            "slice degraded (pods still running)")
+        return holder
+
     def crash_pod(self, namespace: str, name: str, exit_code: int = 137) -> None:
         pod = self.pods.get(namespace, name)
         self._finish(pod, exit_code)

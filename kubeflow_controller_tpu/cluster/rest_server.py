@@ -140,13 +140,12 @@ def make_rest_handler(cluster: FakeCluster, watches: _WatchRegistry):
                             200, {"released": cluster.slice_pool.release(uid)}
                         )
                     if method == "GET":
+                        from kubeflow_controller_tpu.cluster.slices import (
+                            slice_to_dict,
+                        )
+
                         return self._send(200, {"items": [
-                            {
-                                "name": s.name,
-                                "accelerator": s.shape.accelerator_type,
-                                "hosts": list(s.hosts),
-                                "healthy": s.healthy,
-                            }
+                            slice_to_dict(s)
                             for s in cluster.slice_pool.holdings(uid)
                         ]})
                 matched = self._match()

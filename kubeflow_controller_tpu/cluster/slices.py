@@ -40,6 +40,19 @@ class InsufficientCapacity(RuntimeError):
     pass
 
 
+def slice_to_dict(s: TPUSlice) -> dict:
+    """Wire-JSON shape for a slice. The single source of truth for every
+    server that serves slice state (apiserver-shaped ``rest_server`` and the
+    CLI daemon) — ``checker._slice_health`` reads this shape back, so the
+    two servers must never drift."""
+    return {
+        "name": s.name,
+        "accelerator": s.shape.accelerator_type,
+        "healthy": s.healthy,
+        "hosts": list(s.hosts),
+    }
+
+
 class SlicePool:
     """Inventory of TPU slices, grouped by accelerator type.
 
@@ -154,6 +167,17 @@ class SlicePool:
             return [s for s in self._slices.values() if s.holder == job_uid]
 
     # -- fault injection ----------------------------------------------------
+
+    def mark_unhealthy(self, name: str) -> str:
+        """Degrade a slice WITHOUT evicting its holder or touching pods —
+        the 'sick but not dead' state the checker exists to catch before
+        the kubelet does (ICI link flaps, HBM ECC storms). Returns the
+        holder uid ("" if free). The next ``allocate_gang`` for that holder
+        replaces the slice (unhealthy holdings don't count as held)."""
+        with self._lock:
+            s = self._slices[name]
+            s.healthy = False
+            return s.holder
 
     def preempt(self, name: str) -> str:
         """Simulate slice preemption: mark unhealthy, evict holder.

@@ -30,6 +30,7 @@ from kubeflow_controller_tpu.api.types import (
     TPUJob,
 )
 from kubeflow_controller_tpu.api.validation import ValidationError, validate_job
+from kubeflow_controller_tpu.checker import assess_health
 from kubeflow_controller_tpu.cluster.client import ClusterClient
 from kubeflow_controller_tpu.cluster.events import EventType, WatchEvent
 from kubeflow_controller_tpu.cluster.store import AlreadyExists, Conflict, NotFound
@@ -251,7 +252,19 @@ class Controller:
             self.client.update_service,
         )
 
-        plan = plan_job(job, pods, services)
+        # Slice-health assessment (the wired-in checker): pods still running
+        # on an unhealthy slice trigger proactive recovery through the
+        # planner, before the kubelet fails them. Fetched only when the
+        # planner will read it — for local/terminal/suspended/unstamped jobs
+        # the slice query (an HTTP round-trip on the REST backend) is waste.
+        health = None
+        if (
+            job.spec.runtime_id and not job.is_done()
+            and not job.spec.suspend and job.worker_spec() is not None
+        ):
+            health = assess_health(
+                pods, self.client.job_slices(job.metadata.uid))
+        plan = plan_job(job, pods, services, health=health)
         deleting = job.metadata.deletion_timestamp is not None
 
         executed = False
@@ -342,6 +355,10 @@ class Controller:
                     return False
 
         if plan.gang_restart:
+            if plan.health_restart:
+                self.client.record_event(
+                    "TPUJob", job.metadata.name, "SliceUnhealthy",
+                    plan.restart_reason)
             # Persist the epoch bump FIRST so a crash between delete and
             # create cannot strand the job: stale-epoch pods are deleted by
             # rule on every future sync.

@@ -41,6 +41,7 @@ from kubeflow_controller_tpu.api.types import (
     TPUJob,
 )
 from kubeflow_controller_tpu.api.validation import expected_worker_pods
+from kubeflow_controller_tpu.checker import HealthReport, is_local_job
 from kubeflow_controller_tpu.cluster.cluster import (
     ANNOTATION_ACCELERATOR,
     ANNOTATION_GANG_SIZE,
@@ -70,6 +71,9 @@ class Plan:
     # This restart is a voluntary spec resize: bump status.resizes too so it
     # does not count against the failure budget.
     resize: bool = False
+    # Restart triggered by the checker's slice-health signal (pods still
+    # Running on an unhealthy slice): the controller emits SliceUnhealthy.
+    health_restart: bool = False
     # Terminal failure verdict (budget exhausted).
     fail_reason: str = ""
     # Job reached a terminal phase: release slices, delete services.
@@ -121,9 +125,17 @@ def _gang_size_of(pod: Pod, default: int) -> int:
         return default
 
 
-def plan_job(job: TPUJob, pods: List[Pod], services: List[Service]) -> Plan:
-    """Top-level pure decision: dispatch on job mode (the grown-up
-    ``checker.IsLocalJob``, reference ``pkg/checker/checker.go:8-14``)."""
+def plan_job(
+    job: TPUJob,
+    pods: List[Pod],
+    services: List[Service],
+    health: Optional[HealthReport] = None,
+) -> Plan:
+    """Top-level pure decision: mode dispatch via ``checker.is_local_job``
+    (reference ``pkg/checker/checker.go:8-14``), plus the checker's
+    slice-health signal (``health``) driving PROACTIVE gang restarts — the
+    ``TFJobRecovering`` flow the reference declared but never implemented
+    (``types.go:152``)."""
     if not job.spec.runtime_id:
         return Plan(needs_runtime_id=True, note="runtime id not yet stamped")
 
@@ -139,12 +151,15 @@ def plan_job(job: TPUJob, pods: List[Pod], services: List[Service]) -> Plan:
         plan.delete_services = [s.metadata.name for s in services]
         return plan
 
-    local = job.local_spec()
-    if local is not None:
-        return _plan_replicas(job, local, pods, services, is_local=True)
+    if is_local_job(job):
+        return _plan_replicas(
+            job, job.local_spec(), pods, services, is_local=True
+        )
     worker = job.worker_spec()
     if worker is not None:
-        return _plan_replicas(job, worker, pods, services, is_local=False)
+        return _plan_replicas(
+            job, worker, pods, services, is_local=False, health=health
+        )
     return Plan(note="no replica specs")
 
 
@@ -168,6 +183,7 @@ def _plan_replicas(
     pods: List[Pod],
     services: List[Service],
     is_local: bool,
+    health: Optional[HealthReport] = None,
 ) -> Plan:
     plan = Plan()
     epoch = job.status.restarts
@@ -178,20 +194,38 @@ def _plan_replicas(
     plan.delete_pods.extend(p.metadata.name for p in stale)
 
     failed = [p for p in current if p.status.phase == PodPhase.FAILED]
-    if failed:
-        preempted = [p for p in failed if p.status.reason == "Preempted"]
-        reason = (
-            f"slice preempted ({len(preempted)} pods)" if preempted
-            else f"{len(failed)} pod(s) failed"
-        )
+    # The checker's PROACTIVE signal: current-epoch pods still Pending or
+    # Running on a slice that has gone unhealthy. Restarting the gang now —
+    # before the kubelet notices and fails the pods — is the whole point of
+    # the checker (SURVEY.md §7.5; reference TFJobRecovering, types.go:152).
+    # Pod failure takes precedence (strictly more information).
+    at_risk: List[Pod] = []
+    if not failed and health is not None and health.at_risk_pods:
+        risk_names = set(health.at_risk_pods)
+        at_risk = [p for p in current if p.metadata.name in risk_names]
+    if failed or at_risk:
+        if failed:
+            preempted = [p for p in failed if p.status.reason == "Preempted"]
+            reason = (
+                f"slice preempted ({len(preempted)} pods)" if preempted
+                else f"{len(failed)} pod(s) failed"
+            )
+        else:
+            reason = (
+                f"slice(s) {', '.join(health.unhealthy_slices)} unhealthy "
+                f"({len(at_risk)} pods at risk): proactive recovery"
+            )
+            plan.health_restart = True
         # Budget counts FAILURE restarts only: voluntary resizes advanced
         # the epoch but must not make a later routine recovery terminal.
+        # Health restarts are involuntary and consume the same budget (a
+        # flapping slice must not restart-loop forever).
         failures = epoch - job.status.resizes
         if failures + 1 <= spec.max_restarts:
             # Gang restart: the whole epoch dies together. Slices are NOT
             # released — allocate_gang is idempotent per job uid, so healthy
-            # held slices are reused warm and only the preempted one is
-            # replaced.
+            # held slices are reused warm and only the preempted/unhealthy
+            # one is replaced (unhealthy holdings don't count as held).
             plan.gang_restart = True
             plan.restart_reason = reason
             plan.delete_pods.extend(p.metadata.name for p in current)

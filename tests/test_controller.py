@@ -268,6 +268,59 @@ class TestGangJobLifecycle:
         ev_reasons = [e[3] for e in rt.cluster.cluster_events]
         assert "GangRestart" in ev_reasons
 
+    def test_unhealthy_slice_proactive_recovery(self):
+        """The wired-in checker (VERDICT r2 #2): a slice degraded under
+        still-Running pods triggers a gang restart BEFORE any pod fails —
+        the TFJobRecovering flow the reference declared and never
+        implemented (types.go:152)."""
+        rt = self.make_runtime(
+            policy=PodRunPolicy(start_delay=1, run_duration=1000))
+        rt.submit(worker_job())
+        assert rt.wait_for_phase("default", "job", JobPhase.RUNNING, max_steps=10)
+        job = rt.get_job("default", "job")
+        sick = rt.cluster.slice_pool.holdings(job.metadata.uid)[0].name
+        rt.cluster.degrade_slice(sick)
+        # Nothing failed: this is purely the checker's proactive signal.
+        assert all(
+            p.status.phase == PodPhase.RUNNING
+            for p in rt.cluster.pods.list("default")
+        )
+        # Slice health emits no watch event; the periodic informer resync
+        # (reference: 30s) is the level-trigger that surfaces it.
+        rt.job_informer.resync()
+        assert rt.run_until(
+            lambda: rt.get_job("default", "job").status.restarts == 1,
+            max_steps=30,
+        )
+        assert rt.wait_for_phase("default", "job", JobPhase.RUNNING, max_steps=30)
+        job = rt.get_job("default", "job")
+        held = rt.cluster.slice_pool.holdings(job.metadata.uid)
+        assert held and all(s.healthy for s in held)
+        assert sick not in {s.name for s in held}
+        pods = rt.cluster.pods.list("default")
+        assert pods and all(
+            p.metadata.labels[naming.LABEL_EPOCH] == "1" for p in pods
+        )
+        ev_reasons = [e[3] for e in rt.cluster.cluster_events]
+        assert "SliceUnhealthy" in ev_reasons
+        assert "GangRestart" in ev_reasons
+
+    def test_unhealthy_slice_budget_exhaustion_fails_job(self):
+        """Health restarts consume the failure budget: a flapping slice
+        cannot restart-loop past max_restarts."""
+        rt = self.make_runtime(
+            policy=PodRunPolicy(start_delay=1, run_duration=1000))
+        rt.submit(worker_job(max_restarts=0))
+        assert rt.wait_for_phase("default", "job", JobPhase.RUNNING, max_steps=10)
+        job = rt.get_job("default", "job")
+        sick = rt.cluster.slice_pool.holdings(job.metadata.uid)[0].name
+        rt.cluster.degrade_slice(sick)
+        rt.job_informer.resync()
+        assert rt.wait_for_phase("default", "job", JobPhase.FAILED, max_steps=10)
+        job = rt.get_job("default", "job")
+        assert "unhealthy" in job.status.reason
+        assert not rt.cluster.slice_pool.holdings(job.metadata.uid)
+
     def test_worker_failure_exhausts_budget(self):
         rt = self.make_runtime(policy=PodRunPolicy(start_delay=0, run_duration=1,
                                                    exit_code=9))

@@ -83,6 +83,9 @@ def test_assess_health_classification():
         pod(1, PodPhase.FAILED, reason="ExitCode1"),
         pod(2, PodPhase.RUNNING, slice_name="s-bad"),   # at risk
         pod(3, PodPhase.RUNNING, slice_name="s-ok"),    # healthy
+        # Finished work on a since-degraded slice is NOT at risk — flagging
+        # it would restart a completed gang.
+        pod(4, PodPhase.SUCCEEDED, slice_name="s-bad"),
     ]
     r = checker.assess_health(pods, [sick, ok])
     assert r.preempted_pods == ["p0"]
@@ -91,6 +94,17 @@ def test_assess_health_classification():
     assert r.at_risk_pods == ["p2"]
     assert r.needs_recovery
     assert not checker.assess_health([pods[3]], [ok]).needs_recovery
+
+
+def test_assess_health_reads_wire_dicts():
+    """The REST backend's job_slices returns wire JSON, not TPUSlice —
+    the checker must read both so the controller stays backend-agnostic."""
+    r = checker.assess_health(
+        [pod(0, PodPhase.RUNNING, slice_name="s-bad")],
+        [{"name": "s-bad", "healthy": False, "accelerator": "v5p-8"}],
+    )
+    assert r.at_risk_pods == ["p0"]
+    assert r.unhealthy_slices == ["s-bad"]
 
 
 # -- updater ------------------------------------------------------------------
