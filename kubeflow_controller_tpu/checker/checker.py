@@ -15,6 +15,7 @@ from typing import List, Sequence
 from kubeflow_controller_tpu.api.core import Pod, PodPhase
 from kubeflow_controller_tpu.api.types import ReplicaType, TPUJob
 from kubeflow_controller_tpu.cluster.cluster import REASON_PREEMPTED
+from kubeflow_controller_tpu.cluster.slices import TPUSlice
 
 
 def is_local_job(job: TPUJob) -> bool:
@@ -43,23 +44,14 @@ class HealthReport:
         )
 
 
-def _slice_health(s) -> tuple:
-    """(name, healthy) from a TPUSlice or its wire-JSON dict — the REST
-    client's ``job_slices`` returns the latter, the in-process client the
-    former; the checker must read both so the controller stays
-    backend-agnostic."""
-    if isinstance(s, dict):
-        return s.get("name", ""), bool(s.get("healthy", True))
-    return s.name, s.healthy
-
-
-def assess_health(pods: Sequence[Pod], held_slices: Sequence) -> HealthReport:
+def assess_health(
+    pods: Sequence[Pod], held_slices: Sequence[TPUSlice]
+) -> HealthReport:
+    """Every ClusterClient's ``job_slices`` returns TPUSlice (the REST
+    client deserializes the wire dicts at its boundary), so the checker
+    reads one type regardless of backend."""
     report = HealthReport()
-    sick = set()
-    for s in held_slices:
-        name, healthy = _slice_health(s)
-        if not healthy:
-            sick.add(name)
+    sick = {s.name for s in held_slices if not s.healthy}
     report.unhealthy_slices = sorted(sick)
     for pod in pods:
         if pod.status.phase == PodPhase.FAILED:

@@ -278,7 +278,22 @@ class RestClusterClient:
         )["released"]
 
     def job_slices(self, job_uid: str):
-        return self._req("GET", f"/framework/v1/slices/{job_uid}")["items"]
+        # Deserialize to TPUSlice at the client boundary (the inverse of the
+        # server's slice_to_dict) so every consumer — the checker above all —
+        # sees ONE type regardless of backend.
+        from kubeflow_controller_tpu.api.topology import slice_shape
+        from kubeflow_controller_tpu.cluster.slices import TPUSlice
+
+        items = self._req("GET", f"/framework/v1/slices/{job_uid}")["items"]
+        return [
+            TPUSlice(
+                name=d["name"],
+                shape=slice_shape(d["accelerator"]),
+                healthy=bool(d["healthy"]),
+                hosts=list(d.get("hosts") or []),
+            )
+            for d in items
+        ]
 
 
 class RestWatchSource:

@@ -423,6 +423,12 @@ class Controller:
             acted = True
 
         if plan.fail_reason:
+            if plan.health_restart:
+                # Health-triggered but budget-exhausted: still record WHICH
+                # slice killed the job, not just that it failed.
+                self.client.record_event(
+                    "TPUJob", job.metadata.name, "SliceUnhealthy",
+                    plan.fail_reason)
             self.client.record_event(
                 "TPUJob", job.metadata.name, "JobFailed", plan.fail_reason)
         return acted

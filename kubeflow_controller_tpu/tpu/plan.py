@@ -71,8 +71,9 @@ class Plan:
     # This restart is a voluntary spec resize: bump status.resizes too so it
     # does not count against the failure budget.
     resize: bool = False
-    # Restart triggered by the checker's slice-health signal (pods still
-    # Running on an unhealthy slice): the controller emits SliceUnhealthy.
+    # Recovery (or terminal failure) triggered by the checker's slice-health
+    # signal — pods still Running on an unhealthy slice. The controller
+    # emits SliceUnhealthy alongside the restart or failure event.
     health_restart: bool = False
     # Terminal failure verdict (budget exhausted).
     fail_reason: str = ""

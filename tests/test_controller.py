@@ -320,6 +320,8 @@ class TestGangJobLifecycle:
         job = rt.get_job("default", "job")
         assert "unhealthy" in job.status.reason
         assert not rt.cluster.slice_pool.holdings(job.metadata.uid)
+        # the causal slice is recorded even on the terminal path
+        assert "SliceUnhealthy" in [e[3] for e in rt.cluster.cluster_events]
 
     def test_worker_failure_exhausts_budget(self):
         rt = self.make_runtime(policy=PodRunPolicy(start_delay=0, run_duration=1,

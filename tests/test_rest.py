@@ -121,7 +121,11 @@ def test_slices_extension(client, cluster):
     cluster.slice_pool.add_pool("v5p-8", 2)
     cluster.slice_pool.allocate_gang("uid-1", "v5p-8", 1)
     held = client.job_slices("uid-1")
-    assert len(held) == 1 and held[0]["accelerator"] == "v5p-8"
+    # Deserialized to TPUSlice at the client boundary (one type for every
+    # consumer — the checker above all).
+    assert len(held) == 1
+    assert held[0].shape.accelerator_type == "v5p-8"
+    assert held[0].healthy and held[0].hosts
     assert client.release_slices("uid-1") == 1
     assert client.job_slices("uid-1") == []
 

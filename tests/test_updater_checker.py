@@ -96,12 +96,19 @@ def test_assess_health_classification():
     assert not checker.assess_health([pods[3]], [ok]).needs_recovery
 
 
-def test_assess_health_reads_wire_dicts():
-    """The REST backend's job_slices returns wire JSON, not TPUSlice —
-    the checker must read both so the controller stays backend-agnostic."""
+def test_assess_health_over_rest_deserialized_slices():
+    """The REST client deserializes slice wire JSON back to TPUSlice at its
+    boundary; the checker consumes the same type from every backend."""
+    from kubeflow_controller_tpu.cluster.slices import slice_to_dict
+
+    sick = TPUSlice(name="s-bad", shape=slice_shape("v5p-8"), healthy=False)
+    wire = slice_to_dict(sick)
+    rebuilt = TPUSlice(
+        name=wire["name"], shape=slice_shape(wire["accelerator"]),
+        healthy=wire["healthy"], hosts=wire["hosts"],
+    )
     r = checker.assess_health(
-        [pod(0, PodPhase.RUNNING, slice_name="s-bad")],
-        [{"name": "s-bad", "healthy": False, "accelerator": "v5p-8"}],
+        [pod(0, PodPhase.RUNNING, slice_name="s-bad")], [rebuilt]
     )
     assert r.at_risk_pods == ["p0"]
     assert r.unhealthy_slices == ["s-bad"]
