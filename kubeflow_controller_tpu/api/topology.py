@@ -106,3 +106,36 @@ def slice_shape(accelerator_type: str) -> SliceShape:
         raise KeyError(
             f"unknown accelerator type {accelerator_type!r}; known: {known}"
         ) from None
+
+
+# GKE's cloud.google.com/gke-tpu-accelerator label values per TPU
+# generation: what real TPU node pools are labeled with (and what pod
+# nodeSelectors must request). The chip count is NOT in this label — GKE
+# encodes it in cloud.google.com/gke-tpu-topology — so the pair
+# (accelerator label, topology) identifies a catalog entry.
+GKE_ACCELERATOR_BY_GENERATION: Dict[str, str] = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+_GENERATION_BY_GKE = {v: k for k, v in GKE_ACCELERATOR_BY_GENERATION.items()}
+
+
+def gke_accelerator(shape: SliceShape) -> str:
+    """The gke-tpu-accelerator label value for a slice shape."""
+    return GKE_ACCELERATOR_BY_GENERATION[shape.generation]
+
+
+def shape_from_gke(gke_type: str, topology: str) -> SliceShape:
+    """Resolve (gke-tpu-accelerator, gke-tpu-topology) node labels back to
+    the catalog entry — the inverse of the nodeSelector the planner emits.
+    Raises KeyError on an unknown generation or a topology not in the
+    catalog."""
+    gen = _GENERATION_BY_GKE.get(gke_type)
+    if gen is None:
+        raise KeyError(f"unknown gke-tpu-accelerator {gke_type!r}")
+    chips = 1
+    for dim in topology.split("x"):
+        chips *= int(dim)
+    return slice_shape(f"{gen}-{chips}")

@@ -215,7 +215,7 @@ def _add_pools(slice_pool, pools) -> None:
 
 
 def cmd_serve(args) -> int:
-    if args.cluster_url:
+    if args.cluster_url or args.kubeconfig or args.in_cluster:
         return _serve_remote(args)
     rt = LocalRuntime(
         default_policy=PodRunPolicy(
@@ -248,20 +248,58 @@ def cmd_serve(args) -> int:
 
 
 def _serve_remote(args) -> int:
-    """Controller-only mode against an apiserver URL — the reference's
+    """Controller-only mode against an apiserver — the reference's
     ``-master``/``-kubeconfig`` topology (``cmd/controller/main.go:31-52``):
     no in-process cluster, no submit API; jobs are created against the
-    apiserver (``tpujobctl apiserver`` or a real one)."""
+    apiserver. Three dial modes:
+
+    - ``--cluster-url URL``              framework wire JSON (tpujobctl
+                                         apiserver);
+    - ``--cluster-url URL --k8s-wire``   strict Kubernetes wire JSON
+                                         (a real apiserver by URL+token, or
+                                         ``apiserver --k8s-wire``);
+    - ``--kubeconfig PATH`` /            a real cluster via kubeconfig
+      ``--in-cluster``                   (auth + TLS + namespace resolved
+                                         the way client-go's clientcmd
+                                         does, main.go:31-43).
+    """
     from kubeflow_controller_tpu.runtime import RemoteRuntime
     from kubeflow_controller_tpu.util.signals import setup_signal_handler
 
+    kube_context = None
+    if args.kubeconfig or args.in_cluster:
+        from kubeflow_controller_tpu.cluster.kubeconfig import (
+            in_cluster_context, load_kubeconfig,
+        )
+
+        if args.in_cluster:
+            kube_context = in_cluster_context()
+            if kube_context is None:
+                print("tpujobctl serve: --in-cluster but no service-account "
+                      "token mounted", flush=True)
+                return 1
+        else:
+            from kubeflow_controller_tpu.cluster.kubeconfig import (
+                KubeconfigError,
+            )
+
+            try:
+                kube_context = load_kubeconfig(args.kubeconfig, args.context)
+            except KubeconfigError as e:
+                print(f"tpujobctl serve: {e}", flush=True)
+                return 1
     rt = RemoteRuntime(
-        args.cluster_url, namespace=args.namespace, token=args.token or ""
+        args.cluster_url or "",
+        namespace=args.namespace,
+        token=args.token or "",
+        k8s=bool(args.k8s_wire or kube_context is not None),
+        kube_context=kube_context,
     )
+    target = args.cluster_url or rt.client.base_url
     stop = setup_signal_handler()
     rt.start(workers=args.workers)
-    print(f"tpujobctl serve: reconciling {args.namespace!r} via "
-          f"{args.cluster_url} ({args.workers} workers)", flush=True)
+    print(f"tpujobctl serve: reconciling {rt.namespace!r} via "
+          f"{target} ({args.workers} workers)", flush=True)
     stop.wait()
     rt.stop()
     print("tpujobctl serve: stopped")
@@ -280,7 +318,9 @@ def cmd_apiserver(args) -> int:
         start_delay=args.pod_start_delay, run_duration=args.pod_run_duration
     ))
     _add_pools(cluster.slice_pool, args.pool)
-    server = RestServer(cluster, port=args.listen).start()
+    server = RestServer(
+        cluster, port=args.listen, k8s_mode=bool(args.k8s_wire)
+    ).start()
     stop = setup_signal_handler()
 
     def ticker() -> None:
@@ -621,16 +661,32 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--pod-run-duration", type=float, default=10.0)
     s.add_argument("--cluster-url",
                    help="reconcile against this apiserver URL instead of an "
-                        "in-process cluster (the -master/-kubeconfig analog)")
+                        "in-process cluster (the -master analog)")
     s.add_argument("--namespace", default="default",
                    help="namespace to reconcile (with --cluster-url)")
     s.add_argument("--token", help="bearer token (with --cluster-url)")
+    s.add_argument("--k8s-wire", action="store_true",
+                   help="speak strict Kubernetes wire JSON to --cluster-url "
+                        "(a real apiserver, or `apiserver --k8s-wire`)")
+    s.add_argument("--kubeconfig",
+                   help="reconcile a real Kubernetes cluster via this "
+                        "kubeconfig (the -kubeconfig analog; implies k8s "
+                        "wire)")
+    s.add_argument("--context",
+                   help="kubeconfig context to use (default: "
+                        "current-context)")
+    s.add_argument("--in-cluster", action="store_true",
+                   help="use the mounted service-account token "
+                        "(controller-as-Deployment)")
     s.set_defaults(fn=cmd_serve)
 
     s = add_parser("apiserver", help="run the REST apiserver facade "
                                      "(pair with serve --cluster-url)")
     s.add_argument("--listen", type=int, default=8378,
                    help="apiserver port (--port is the client-API flag)")
+    s.add_argument("--k8s-wire", action="store_true",
+                   help="serve strict Kubernetes wire JSON (core/v1 + CRD "
+                        "+ status subresource + Nodes)")
     s.add_argument("--pool", action="append",
                    help="slice pool to register, e.g. v5e-16x2 (repeatable)")
     s.add_argument("--pod-start-delay", type=float, default=1.0)

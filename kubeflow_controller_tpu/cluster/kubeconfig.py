@@ -1,0 +1,229 @@
+"""kubeconfig loading — the reference's client bootstrap, rebuilt.
+
+``cmd/controller/main.go:31-43`` starts from ``clientcmd.BuildConfigFromFlags
+(masterURL, kubeconfig)``: resolve a kubeconfig file, pick the current (or
+named) context, and produce a rest.Config (server URL + auth + TLS). This
+module is that path for the TPU framework: parse the standard kubeconfig YAML
+shape (clusters / users / contexts / current-context), resolve one context,
+and build the ``ssl.SSLContext`` + headers ``kube_client.KubeClusterClient``
+needs.
+
+Supported auth/TLS surface (the subset GKE and kubeadm configs actually use
+for controller service accounts):
+
+- ``token`` / ``tokenFile`` bearer auth,
+- ``client-certificate(-data)`` + ``client-key(-data)`` mTLS,
+- ``certificate-authority(-data)`` server verification,
+- ``insecure-skip-tls-verify``.
+
+Exec-plugin credential helpers are intentionally out of scope — controllers
+in-cluster use mounted service-account tokens, which is the ``tokenFile``
+path.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import ssl
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import yaml
+
+
+class KubeconfigError(ValueError):
+    pass
+
+
+@dataclass
+class KubeContext:
+    """One resolved kubeconfig context: everything needed to dial the
+    apiserver."""
+
+    server: str
+    namespace: str = "default"
+    token: str = ""
+    ca_data: str = ""            # PEM text
+    insecure_skip_tls_verify: bool = False
+    client_cert_file: str = ""   # PEM file paths (written if *-data given)
+    client_key_file: str = ""
+    context_name: str = ""
+
+    # Key/cert files this loader materialized from *-data fields. They hold
+    # private key material: written 0600 (NamedTemporaryFile default) and
+    # deleted at process exit via atexit — call cleanup() to remove sooner.
+    _temp_files: list = field(default_factory=list)
+
+    def cleanup(self) -> None:
+        """Remove materialized key/cert temp files."""
+        while self._temp_files:
+            path = self._temp_files.pop()
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def ssl_context(self) -> Optional[ssl.SSLContext]:
+        """TLS context for https:// servers; None for http:// (dev)."""
+        if not self.server.startswith("https"):
+            return None
+        ctx = ssl.create_default_context()
+        if self.insecure_skip_tls_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        elif self.ca_data:
+            ctx = ssl.create_default_context(cadata=self.ca_data)
+        if self.client_cert_file:
+            ctx.load_cert_chain(
+                self.client_cert_file, self.client_key_file or None
+            )
+        return ctx
+
+
+def _b64_text(data: str) -> str:
+    return base64.b64decode(data).decode()
+
+
+def _materialize(pem_text: str, suffix: str, holder: list) -> str:
+    import atexit
+
+    f = tempfile.NamedTemporaryFile(
+        "w", suffix=suffix, delete=False, prefix="tpujob-kubeconfig-"
+    )
+    f.write(pem_text)
+    f.close()
+    holder.append(f.name)
+    atexit.register(lambda path=f.name: _unlink_quiet(path))
+    return f.name
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def _by_name(seq: Any, name: str, what: str) -> Dict[str, Any]:
+    for item in seq or []:
+        if item.get("name") == name:
+            return item
+    raise KubeconfigError(f"kubeconfig: no {what} named {name!r}")
+
+
+def default_kubeconfig_path() -> str:
+    return os.environ.get(
+        "KUBECONFIG", os.path.expanduser("~/.kube/config")
+    )
+
+
+def load_kubeconfig(
+    path: Optional[str] = None, context: Optional[str] = None,
+) -> KubeContext:
+    """Parse a kubeconfig file and resolve one context to a KubeContext.
+
+    ``path`` defaults to ``$KUBECONFIG`` then ``~/.kube/config``;
+    ``context`` defaults to ``current-context``.
+    """
+    path = path or default_kubeconfig_path()
+    try:
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+    except FileNotFoundError:
+        raise KubeconfigError(f"kubeconfig not found: {path}") from None
+    except yaml.YAMLError as e:
+        raise KubeconfigError(f"kubeconfig {path}: invalid YAML: {e}") from None
+    if not isinstance(doc, dict):
+        raise KubeconfigError(f"kubeconfig {path}: not a mapping")
+    return resolve_context(doc, context)
+
+
+def resolve_context(
+    doc: Dict[str, Any], context: Optional[str] = None,
+) -> KubeContext:
+    ctx_name = context or doc.get("current-context")
+    if not ctx_name:
+        raise KubeconfigError(
+            "kubeconfig: no context requested and no current-context set"
+        )
+    ctx = _by_name(doc.get("contexts"), ctx_name, "context").get("context") or {}
+    cluster = _by_name(
+        doc.get("clusters"), ctx.get("cluster", ""), "cluster"
+    ).get("cluster") or {}
+    user: Dict[str, Any] = {}
+    if ctx.get("user"):
+        user = _by_name(doc.get("users"), ctx["user"], "user").get("user") or {}
+
+    server = cluster.get("server", "")
+    if not server:
+        raise KubeconfigError(
+            f"kubeconfig: cluster for context {ctx_name!r} has no server"
+        )
+
+    out = KubeContext(
+        server=server.rstrip("/"),
+        namespace=ctx.get("namespace", "default"),
+        insecure_skip_tls_verify=bool(
+            cluster.get("insecure-skip-tls-verify", False)
+        ),
+        context_name=ctx_name,
+    )
+
+    if cluster.get("certificate-authority-data"):
+        out.ca_data = _b64_text(cluster["certificate-authority-data"])
+    elif cluster.get("certificate-authority"):
+        with open(cluster["certificate-authority"]) as f:
+            out.ca_data = f.read()
+
+    if user.get("token"):
+        out.token = str(user["token"])
+    elif user.get("tokenFile"):
+        with open(user["tokenFile"]) as f:
+            out.token = f.read().strip()
+
+    if user.get("client-certificate-data"):
+        out.client_cert_file = _materialize(
+            _b64_text(user["client-certificate-data"]), ".crt",
+            out._temp_files,
+        )
+    elif user.get("client-certificate"):
+        out.client_cert_file = user["client-certificate"]
+    if user.get("client-key-data"):
+        out.client_key_file = _materialize(
+            _b64_text(user["client-key-data"]), ".key", out._temp_files,
+        )
+    elif user.get("client-key"):
+        out.client_key_file = user["client-key"]
+
+    return out
+
+
+def in_cluster_context() -> Optional[KubeContext]:
+    """The in-cluster config path (mounted service-account token), the way
+    controllers deployed as k8s Deployments authenticate."""
+    sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    token_path = os.path.join(sa, "token")
+    if not host or not os.path.exists(token_path):
+        return None
+    with open(token_path) as f:
+        token = f.read().strip()
+    ca_path = os.path.join(sa, "ca.crt")
+    ca_data = ""
+    if os.path.exists(ca_path):
+        with open(ca_path) as f:
+            ca_data = f.read()
+    ns_path = os.path.join(sa, "namespace")
+    namespace = "default"
+    if os.path.exists(ns_path):
+        with open(ns_path) as f:
+            namespace = f.read().strip() or "default"
+    return KubeContext(
+        server=f"https://{host}:{port}",
+        namespace=namespace,
+        token=token,
+        ca_data=ca_data,
+    )

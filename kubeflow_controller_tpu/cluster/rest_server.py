@@ -35,6 +35,15 @@ JOB_RE = re.compile(
     r"^/apis/tpu\.kubeflow\.dev/v1alpha1/namespaces/([^/]+)/tpujobs"
     r"(?:/([^/]+))?$"
 )
+# Strict-k8s-mode routes: the CRD status subresource, core/v1 Events, and
+# GKE-shaped TPU Nodes (the slice pool expressed the way a real cluster
+# exposes it).
+JOB_STATUS_RE = re.compile(
+    r"^/apis/tpu\.kubeflow\.dev/v1alpha1/namespaces/([^/]+)/tpujobs"
+    r"/([^/]+)/status$"
+)
+K8S_EVENTS_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/events$")
+NODES_PATH = "/api/v1/nodes"
 EVENT_PATH = "/framework/v1/events"
 SLICES_RE = re.compile(r"^/framework/v1/slices/([^/]+)$")
 
@@ -51,15 +60,25 @@ def _parse_query(query: str) -> Dict[str, str]:
 
 
 def _parse_selector(query: str) -> Optional[Dict[str, str]]:
+    sel, _ = _parse_selector_full(query)
+    return sel
+
+
+def _parse_selector_full(query: str):
+    """Equality selector dict + existence-only keys (``labelSelector=key``
+    with no ``=``, which real clients use to scope by label presence)."""
     raw = _parse_query(query).get("labelSelector")
     if not raw:
-        return None
-    sel = {}
+        return None, ()
+    sel: Dict[str, str] = {}
+    exists = []
     for kv in raw.split(","):
         if "=" in kv:
             k, _, v = kv.partition("=")
             sel[k] = v
-    return sel or None
+        elif kv:
+            exists.append(kv)
+    return (sel or None), tuple(exists)
 
 
 class _WatchRegistry:
@@ -91,12 +110,44 @@ class _WatchRegistry:
             q.put(self.CLOSE)
 
 
-def make_rest_handler(cluster: FakeCluster, watches: _WatchRegistry):
-    stores = {
-        "pods": (cluster.pods, pod_to_dict, pod_from_dict),
-        "services": (cluster.services, service_to_dict, service_from_dict),
-        "jobs": (cluster.jobs, job_to_dict, job_from_dict),
+def make_rest_handler(
+    cluster: FakeCluster, watches: _WatchRegistry, k8s_mode: bool = False,
+):
+    """Build the request handler.
+
+    ``k8s_mode=True`` is the strict-Kubernetes facade: genuine core/v1 /
+    CRD wire JSON (``kube_wire``), k8s List envelopes with a collection
+    resourceVersion, the real watch protocol (``resourceVersion=N`` resume,
+    k8s BOOKMARK frames, no framework SYNC marker), the TPUJob **status
+    subresource** (main PUT ignores status; ``/status`` PUT applies only
+    status), core/v1 Events, and GKE-shaped TPU Nodes synthesized from the
+    slice pool. This is the hermetic twin of a real apiserver that
+    ``kube_client.KubeClusterClient`` drives — the same client config
+    pointed at a real cluster needs no code change.
+    """
+    from kubeflow_controller_tpu.cluster import kube_wire
+
+    if k8s_mode:
+        stores = {
+            "pods": (cluster.pods, kube_wire.pod_to_k8s,
+                     kube_wire.pod_from_k8s),
+            "services": (cluster.services, kube_wire.service_to_k8s,
+                         kube_wire.service_from_k8s),
+            "jobs": (cluster.jobs, kube_wire.job_to_k8s,
+                     kube_wire.job_from_k8s),
+        }
+    else:
+        stores = {
+            "pods": (cluster.pods, pod_to_dict, pod_from_dict),
+            "services": (cluster.services, service_to_dict, service_from_dict),
+            "jobs": (cluster.jobs, job_to_dict, job_from_dict),
+        }
+    list_envelopes = {
+        "pods": ("v1", "PodList"),
+        "services": ("v1", "ServiceList"),
+        "jobs": (kube_wire.JOB_API_VERSION, "TPUJobList"),
     }
+    watch_kinds = {"pods": "Pod", "services": "Service", "jobs": "TPUJob"}
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -132,6 +183,8 @@ def make_rest_handler(cluster: FakeCluster, watches: _WatchRegistry):
                         b["kind"], b["name"], b["reason"], b["message"]
                     )
                     return self._send(200, {"ok": True})
+                if k8s_mode and self._handle_k8s(method, path):
+                    return
                 m = SLICES_RE.match(path)
                 if m:
                     uid = m.group(1)
@@ -154,12 +207,28 @@ def make_rest_handler(cluster: FakeCluster, watches: _WatchRegistry):
                 kind, ns, name, query = matched
                 store, to_dict, from_dict = stores[kind]
                 if method == "GET" and name is None:
-                    sel = _parse_selector(query)
+                    sel, exists = _parse_selector_full(query)
                     q = _parse_query(query)
                     if q.get("watch") in ("true", "1"):
-                        return self._watch(store, to_dict, ns, sel, q)
+                        return self._watch(store, to_dict, ns, sel, q, kind)
+                    items = store.list(ns, sel)
+                    if exists:
+                        items = [
+                            o for o in items
+                            if all(k in o.metadata.labels for k in exists)
+                        ]
+                    if k8s_mode:
+                        api_version, list_kind = list_envelopes[kind]
+                        return self._send(200, {
+                            "apiVersion": api_version,
+                            "kind": list_kind,
+                            "metadata": {
+                                "resourceVersion": str(store.revision),
+                            },
+                            "items": [to_dict(o) for o in items],
+                        })
                     return self._send(200, {
-                        "items": [to_dict(o) for o in store.list(ns, sel)]
+                        "items": [to_dict(o) for o in items]
                     })
                 if method == "GET":
                     return self._send(200, to_dict(store.get(ns, name)))
@@ -168,6 +237,13 @@ def make_rest_handler(cluster: FakeCluster, watches: _WatchRegistry):
                     return self._send(201, to_dict(store.create(obj)))
                 if method == "PUT":
                     obj = from_dict(self._body())
+                    if k8s_mode and kind == "jobs":
+                        # Status subresource semantics: the main resource
+                        # PUT cannot touch .status (apiextensions behavior
+                        # once `subresources.status` is registered).
+                        stored = store.try_get(ns, name)
+                        if stored is not None:
+                            obj.status = stored.status
                     return self._send(200, to_dict(store.update(obj)))
                 if method == "DELETE":
                     store.delete(ns, name)
@@ -182,7 +258,56 @@ def make_rest_handler(cluster: FakeCluster, watches: _WatchRegistry):
             except Exception as e:
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
-        def _watch(self, store, to_dict, ns, sel, q) -> None:
+        def _handle_k8s(self, method: str, path: str) -> bool:
+            """Strict-k8s-only routes. Returns True if the request was
+            handled (response already sent)."""
+            m = JOB_STATUS_RE.match(path)
+            if m and method == "PUT":
+                ns, name = m.group(1), m.group(2)
+                incoming = kube_wire.job_from_k8s(self._body())
+                # Apply ONLY .status, under the caller's resourceVersion —
+                # store.update enforces the optimistic-concurrency check.
+                cur = cluster.jobs.get(ns, name)
+                cur.status = incoming.status
+                cur.metadata.resource_version = (
+                    incoming.metadata.resource_version
+                )
+                out = cluster.jobs.update(cur)
+                self._send(200, kube_wire.job_to_k8s(out))
+                return True
+            m = K8S_EVENTS_RE.match(path)
+            if m and method == "POST":
+                b = self._body()
+                inv = b.get("involvedObject") or {}
+                cluster.record_event(
+                    inv.get("kind", ""), inv.get("name", ""),
+                    b.get("reason", ""), b.get("message", ""),
+                )
+                self._send(201, b)
+                return True
+            if path == NODES_PATH and method == "GET":
+                from kubeflow_controller_tpu.api.topology import (
+                    gke_accelerator,
+                )
+
+                nodes = []
+                for s in cluster.slice_pool.list():
+                    for host in s.hosts:
+                        nodes.append(kube_wire.node_to_k8s(
+                            host, pool=s.name,
+                            accelerator=gke_accelerator(s.shape),
+                            topology=s.shape.topology_str,
+                            ready=s.healthy,
+                        ))
+                self._send(200, {
+                    "apiVersion": "v1", "kind": "NodeList",
+                    "metadata": {"resourceVersion": "0"},
+                    "items": nodes,
+                })
+                return True
+            return False
+
+        def _watch(self, store, to_dict, ns, sel, q, kind=None) -> None:
             """``?watch=true``: stream newline-delimited JSON watch events.
 
             The k8s chunked-watch analog (the verb the reference's informers
@@ -199,6 +324,21 @@ def make_rest_handler(cluster: FakeCluster, watches: _WatchRegistry):
 
             timeout_s = float(q.get("timeoutSeconds") or 0)
             heartbeat_s = float(q.get("heartbeatSeconds") or 5)
+            # k8s watch resume point: replayed objects at or below this
+            # resourceVersion were already in the caller's List response.
+            from_rv = int(q.get("resourceVersion") or 0) if k8s_mode else 0
+            if k8s_mode and from_rv and store.last_delete_revision > from_rv:
+                # A delete happened after the caller's List; with no event
+                # history it cannot be replayed — real apiservers answer
+                # 410 Gone when the watch cache can't serve an RV, and the
+                # client relists.
+                return self._send(410, {
+                    "kind": "Status", "apiVersion": "v1",
+                    "status": "Failure", "reason": "Expired",
+                    "message": f"too old resource version: {from_rv}",
+                    "code": 410,
+                })
+            in_replay = True
             deadline = (time.monotonic() + timeout_s) if timeout_s else None
             events: "queue.Queue" = queue.Queue()
             if not watches.register(events):
@@ -221,14 +361,43 @@ def make_rest_handler(cluster: FakeCluster, watches: _WatchRegistry):
                     except queue.Empty:
                         if deadline is not None and time.monotonic() >= deadline:
                             return
-                        self._stream_line({"type": "BOOKMARK"})
+                        if k8s_mode:
+                            api_version, _ = list_envelopes[kind]
+                            self._stream_line({
+                                "type": "BOOKMARK",
+                                "object": {
+                                    "apiVersion": api_version,
+                                    "kind": watch_kinds[kind],
+                                    "metadata": {
+                                        "resourceVersion":
+                                            str(store.revision),
+                                    },
+                                },
+                            })
+                        else:
+                            self._stream_line({"type": "BOOKMARK"})
                         continue
                     if ev is _WatchRegistry.CLOSE:
                         return  # server stopping: drop the stream
                     if ev is None:
-                        self._stream_line({"type": "SYNC"})
+                        in_replay = False
+                        if not k8s_mode:
+                            # k8s has no SYNC frame: the client's List
+                            # already was the sync point.
+                            self._stream_line({"type": "SYNC"})
                         continue
                     obj = ev.obj
+                    if (
+                        k8s_mode and in_replay
+                        and ev.type != EventType.DELETED
+                        and obj.metadata.resource_version <= from_rv
+                    ):
+                        # Caller's List already contained this object.
+                        # DELETED is exempt: a delete event carries the
+                        # object's LAST resourceVersion (possibly older
+                        # than the List) and suppressing it would leave
+                        # the client a phantom object.
+                        continue
                     if ns is not None and obj.metadata.namespace != ns:
                         continue
                     etype = ev.type
@@ -288,12 +457,19 @@ def make_rest_handler(cluster: FakeCluster, watches: _WatchRegistry):
 
 
 class RestServer:
-    """In-process apiserver facade; bind port 0 for an ephemeral port."""
+    """In-process apiserver facade; bind port 0 for an ephemeral port.
 
-    def __init__(self, cluster: FakeCluster, port: int = 0):
+    ``k8s_mode=True`` serves strict Kubernetes wire JSON + protocol (see
+    ``make_rest_handler``) for driving ``kube_client.KubeClusterClient``
+    hermetically."""
+
+    def __init__(
+        self, cluster: FakeCluster, port: int = 0, k8s_mode: bool = False,
+    ):
         self._watches = _WatchRegistry()
         self._httpd = ThreadingHTTPServer(
-            ("127.0.0.1", port), make_rest_handler(cluster, self._watches)
+            ("127.0.0.1", port),
+            make_rest_handler(cluster, self._watches, k8s_mode=k8s_mode),
         )
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True

@@ -56,6 +56,7 @@ class ObjectStore:
         self._lock = threading.RLock()
         self._objects: Dict[str, Any] = {}
         self._rv = 0
+        self._last_delete_rv = 0
         self._listeners: List[Listener] = []
         # Label indexes (client-go Indexer analog): selector lists on an
         # indexed key touch only matching objects instead of scanning the
@@ -204,7 +205,13 @@ class ObjectStore:
                 raise NotFound(f"{self.kind} {key}")
             self._index_remove(key, obj)
             self._rv += 1
-            self._emit(WatchEvent(EventType.DELETED, self.kind, obj.deepcopy()))
+            self._last_delete_rv = self._rv
+            # The tombstone carries the DELETION's revision (k8s watch
+            # semantics): a watcher that saw this event can resume from its
+            # resourceVersion without tripping the 410 relist path.
+            tomb = obj.deepcopy()
+            tomb.metadata.resource_version = self._rv
+            self._emit(WatchEvent(EventType.DELETED, self.kind, tomb))
             return obj
 
     # -- listing -------------------------------------------------------------
@@ -232,6 +239,24 @@ class ObjectStore:
                     continue
                 out.append(obj.deepcopy())
             return out
+
+    @property
+    def revision(self) -> int:
+        """Store-wide resourceVersion high-water mark — what a k8s List
+        response carries in ``.metadata.resourceVersion`` (the point a
+        watch resumes from)."""
+        with self._lock:
+            return self._rv
+
+    @property
+    def last_delete_revision(self) -> int:
+        """Revision of the most recent delete. A k8s-mode watch resuming
+        from an OLDER resourceVersion cannot be replayed faithfully (this
+        store keeps no event history, and the deleted object is gone from
+        the replay set) — the server answers 410 Gone and the client
+        relists, exactly real watch-cache-expiry semantics."""
+        with self._lock:
+            return self._last_delete_rv
 
     def __len__(self) -> int:
         with self._lock:

@@ -37,23 +37,43 @@ class RemoteRuntime:
 
     def __init__(
         self,
-        cluster_url: str,
+        cluster_url: str = "",
         namespace: str = "default",
         token: str = "",
         resync_period: float = 30.0,
         watch_timeout_seconds: float = 0,
+        k8s: bool = False,
+        kube_context=None,
     ):
-        from kubeflow_controller_tpu.cluster.rest_client import (
-            RestClusterClient, RestWatchSource,
-        )
-
         self.namespace = namespace
-        self.client = RestClusterClient(cluster_url, token=token)
-        self._sources = [
-            RestWatchSource(self.client, kind, namespace,
-                            timeout_seconds=watch_timeout_seconds)
-            for kind in ("TPUJob", "Pod", "Service")
-        ]
+        if k8s or kube_context is not None:
+            # Real-Kubernetes wiring (the reference's actual topology:
+            # core/v1 + CRD wire JSON, kubeconfig auth, list-then-watch).
+            from kubeflow_controller_tpu.cluster.kube_client import (
+                KubeClusterClient, KubeWatchSource,
+            )
+
+            self.client = KubeClusterClient(
+                cluster_url or None, token=token, namespace=namespace,
+                kube_context=kube_context,
+            )
+            self.namespace = namespace = self.client.namespace
+            self._sources = [
+                KubeWatchSource(self.client, kind, namespace,
+                                timeout_seconds=watch_timeout_seconds)
+                for kind in ("TPUJob", "Pod", "Service")
+            ]
+        else:
+            from kubeflow_controller_tpu.cluster.rest_client import (
+                RestClusterClient, RestWatchSource,
+            )
+
+            self.client = RestClusterClient(cluster_url, token=token)
+            self._sources = [
+                RestWatchSource(self.client, kind, namespace,
+                                timeout_seconds=watch_timeout_seconds)
+                for kind in ("TPUJob", "Pod", "Service")
+            ]
         job_src, pod_src, svc_src = self._sources
         self.job_informer = Informer(job_src, resync_period)
         self.pod_informer = Informer(pod_src, resync_period)

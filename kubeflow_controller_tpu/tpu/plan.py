@@ -380,9 +380,15 @@ def _build_pod(
             **main.resources,
             "google.com/tpu": shape.chips_per_host,
         }
+        # Real GKE label values: the accelerator label names the TPU
+        # generation (e.g. tpu-v5-lite-podslice); the chip count rides the
+        # topology label. Emitting catalog names here would produce pods no
+        # real GKE node could ever satisfy.
+        from kubeflow_controller_tpu.api.topology import gke_accelerator
+
         pod.spec.node_selector = {
             **pod.spec.node_selector,
-            "cloud.google.com/gke-tpu-accelerator": shape.accelerator_type,
+            "cloud.google.com/gke-tpu-accelerator": gke_accelerator(shape),
             "cloud.google.com/gke-tpu-topology": shape.topology_str,
         }
     main = pod.spec.main_container()

@@ -222,8 +222,12 @@ class TestGangJobLifecycle:
         assert env0["TPUJOB_MODEL_DIR"] == "/ckpt/job"
         # TPU resources + GKE node selectors stamped
         assert pods[0].spec.containers[0].resources["google.com/tpu"] == 4
+        # real GKE label values: generation in the accelerator label, chip
+        # count in the topology label
         assert pods[0].spec.node_selector[
-            "cloud.google.com/gke-tpu-accelerator"] == "v5p-8"
+            "cloud.google.com/gke-tpu-accelerator"] == "tpu-v5p-slice"
+        assert pods[0].spec.node_selector[
+            "cloud.google.com/gke-tpu-topology"] == "2x2x2"
 
     def test_running_phase_and_conditions(self):
         rt = self.make_runtime(policy=PodRunPolicy(start_delay=1, run_duration=100))
@@ -544,7 +548,7 @@ class TestResize:
                 if p.metadata.labels[naming.LABEL_EPOCH] == str(job.status.restarts)]
         assert all(
             p.spec.node_selector["cloud.google.com/gke-tpu-accelerator"]
-            == "v5e-8" for p in pods
+            == "tpu-v5-lite-podslice" for p in pods
         )
 
 

@@ -1,0 +1,603 @@
+"""Real-Kubernetes adapter tests.
+
+Three layers of proof that the controller can drive a genuine apiserver
+(the reference's entire operating mode, ``cmd/controller/main.go:31-43``):
+
+1. **Golden wire fixtures** — the exact JSON ``kube_wire`` emits for a
+   planner-built TPU worker pod, a coordinator service, a TPUJob CR, and an
+   Event is pinned byte-for-byte in ``tests/fixtures/k8s/``. Those files are
+   themselves valid ``kubectl apply`` manifests (core/v1 + the CRD group
+   from ``examples/crd/tpujob-crd.yml``).
+2. **Protocol** — KubeClusterClient against ``RestServer(k8s_mode=True)``:
+   CRUD with k8s List envelopes, optimistic-concurrency conflicts, the
+   status subresource split, existence label selectors, list-then-watch
+   with resourceVersion resume, node-pool slice health.
+3. **The controller unmodified** — a full job lifecycle reconciled over
+   strict k8s wire: RemoteRuntime(k8s=True) takes a TPUJob CR to Succeeded
+   through gang scheduling on the hermetic cluster.
+
+Regenerate fixtures after an intentional wire change:
+``REGEN_K8S_FIXTURES=1 python -m pytest tests/test_kube.py -q``.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    Container, ObjectMeta, OwnerReference, Pod, PodPhase, PodSpec,
+    PodTemplateSpec, Service, ServicePort, ServiceSpec,
+)
+from kubeflow_controller_tpu.api.types import (
+    JobPhase, ReplicaSpec, ReplicaType, TPUJob, TPUJobSpec, TPUSliceSpec,
+)
+from kubeflow_controller_tpu.cluster import kube_wire
+from kubeflow_controller_tpu.cluster.cluster import FakeCluster, PodRunPolicy
+from kubeflow_controller_tpu.cluster.kube_client import (
+    KubeClusterClient, KubeWatchSource,
+)
+from kubeflow_controller_tpu.cluster.kubeconfig import (
+    KubeconfigError, load_kubeconfig,
+)
+from kubeflow_controller_tpu.cluster.rest_server import RestServer
+from kubeflow_controller_tpu.cluster.store import Conflict, NotFound
+from kubeflow_controller_tpu.tpu.plan import plan_job
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "k8s")
+
+
+# -- deterministic objects ----------------------------------------------------
+
+def fixture_job() -> TPUJob:
+    """A v5e-16 2-host worker job exactly as validation+defaulting leaves
+    it, with the identity fields a live job carries."""
+    job = TPUJob(
+        metadata=ObjectMeta(
+            name="bert-pretrain", namespace="default",
+            uid="uid-00000042-beef", resource_version=7,
+            creation_timestamp=1000.0,
+        ),
+        spec=TPUJobSpec(
+            runtime_id="r1a2b",
+            model_dir="/ckpt/bert-pretrain",
+            replica_specs=[ReplicaSpec(
+                replica_type=ReplicaType.WORKER,
+                template=PodTemplateSpec(spec=PodSpec(containers=[
+                    Container(
+                        name="trainer", image="tpujob/bert:latest",
+                        command=["python", "-m",
+                                 "kubeflow_controller_tpu.dataplane."
+                                 "entrypoints.bert"],
+                    ),
+                ])),
+                tpu=TPUSliceSpec(accelerator_type="v5e-16", num_slices=1),
+                max_restarts=3,
+            )],
+        ),
+    )
+    job.status.phase = JobPhase.PENDING
+    job.status.submit_time = 1000.0
+    return job
+
+
+def fixture_pod() -> Pod:
+    """The FIRST worker pod the planner actually emits for fixture_job —
+    the golden fixture pins what the controller would POST to a real
+    apiserver, not a hand-written approximation."""
+    plan = plan_job(fixture_job(), [], [])
+    pod = plan.create_pods[0]
+    return pod
+
+
+def fixture_service() -> Service:
+    plan = plan_job(fixture_job(), [], [])
+    assert plan.create_services, "planner should create a coordinator service"
+    return plan.create_services[0]
+
+
+def _golden(name: str, payload: dict) -> None:
+    path = os.path.join(FIXTURES, name)
+    text = json.dumps(payload, indent=2, sort_keys=True) + "\n"
+    if os.environ.get("REGEN_K8S_FIXTURES"):
+        os.makedirs(FIXTURES, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
+    with open(path) as f:
+        assert f.read() == text, (
+            f"wire JSON for {name} drifted from the golden fixture; if the "
+            f"change is intentional: REGEN_K8S_FIXTURES=1 pytest {__file__}"
+        )
+
+
+class TestGoldenWire:
+    def test_pod_fixture(self):
+        _golden("pod.json", kube_wire.pod_to_k8s(fixture_pod()))
+
+    def test_service_fixture(self):
+        _golden("service.json", kube_wire.service_to_k8s(fixture_service()))
+
+    def test_job_fixture(self):
+        _golden("tpujob.json", kube_wire.job_to_k8s(fixture_job()))
+
+    def test_event_fixture(self):
+        _golden("event.json", kube_wire.event_to_k8s(
+            "Pod", "bert-pretrain-r1a2b-worker-e0-0", "default",
+            "FailedCreate", "injected create failure", ts=1000.0,
+        ))
+
+    def test_pod_fixture_is_core_v1(self):
+        """Structural invariants a real apiserver would enforce."""
+        wire = kube_wire.pod_to_k8s(fixture_pod())
+        assert wire["apiVersion"] == "v1" and wire["kind"] == "Pod"
+        c = wire["spec"]["containers"][0]
+        # env is a name/value LIST on the wire, not a mapping
+        assert isinstance(c["env"], list) and all(
+            set(e) <= {"name", "value"} for e in c["env"]
+        )
+        # extended resources must appear in limits with requests == limits
+        assert c["resources"]["limits"]["google.com/tpu"] == \
+            c["resources"]["requests"]["google.com/tpu"]
+        # GKE TPU placement contract: REAL label values (generation name +
+        # topology), not framework catalog names
+        sel = wire["spec"]["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == \
+            "tpu-v5-lite-podslice"
+        assert sel["cloud.google.com/gke-tpu-topology"] == "4x4"
+        # ownership: a controller ref pointing at the TPUJob CR
+        ref = wire["metadata"]["ownerReferences"][0]
+        assert ref["kind"] == "TPUJob" and ref["controller"] is True
+        assert ref["apiVersion"] == "tpu.kubeflow.dev/v1alpha1"
+
+    def test_pod_roundtrip_identity(self):
+        pod = fixture_pod()
+        back = kube_wire.pod_from_k8s(kube_wire.pod_to_k8s(pod))
+        assert back == pod
+
+    def test_pod_roundtrip_with_status(self):
+        pod = fixture_pod()
+        pod.metadata.uid = "uid-1"
+        pod.metadata.resource_version = 3
+        pod.status.phase = PodPhase.FAILED
+        pod.status.reason = "Preempted"
+        pod.status.host_ip = "pool-v5e-16-slice-0-host-1"
+        pod.status.start_time = 5.0
+        pod.status.finish_time = 9.0
+        pod.status.exit_code = 137
+        pod.spec.assigned_slice = "pool-v5e-16/slice-0"
+        back = kube_wire.pod_from_k8s(kube_wire.pod_to_k8s(pod))
+        assert back == pod
+        wire = kube_wire.pod_to_k8s(pod)
+        term = wire["status"]["containerStatuses"][0]["state"]["terminated"]
+        assert term["exitCode"] == 137
+
+    def test_service_roundtrip(self):
+        svc = fixture_service()
+        back = kube_wire.service_from_k8s(kube_wire.service_to_k8s(svc))
+        assert back == svc
+        # coordinator services are headless on the wire
+        assert kube_wire.service_to_k8s(svc)["spec"]["clusterIP"] == "None"
+
+    def test_job_roundtrip(self):
+        job = fixture_job()
+        back = kube_wire.job_from_k8s(kube_wire.job_to_k8s(job))
+        assert back == job
+
+    def test_non_numeric_resource_version_rejected(self):
+        with pytest.raises(ValueError, match="resourceVersion"):
+            kube_wire.meta_from_k8s({"name": "x", "resourceVersion": "abc"})
+
+
+# -- kubeconfig ---------------------------------------------------------------
+
+KUBECONFIG_YAML = """\
+apiVersion: v1
+kind: Config
+current-context: gke-tpu
+contexts:
+- name: gke-tpu
+  context: {cluster: tpu-cluster, user: controller, namespace: training}
+- name: other
+  context: {cluster: plain, user: tokenless}
+clusters:
+- name: tpu-cluster
+  cluster:
+    server: https://34.1.2.3
+    certificate-authority-data: {ca64}
+- name: plain
+  cluster:
+    server: http://127.0.0.1:8378
+    insecure-skip-tls-verify: true
+users:
+- name: controller
+  user: {token: sekrit-token}
+- name: tokenless
+  user: {}
+"""
+
+
+class TestKubeconfig:
+    def _write(self, tmp_path):
+        import base64
+
+        ca = "-----BEGIN CERTIFICATE-----\nZZZZ\n-----END CERTIFICATE-----\n"
+        text = KUBECONFIG_YAML.replace(
+            "{ca64}", base64.b64encode(ca.encode()).decode()
+        )
+        path = tmp_path / "config"
+        path.write_text(text)
+        return str(path), ca
+
+    def test_current_context(self, tmp_path):
+        path, ca = self._write(tmp_path)
+        ctx = load_kubeconfig(path)
+        assert ctx.server == "https://34.1.2.3"
+        assert ctx.token == "sekrit-token"
+        assert ctx.namespace == "training"
+        assert ctx.ca_data == ca
+
+    def test_ssl_context_with_real_ca(self, tmp_path):
+        import base64
+        import shutil
+        import subprocess
+
+        if shutil.which("openssl") is None:
+            pytest.skip("openssl not available to mint a test CA")
+        key = tmp_path / "ca.key"
+        crt = tmp_path / "ca.crt"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(crt), "-days", "1",
+             "-subj", "/CN=test-ca"],
+            check=True, capture_output=True,
+        )
+        ca_pem = crt.read_text()
+        text = KUBECONFIG_YAML.replace(
+            "{ca64}", base64.b64encode(ca_pem.encode()).decode()
+        )
+        path = tmp_path / "config"
+        path.write_text(text)
+        ctx = load_kubeconfig(str(path))
+        ssl_ctx = ctx.ssl_context()
+        assert ssl_ctx is not None
+        import ssl as ssl_mod
+
+        assert ssl_ctx.verify_mode == ssl_mod.CERT_REQUIRED
+
+    def test_named_context_http(self, tmp_path):
+        path, _ = self._write(tmp_path)
+        ctx = load_kubeconfig(path, context="other")
+        assert ctx.server == "http://127.0.0.1:8378"
+        assert ctx.token == ""
+        assert ctx.namespace == "default"
+        assert ctx.ssl_context() is None  # http: no TLS
+
+    def test_unknown_context(self, tmp_path):
+        path, _ = self._write(tmp_path)
+        with pytest.raises(KubeconfigError, match="no context"):
+            load_kubeconfig(path, context="nope")
+
+    def test_missing_file(self, tmp_path):
+        with pytest.raises(KubeconfigError, match="not found"):
+            load_kubeconfig(str(tmp_path / "absent"))
+
+    def test_client_builds_from_context(self, tmp_path):
+        path, _ = self._write(tmp_path)
+        ctx = load_kubeconfig(path)
+        # skip CA verification here: the fixture CA is a placeholder (the
+        # real-CA path is covered by test_ssl_context_with_real_ca)
+        ctx.ca_data = ""
+        ctx.insecure_skip_tls_verify = True
+        client = KubeClusterClient(kube_context=ctx)
+        assert client.base_url == "https://34.1.2.3"
+        assert client.token == "sekrit-token"
+        assert client.namespace == "training"
+
+
+# -- protocol against the strict-k8s server -----------------------------------
+
+@pytest.fixture()
+def cluster():
+    return FakeCluster(default_policy=PodRunPolicy(
+        start_delay=1.0, run_duration=3.0
+    ))
+
+
+@pytest.fixture()
+def kube(cluster):
+    server = RestServer(cluster, k8s_mode=True).start()
+    yield KubeClusterClient(server.url, namespace="default")
+    server.stop()
+
+
+def make_pod(name, labels=None, annotations=None):
+    return Pod(metadata=ObjectMeta(
+        name=name, namespace="default", labels=labels or {},
+        annotations=annotations or {},
+    ), spec=PodSpec(containers=[Container(name="c", image="img")]))
+
+
+class TestKubeProtocol:
+    def test_pod_crud(self, kube, cluster):
+        created = kube.create_pod(make_pod("p1", labels={"a": "1"}))
+        assert created.metadata.resource_version > 0
+        assert [p.metadata.name for p in kube.list_pods("default", {"a": "1"})] == ["p1"]
+        assert kube.list_pods("default", {"a": "2"}) == []
+        kube.delete_pod("default", "p1")
+        assert kube.list_pods("default", {}) == []
+        # SuccessfulCreate/SuccessfulDelete events arrived as core/v1 Events
+        reasons = [e[3] for e in cluster.cluster_events]
+        assert "SuccessfulCreate" in reasons and "SuccessfulDelete" in reasons
+
+    def test_update_conflict(self, kube):
+        created = kube.create_pod(make_pod("p1"))
+        stale = created.deepcopy()
+        created.metadata.labels["x"] = "1"
+        kube.update_pod(created)
+        stale.metadata.labels["x"] = "2"
+        with pytest.raises(Conflict):
+            kube.update_pod(stale)
+
+    def test_job_status_subresource_split(self, kube):
+        job = fixture_job()
+        job.metadata.resource_version = 0
+        job.metadata.uid = ""
+        created = kube.create_job(job)
+
+        # A main-resource PUT cannot smuggle status past the subresource.
+        tampered = created.deepcopy()
+        tampered.status.phase = JobPhase.SUCCEEDED
+        wire = kube_wire.job_to_k8s(tampered)
+        kube._request(
+            "PUT",
+            f"/apis/tpu.kubeflow.dev/v1alpha1/namespaces/default/tpujobs/"
+            f"{created.metadata.name}",
+            wire,
+        )
+        got = kube.get_job("default", created.metadata.name)
+        assert got.status.phase != JobPhase.SUCCEEDED
+
+        # update_job (spec PUT + status PUT) lands both.
+        got.spec.priority = 7
+        got.status.phase = JobPhase.RUNNING
+        updated = kube.update_job(got)
+        assert updated.spec.priority == 7
+        assert updated.status.phase == JobPhase.RUNNING
+        persisted = kube.get_job("default", created.metadata.name)
+        assert persisted.status.phase == JobPhase.RUNNING
+
+    def test_list_then_watch_resume(self, kube, cluster):
+        kube.create_pod(make_pod("pre"))
+        items, rv = kube.list_raw("Pod", "default")
+        assert [p.metadata.name for p in items] == ["pre"]
+        events = []
+        done = threading.Event()
+
+        def consume():
+            for ev in kube.watch("Pod", "default", resource_version=rv,
+                                 timeout_seconds=3):
+                events.append(ev)
+                if len(events) >= 2:
+                    break
+            done.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        kube.create_pod(make_pod("post"))
+        kube.delete_pod("default", "post")
+        assert done.wait(10)
+        names = [(e.type.value, e.obj.metadata.name) for e in events]
+        # pre-list object must NOT replay; post-list mutations must arrive
+        assert ("ADDED", "post") == names[0]
+        assert names[1][1] == "post"
+
+    def test_watch_delivers_delete_of_old_object(self, kube):
+        """A pod created long before the List must still produce a DELETED
+        watch event (tombstones carry the deletion revision, so the
+        replay-suppression filter cannot eat them)."""
+        kube.create_pod(make_pod("old"))
+        # bump the store revision well past the pod's own RV
+        for i in range(3):
+            kube.create_pod(make_pod(f"fill{i}"))
+        _, rv = kube.list_raw("Pod", "default")
+        got = []
+        done = threading.Event()
+
+        def consume():
+            for ev in kube.watch("Pod", "default", resource_version=rv,
+                                 timeout_seconds=5):
+                got.append(ev)
+                break
+            done.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        kube.delete_pod("default", "old")
+        assert done.wait(10)
+        assert got[0].type.value == "DELETED"
+        assert got[0].obj.metadata.name == "old"
+
+    def test_watch_from_pre_delete_rv_gets_410(self, kube):
+        """Resuming a watch from before a delete cannot be served (no event
+        history) — the server must 410 so the client relists instead of
+        keeping a phantom object."""
+        from kubeflow_controller_tpu.cluster.kube_client import WatchExpired
+
+        kube.create_pod(make_pod("doomed"))
+        _, rv = kube.list_raw("Pod", "default")
+        kube.delete_pod("default", "doomed")
+        with pytest.raises(WatchExpired):
+            for _ in kube.watch("Pod", "default", resource_version=rv,
+                                timeout_seconds=2):
+                pass
+
+    def test_update_pod_preserves_unknown_spec_fields(self, kube):
+        """Claiming's metadata update must not strip server-populated spec
+        fields our dataclasses don't model (volumes, nodeName,
+        tolerations, ... — a real apiserver 422s a PUT that drops them).
+        Intercept the transport: the PUT body must be the LIVE wire
+        document with only metadata overlaid."""
+        pod = make_pod("adoptee", labels={"a": "1"})
+        pod.metadata.resource_version = 9
+        live_doc = kube_wire.pod_to_k8s(pod)
+        live_doc["spec"]["volumes"] = [{"name": "workdir", "emptyDir": {}}]
+        live_doc["spec"]["nodeName"] = "gke-node-7"
+        calls = []
+
+        def fake_request(method, path, payload=None, **kw):
+            calls.append((method, path, payload))
+            if method == "GET":
+                return json.loads(json.dumps(live_doc))
+            assert method == "PUT"
+            return payload
+
+        kube._request = fake_request
+        desired = pod.deepcopy()
+        desired.metadata.labels["claimed"] = "yes"
+        kube.update_pod(desired)
+        put_body = calls[-1][2]
+        assert put_body["spec"]["volumes"] == live_doc["spec"]["volumes"]
+        assert put_body["spec"]["nodeName"] == "gke-node-7"
+        assert put_body["metadata"]["labels"]["claimed"] == "yes"
+        assert put_body["metadata"]["resourceVersion"] == "9"
+
+    def test_informer_over_kube_watch(self, kube, cluster):
+        from kubeflow_controller_tpu.controller.informer import Informer
+
+        src = KubeWatchSource(kube, "Pod", "default")
+        informer = Informer(src, resync_period=0.0)
+        seen = []
+        informer.add_handler(lambda ev: seen.append(
+            (ev.type.value, ev.obj.metadata.name)
+        ))
+        kube.create_pod(make_pod("w1"))
+        informer.start()
+        assert informer.has_synced()
+        kube.create_pod(make_pod("w2"))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if {"w1", "w2"} <= {n for _, n in seen}:
+                break
+            time.sleep(0.05)
+        assert {"w1", "w2"} <= {n for _, n in seen}
+        src.stop()
+
+    def test_node_pool_slice_health(self, kube, cluster):
+        cluster.slice_pool.add_pool("v5e-16", 2)
+        slices = cluster.slice_pool.list("v5e-16")
+        owner = OwnerReference(
+            api_version="tpu.kubeflow.dev/v1alpha1", kind="TPUJob",
+            name="j", uid="uid-slicejob",
+        )
+        pod = make_pod(
+            "sp0",
+            labels={"tpu.kubeflow.dev/job": "j",
+                    "tpu.kubeflow.dev/runtime-id": "r"},
+        )
+        pod.metadata.owner_references = [owner]
+        pod.spec.assigned_slice = slices[0].name
+        pod.spec.node_selector = {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "4x4",
+        }
+        kube.create_pod(pod)
+
+        held = kube.job_slices("uid-slicejob")
+        assert [s.name for s in held] == [slices[0].name]
+        assert held[0].healthy
+        assert len(held[0].hosts) == slices[0].shape.num_hosts
+
+        # NotReady nodes (degraded slice) surface as unhealthy
+        cluster.slice_pool.mark_unhealthy(slices[0].name)
+        kube._node_cache = (0.0, [])  # drop the client's node cache
+        held = kube.job_slices("uid-slicejob")
+        assert not held[0].healthy
+
+    def test_release_slices_is_noop(self, kube):
+        assert kube.release_slices("whatever") == 0
+
+
+# -- the controller, unmodified, over strict k8s wire -------------------------
+
+class TestControllerOverKube:
+    def test_local_job_to_succeeded(self, cluster):
+        from kubeflow_controller_tpu.runtime import RemoteRuntime
+
+        server = RestServer(cluster, k8s_mode=True).start()
+        rt = RemoteRuntime(server.url, k8s=True, resync_period=1.0)
+        stop = threading.Event()
+
+        def ticker():
+            while not stop.wait(0.05):
+                cluster.tick(0.05)
+
+        threading.Thread(target=ticker, daemon=True).start()
+        try:
+            rt.start(workers=2)
+            job = TPUJob(
+                metadata=ObjectMeta(name="k8s-local", namespace="default"),
+                spec=TPUJobSpec(replica_specs=[ReplicaSpec(
+                    replica_type=ReplicaType.LOCAL,
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="t", image="img"),
+                    ])),
+                )]),
+            )
+            rt.client.create_job(job)
+            deadline = time.monotonic() + 60
+            phase = None
+            while time.monotonic() < deadline:
+                got = rt.client.get_job("default", "k8s-local")
+                phase = got.status.phase if got else None
+                if phase == JobPhase.SUCCEEDED:
+                    break
+                time.sleep(0.1)
+            assert phase == JobPhase.SUCCEEDED
+        finally:
+            stop.set()
+            rt.stop()
+            server.stop()
+
+    def test_gang_job_to_succeeded(self, cluster):
+        """A 2-host v5e-16 gang through real wire: all-or-nothing admission
+        on the slice pool, coordinator service, Succeeded."""
+        from kubeflow_controller_tpu.runtime import RemoteRuntime
+
+        cluster.slice_pool.add_pool("v5e-16", 1)
+        server = RestServer(cluster, k8s_mode=True).start()
+        rt = RemoteRuntime(server.url, k8s=True, resync_period=1.0)
+        stop = threading.Event()
+
+        def ticker():
+            while not stop.wait(0.05):
+                cluster.tick(0.05)
+
+        threading.Thread(target=ticker, daemon=True).start()
+        try:
+            rt.start(workers=2)
+            job = fixture_job()
+            job.metadata = ObjectMeta(name="k8s-gang", namespace="default")
+            job.spec.runtime_id = ""
+            job.status.phase = JobPhase.NONE
+            job.status.submit_time = None
+            rt.client.create_job(job)
+            deadline = time.monotonic() + 60
+            phase = None
+            while time.monotonic() < deadline:
+                got = rt.client.get_job("default", "k8s-gang")
+                phase = got.status.phase if got else None
+                if phase == JobPhase.SUCCEEDED:
+                    break
+                time.sleep(0.1)
+            assert phase == JobPhase.SUCCEEDED
+            # the gang really rode the slice pool
+            reasons = [e[3] for e in cluster.cluster_events]
+            assert "GangScheduled" in reasons
+        finally:
+            stop.set()
+            rt.stop()
+            server.stop()
