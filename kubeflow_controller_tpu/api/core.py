@@ -48,6 +48,21 @@ class OwnerReference:
     controller: bool = True
     block_owner_deletion: bool = True
 
+    # Hand-rolled copies throughout this module: the cluster store
+    # deep-copies on every get/list/update/emit, which made generic
+    # copy.deepcopy ~90% of control-plane wall time at 1000-job scale
+    # (benchmarks/controlplane_bench.py). Field coverage is guarded by
+    # tests/test_deepcopy.py, which fails loudly when a field is added
+    # without updating its copy method.
+    def deepcopy(self) -> "OwnerReference":
+        return OwnerReference(
+            self.api_version, self.kind, self.name, self.uid,
+            self.controller, self.block_owner_deletion,
+        )
+
+    def __deepcopy__(self, memo) -> "OwnerReference":
+        return self.deepcopy()
+
 
 @dataclass
 class ObjectMeta:
@@ -73,6 +88,23 @@ class ObjectMeta:
                 return ref
         return None
 
+    def deepcopy(self) -> "ObjectMeta":
+        return ObjectMeta(
+            name=self.name,
+            generate_name=self.generate_name,
+            namespace=self.namespace,
+            uid=self.uid,
+            resource_version=self.resource_version,
+            labels=dict(self.labels),
+            annotations=dict(self.annotations),
+            owner_references=[r.deepcopy() for r in self.owner_references],
+            creation_timestamp=self.creation_timestamp,
+            deletion_timestamp=self.deletion_timestamp,
+        )
+
+    def __deepcopy__(self, memo) -> "ObjectMeta":
+        return self.deepcopy()
+
 
 @dataclass
 class Container:
@@ -82,8 +114,23 @@ class Container:
     args: List[str] = field(default_factory=list)
     env: Dict[str, str] = field(default_factory=dict)
     ports: List[int] = field(default_factory=list)
-    # Resource requests, e.g. {"google.com/tpu": 4, "cpu": 8}.
+    # Resource requests: scalar quantities keyed by resource name, e.g.
+    # {"google.com/tpu": 4, "cpu": 8} (scalars only — deepcopy relies on it).
     resources: Dict[str, Any] = field(default_factory=dict)
+
+    def deepcopy(self) -> "Container":
+        return Container(
+            name=self.name,
+            image=self.image,
+            command=list(self.command),
+            args=list(self.args),
+            env=dict(self.env),
+            ports=list(self.ports),
+            resources=dict(self.resources),
+        )
+
+    def __deepcopy__(self, memo) -> "Container":
+        return self.deepcopy()
 
 
 @dataclass
@@ -102,6 +149,18 @@ class PodSpec:
     def main_container(self) -> Container:
         return self.containers[0]
 
+    def deepcopy(self) -> "PodSpec":
+        return PodSpec(
+            containers=[c.deepcopy() for c in self.containers],
+            restart_policy=self.restart_policy,
+            node_selector=dict(self.node_selector),
+            scheduling_group=self.scheduling_group,
+            assigned_slice=self.assigned_slice,
+        )
+
+    def __deepcopy__(self, memo) -> "PodSpec":
+        return self.deepcopy()
+
 
 @dataclass
 class PodStatus:
@@ -114,6 +173,21 @@ class PodStatus:
     finish_time: Optional[float] = None
     exit_code: Optional[int] = None
 
+    def deepcopy(self) -> "PodStatus":
+        return PodStatus(
+            phase=self.phase,
+            reason=self.reason,
+            message=self.message,
+            pod_ip=self.pod_ip,
+            host_ip=self.host_ip,
+            start_time=self.start_time,
+            finish_time=self.finish_time,
+            exit_code=self.exit_code,
+        )
+
+    def __deepcopy__(self, memo) -> "PodStatus":
+        return self.deepcopy()
+
 
 @dataclass
 class Pod:
@@ -125,7 +199,16 @@ class Pod:
     api_version: str = "v1"
 
     def deepcopy(self) -> "Pod":
-        return copy.deepcopy(self)
+        return Pod(
+            metadata=self.metadata.deepcopy(),
+            spec=self.spec.deepcopy(),
+            status=self.status.deepcopy(),
+            kind=self.kind,
+            api_version=self.api_version,
+        )
+
+    def __deepcopy__(self, memo) -> "Pod":
+        return self.deepcopy()
 
 
 @dataclass
@@ -138,7 +221,12 @@ class PodTemplateSpec:
     spec: PodSpec = field(default_factory=PodSpec)
 
     def deepcopy(self) -> "PodTemplateSpec":
-        return copy.deepcopy(self)
+        return PodTemplateSpec(
+            metadata=self.metadata.deepcopy(), spec=self.spec.deepcopy(),
+        )
+
+    def __deepcopy__(self, memo) -> "PodTemplateSpec":
+        return self.deepcopy()
 
 
 @dataclass
@@ -147,12 +235,28 @@ class ServicePort:
     name: str = ""
     target_port: Optional[int] = None
 
+    def deepcopy(self) -> "ServicePort":
+        return ServicePort(self.port, self.name, self.target_port)
+
+    def __deepcopy__(self, memo) -> "ServicePort":
+        return self.deepcopy()
+
 
 @dataclass
 class ServiceSpec:
     selector: Dict[str, str] = field(default_factory=dict)
     ports: List[ServicePort] = field(default_factory=list)
     cluster_ip: str = ""
+
+    def deepcopy(self) -> "ServiceSpec":
+        return ServiceSpec(
+            selector=dict(self.selector),
+            ports=[p.deepcopy() for p in self.ports],
+            cluster_ip=self.cluster_ip,
+        )
+
+    def __deepcopy__(self, memo) -> "ServiceSpec":
+        return self.deepcopy()
 
 
 @dataclass
@@ -164,7 +268,15 @@ class Service:
     api_version: str = "v1"
 
     def deepcopy(self) -> "Service":
-        return copy.deepcopy(self)
+        return Service(
+            metadata=self.metadata.deepcopy(),
+            spec=self.spec.deepcopy(),
+            kind=self.kind,
+            api_version=self.api_version,
+        )
+
+    def __deepcopy__(self, memo) -> "Service":
+        return self.deepcopy()
 
     def dns_name(self) -> str:
         return f"{self.metadata.name}.{self.metadata.namespace}.svc"

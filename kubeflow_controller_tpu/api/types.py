@@ -102,6 +102,15 @@ class TPUSliceSpec:
     # checker's preemption-recovery path.
     provisioning: str = "on-demand"
 
+    def deepcopy(self) -> "TPUSliceSpec":
+        return TPUSliceSpec(
+            self.accelerator_type, self.num_slices,
+            self.topology, self.provisioning,
+        )
+
+    def __deepcopy__(self, memo) -> "TPUSliceSpec":
+        return self.deepcopy()
+
 
 @dataclass
 class ChiefSpec:
@@ -110,10 +119,24 @@ class ChiefSpec:
     replica_name: str = "Worker"
     replica_index: int = 0
 
+    def deepcopy(self) -> "ChiefSpec":
+        return ChiefSpec(self.replica_name, self.replica_index)
+
+    def __deepcopy__(self, memo) -> "ChiefSpec":
+        return self.deepcopy()
+
 
 @dataclass
 class TerminationPolicySpec:
     chief: Optional[ChiefSpec] = None
+
+    def deepcopy(self) -> "TerminationPolicySpec":
+        return TerminationPolicySpec(
+            self.chief.deepcopy() if self.chief else None
+        )
+
+    def __deepcopy__(self, memo) -> "TerminationPolicySpec":
+        return self.deepcopy()
 
 
 @dataclass
@@ -130,6 +153,22 @@ class ReplicaSpec:
     # Job-level restart budget for failed pods before the job goes Failed
     # (reference has only pod-level restartPolicy, SURVEY.md §5.3).
     max_restarts: int = 3
+
+    def deepcopy(self) -> "ReplicaSpec":
+        return ReplicaSpec(
+            replica_type=self.replica_type,
+            replicas=self.replicas,
+            template=self.template.deepcopy() if self.template else None,
+            tpu=self.tpu.deepcopy(),
+            termination_policy=(
+                self.termination_policy.deepcopy()
+                if self.termination_policy else None
+            ),
+            max_restarts=self.max_restarts,
+        )
+
+    def __deepcopy__(self, memo) -> "ReplicaSpec":
+        return self.deepcopy()
 
 
 @dataclass
@@ -157,6 +196,22 @@ class TPUJobSpec:
     # ttlSecondsAfterFinished semantics).
     ttl_seconds_after_finished: Optional[int] = None
 
+    def deepcopy(self) -> "TPUJobSpec":
+        return TPUJobSpec(
+            runtime_id=self.runtime_id,
+            data_dir=self.data_dir,
+            model_dir=self.model_dir,
+            log_dir=self.log_dir,
+            export_dir=self.export_dir,
+            replica_specs=[rs.deepcopy() for rs in self.replica_specs],
+            suspend=self.suspend,
+            priority=self.priority,
+            ttl_seconds_after_finished=self.ttl_seconds_after_finished,
+        )
+
+    def __deepcopy__(self, memo) -> "TPUJobSpec":
+        return self.deepcopy()
+
 
 @dataclass
 class Condition:
@@ -166,6 +221,15 @@ class Condition:
     message: str = ""
     last_transition_time: float = 0.0
 
+    def deepcopy(self) -> "Condition":
+        return Condition(
+            self.type, self.status, self.reason, self.message,
+            self.last_transition_time,
+        )
+
+    def __deepcopy__(self, memo) -> "Condition":
+        return self.deepcopy()
+
 
 @dataclass
 class ReplicaStatus:
@@ -173,6 +237,12 @@ class ReplicaStatus:
     state: ReplicaState = ReplicaState.UNKNOWN
     # Histogram of pod states, mirror of TFReplicasStates (types.go:163-165).
     states: Dict[ReplicaState, int] = field(default_factory=dict)
+
+    def deepcopy(self) -> "ReplicaStatus":
+        return ReplicaStatus(self.type, self.state, dict(self.states))
+
+    def __deepcopy__(self, memo) -> "ReplicaStatus":
+        return self.deepcopy()
 
 
 @dataclass
@@ -195,6 +265,23 @@ class TPUJobStatus:
     # When the last gang restart fired (controller clock) — drives the
     # exponential failure-restart backoff.
     last_restart_time: float = 0.0
+
+    def deepcopy(self) -> "TPUJobStatus":
+        return TPUJobStatus(
+            phase=self.phase,
+            reason=self.reason,
+            conditions=[c.deepcopy() for c in self.conditions],
+            replica_statuses=[r.deepcopy() for r in self.replica_statuses],
+            submit_time=self.submit_time,
+            all_running_time=self.all_running_time,
+            completion_time=self.completion_time,
+            restarts=self.restarts,
+            resizes=self.resizes,
+            last_restart_time=self.last_restart_time,
+        )
+
+    def __deepcopy__(self, memo) -> "TPUJobStatus":
+        return self.deepcopy()
 
     def set_condition(
         self,
@@ -254,7 +341,16 @@ class TPUJob:
     api_version: str = f"{API_GROUP}/{API_VERSION}"
 
     def deepcopy(self) -> "TPUJob":
-        return copy.deepcopy(self)
+        return TPUJob(
+            metadata=self.metadata.deepcopy(),
+            spec=self.spec.deepcopy(),
+            status=self.status.deepcopy(),
+            kind=self.kind,
+            api_version=self.api_version,
+        )
+
+    def __deepcopy__(self, memo) -> "TPUJob":
+        return self.deepcopy()
 
     @property
     def key(self) -> str:

@@ -39,7 +39,10 @@ class ClusterClient(Protocol):
 
     def record_event(self, kind: str, name: str, reason: str, message: str) -> None: ...
     def release_slices(self, job_uid: str) -> int: ...
-    def job_slices(self, job_uid: str): ...
+    # job_name is an optional routing hint: backends that resolve slices
+    # through pod queries (the real-k8s adapter) use it for a server-side
+    # equality selector; inventory-backed backends key on uid alone.
+    def job_slices(self, job_uid: str, job_name: str = ""): ...
 
 
 class FakeClusterClient:
@@ -116,5 +119,5 @@ class FakeClusterClient:
     def release_slices(self, job_uid: str) -> int:
         return self.cluster.slice_pool.release(job_uid)
 
-    def job_slices(self, job_uid: str):
+    def job_slices(self, job_uid: str, job_name: str = ""):
         return self.cluster.slice_pool.holdings(job_uid)

@@ -111,6 +111,14 @@ class FakeCluster:
         self.services = ObjectStore(
             "Service", now_fn=lambda: self.now, index_labels=(LABEL_JOB,))
         self.jobs = ObjectStore("TPUJob", now_fn=lambda: self.now)
+        # Scheduler/kubelet work queues: every tick touches only pods that
+        # can actually change state — unbound Pending pods (scheduler) and
+        # live pods (kubelet) — instead of scanning the whole store.
+        # Maintained from the pod watch stream, so they can never drift
+        # from the store (membership is re-derived on every event).
+        self._pending_keys: set = set()
+        self._active_keys: set = set()
+        self.pods.subscribe(self._track_pod, replay=False)
         self.slice_pool = SlicePool()
         self.faults = FaultInjector()
         self.default_policy = default_policy or PodRunPolicy(
@@ -127,6 +135,40 @@ class FakeCluster:
         # The fake kubelet writes lifecycle lines; run_fn workloads may append
         # via append_pod_log.
         self.pod_logs: Dict[str, List[tuple]] = {}
+
+    # -- pod work-queue tracking ---------------------------------------------
+
+    def _track_pod(self, ev) -> None:
+        from kubeflow_controller_tpu.cluster.events import EventType
+
+        pod = ev.obj
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        with self._lock:
+            if ev.type == EventType.DELETED:
+                self._pending_keys.discard(key)
+                self._active_keys.discard(key)
+                return
+            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                self._active_keys.discard(key)
+            else:
+                self._active_keys.add(key)
+            if (
+                pod.status.phase == PodPhase.PENDING
+                and not pod.spec.assigned_slice
+                and pod.metadata.deletion_timestamp is None
+            ):
+                self._pending_keys.add(key)
+            else:
+                self._pending_keys.discard(key)
+
+    def _pods_by_keys(self, keys) -> List[Pod]:
+        out = []
+        for key in keys:
+            ns, _, name = key.partition("/")
+            pod = self.pods.try_get(ns, name)
+            if pod is not None:
+                out.append(pod)
+        return out
 
     # -- event recording -----------------------------------------------------
 
@@ -174,8 +216,17 @@ class FakeCluster:
         return self._runtimes.setdefault(pod.metadata.uid, _PodRuntime())
 
     def _schedule_pending(self) -> None:
+        with self._lock:
+            # Sorted: set iteration order is hash-seed dependent, and gang
+            # rank ties break by stable-sort input order — admission must
+            # not vary run to run in a deterministic simulator.
+            keys = sorted(self._pending_keys)
+        if not keys:
+            return
+        # Membership is re-checked on the fresh copies: the index is an
+        # over-approximation between event delivery and this read.
         pending = [
-            p for p in self.pods.list()
+            p for p in self._pods_by_keys(keys)
             if p.status.phase == PodPhase.PENDING and not p.spec.assigned_slice
             and p.metadata.deletion_timestamp is None
         ]
@@ -313,7 +364,11 @@ class FakeCluster:
 
     def _advance_pods(self) -> None:
         spawned: List[tuple] = []   # (pod, runtime, policy) started this tick
-        for pod in self.pods.list():
+        with self._lock:
+            keys = list(self._active_keys)
+        if not keys:
+            return
+        for pod in self._pods_by_keys(sorted(keys)):
             if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
                 continue
             rt = self._runtime(pod)
@@ -422,7 +477,9 @@ class FakeCluster:
         reason Preempted. Returns names of failed pods."""
         self.slice_pool.preempt(slice_name)
         failed = []
-        for pod in self.pods.list():
+        with self._lock:
+            keys = list(self._active_keys)
+        for pod in self._pods_by_keys(sorted(keys)):
             if pod.spec.assigned_slice == slice_name and pod.status.phase in (
                 PodPhase.PENDING, PodPhase.RUNNING,
             ):

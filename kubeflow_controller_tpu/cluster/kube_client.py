@@ -344,8 +344,13 @@ class KubeClusterClient:
             self._node_cache = (time.monotonic(), nodes)
         return nodes
 
-    def job_slices(self, job_uid: str):
-        """Slice health for one job, derived from its pods' node pools."""
+    def job_slices(self, job_uid: str, job_name: str = ""):
+        """Slice health for one job, derived from its pods' node pools.
+
+        With ``job_name`` the pod query is a server-side equality selector
+        (one job's pods); without it, a presence selector over all
+        framework pods with client-side uid filtering — correct but
+        O(namespace pods) per call."""
         from kubeflow_controller_tpu.api.topology import (
             shape_from_gke, slice_shape,
         )
@@ -355,10 +360,13 @@ class KubeClusterClient:
         from kubeflow_controller_tpu.cluster.slices import TPUSlice
         from kubeflow_controller_tpu.tpu.naming import LABEL_JOB
 
+        selector = (
+            f"{LABEL_JOB}={job_name}" if job_name else LABEL_JOB
+        )
         out = self._request(
             "GET",
             self._collection("Pod", self.namespace)
-            + "?labelSelector=" + urllib.parse.quote(LABEL_JOB),
+            + "?labelSelector=" + urllib.parse.quote(selector),
         )
         pools: List[str] = []
         shape_hint = None

@@ -277,7 +277,7 @@ class RestClusterClient:
             "DELETE", f"/framework/v1/slices/{job_uid}"
         )["released"]
 
-    def job_slices(self, job_uid: str):
+    def job_slices(self, job_uid: str, job_name: str = ""):
         # Deserialize to TPUSlice at the client boundary (the inverse of the
         # server's slice_to_dict) so every consumer — the checker above all —
         # sees ONE type regardless of backend.

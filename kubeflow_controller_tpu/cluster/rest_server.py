@@ -210,7 +210,9 @@ def make_rest_handler(
                     sel, exists = _parse_selector_full(query)
                     q = _parse_query(query)
                     if q.get("watch") in ("true", "1"):
-                        return self._watch(store, to_dict, ns, sel, q, kind)
+                        return self._watch(
+                            store, to_dict, ns, sel, q, kind, exists
+                        )
                     items = store.list(ns, sel)
                     if exists:
                         items = [
@@ -307,7 +309,9 @@ def make_rest_handler(
                 return True
             return False
 
-        def _watch(self, store, to_dict, ns, sel, q, kind=None) -> None:
+        def _watch(
+            self, store, to_dict, ns, sel, q, kind=None, exists=(),
+        ) -> None:
             """``?watch=true``: stream newline-delimited JSON watch events.
 
             The k8s chunked-watch analog (the verb the reference's informers
@@ -401,16 +405,22 @@ def make_rest_handler(
                     if ns is not None and obj.metadata.namespace != ns:
                         continue
                     etype = ev.type
-                    if sel:
+                    if sel or exists:
                         # k8s selector-scoped watch semantics: events are
                         # rewritten by the (old-matched, new-matched)
                         # transition so watchers only ever see objects in
                         # their scope — entering scope is ADDED, leaving
                         # is DELETED, never-in-scope is invisible.
+                        # Existence-only terms scope the watch exactly as
+                        # they scope the list — list+watch must agree or
+                        # informer caches hold objects their own relist
+                        # would tombstone.
                         def _m(o):
                             return o is not None and all(
                                 o.metadata.labels.get(k) == v
-                                for k, v in sel.items()
+                                for k, v in (sel or {}).items()
+                            ) and all(
+                                k in o.metadata.labels for k in exists
                             )
 
                         now_in = _m(obj) and etype != EventType.DELETED

@@ -65,6 +65,43 @@ class SlicePool:
     def __init__(self):
         self._lock = threading.Lock()
         self._slices: Dict[str, TPUSlice] = {}
+        # Indexes (insertion-ordered dict-sets, deterministic but NOT
+        # provisioning-order after churn: a released slice re-enters the
+        # free index at the back, so reuse is approximately
+        # least-recently-released rather than lowest-numbered):
+        # accelerator type -> names; free+healthy per type; holder -> names.
+        # At 5000-job scale the full-pool scans in allocate_gang/holdings
+        # were the control plane's top cost (controlplane_bench profile); every
+        # holder/health mutation funnels through _set_holder/_set_healthy
+        # so the indexes cannot drift.
+        self._by_type: Dict[str, Dict[str, None]] = {}
+        self._free: Dict[str, Dict[str, None]] = {}
+        self._by_holder: Dict[str, Dict[str, None]] = {}
+
+    # -- index maintenance (call with lock held) -----------------------------
+
+    def _refresh_free(self, s: TPUSlice) -> None:
+        free = self._free.setdefault(s.shape.accelerator_type, {})
+        if not s.holder and s.healthy:
+            free[s.name] = None
+        else:
+            free.pop(s.name, None)
+
+    def _set_holder(self, s: TPUSlice, holder: str) -> None:
+        if s.holder:
+            held = self._by_holder.get(s.holder)
+            if held is not None:
+                held.pop(s.name, None)
+                if not held:
+                    del self._by_holder[s.holder]
+        s.holder = holder
+        if holder:
+            self._by_holder.setdefault(holder, {})[s.name] = None
+        self._refresh_free(s)
+
+    def _set_healthy(self, s: TPUSlice, healthy: bool) -> None:
+        s.healthy = healthy
+        self._refresh_free(s)
 
     def add_pool(self, accelerator_type: str, count: int, pool_name: str = "") -> List[str]:
         """Provision ``count`` slices of a type; returns their names."""
@@ -72,13 +109,14 @@ class SlicePool:
         pool = pool_name or f"pool-{accelerator_type}"
         names = []
         with self._lock:
-            base = sum(
-                1 for s in self._slices.values()
-                if s.shape.accelerator_type == accelerator_type
-            )
+            base = len(self._by_type.get(accelerator_type, {}))
             for i in range(count):
                 name = f"{pool}/slice-{base + i}"
-                self._slices[name] = TPUSlice(name=name, shape=shape)
+                s = TPUSlice(name=name, shape=shape)
+                self._slices[name] = s
+                self._by_type.setdefault(
+                    shape.accelerator_type, {})[name] = None
+                self._refresh_free(s)
                 names.append(name)
         return names
 
@@ -88,16 +126,19 @@ class SlicePool:
 
     def list(self, accelerator_type: Optional[str] = None) -> List[TPUSlice]:
         with self._lock:
+            if accelerator_type is None:
+                return list(self._slices.values())
             return [
-                s for s in self._slices.values()
-                if accelerator_type is None
-                or s.shape.accelerator_type == accelerator_type
+                self._slices[n]
+                for n in self._by_type.get(accelerator_type, {})
             ]
 
     def free(self, accelerator_type: str) -> List[TPUSlice]:
-        return [
-            s for s in self.list(accelerator_type) if not s.holder and s.healthy
-        ]
+        with self._lock:
+            return [
+                self._slices[n]
+                for n in self._free.get(accelerator_type, {})
+            ]
 
     def allocate_gang(
         self, job_uid: str, accelerator_type: str, num_slices: int
@@ -114,57 +155,50 @@ class SlicePool:
             # useless to this job: release them up front — before the
             # capacity check — so they can never be leaked by an
             # InsufficientCapacity exit, nor deadlock two type-swapping jobs.
-            for s in self._slices.values():
-                if (
-                    s.holder == job_uid
-                    and s.shape.accelerator_type != accelerator_type
-                ):
-                    s.holder = ""
+            for name in list(self._by_holder.get(job_uid, {})):
+                s = self._slices[name]
+                if s.shape.accelerator_type != accelerator_type:
+                    self._set_holder(s, "")
             held = [
-                s for s in self._slices.values()
-                if s.holder == job_uid
-                and s.shape.accelerator_type == accelerator_type
-                and s.healthy
+                self._slices[n]
+                for n in self._by_holder.get(job_uid, {})
+                if self._slices[n].healthy
             ]
             if len(held) >= num_slices:
                 keep = held[:num_slices]
             else:
                 need = num_slices - len(held)
-                avail = [
-                    s for s in self._slices.values()
-                    if not s.holder and s.healthy
-                    and s.shape.accelerator_type == accelerator_type
-                ]
-                if len(avail) < need:
+                avail_names = list(self._free.get(accelerator_type, {}))
+                if len(avail_names) < need:
                     raise InsufficientCapacity(
                         f"need {need} more {accelerator_type} slices for job "
-                        f"{job_uid}, only {len(avail)} free"
+                        f"{job_uid}, only {len(avail_names)} free"
                     )
-                granted = avail[:need]
+                granted = [self._slices[n] for n in avail_names[:need]]
                 for s in granted:
-                    s.holder = job_uid
+                    self._set_holder(s, job_uid)
                 keep = held + granted
             # Surplus same-type holdings (scale-down) go back to the pool —
             # a resized gang must not leak capacity mid-job.
             keep_names = {s.name for s in keep}
-            for s in self._slices.values():
-                if s.holder == job_uid and s.name not in keep_names:
-                    s.holder = ""
+            for name in list(self._by_holder.get(job_uid, {})):
+                if name not in keep_names:
+                    self._set_holder(self._slices[name], "")
             return keep
 
     def release(self, job_uid: str) -> int:
         """Free every slice a job holds; returns count released."""
         with self._lock:
-            n = 0
-            for s in self._slices.values():
-                if s.holder == job_uid:
-                    s.holder = ""
-                    n += 1
-            return n
+            names = list(self._by_holder.get(job_uid, {}))
+            for name in names:
+                self._set_holder(self._slices[name], "")
+            return len(names)
 
     def holdings(self, job_uid: str) -> List[TPUSlice]:
         with self._lock:
-            return [s for s in self._slices.values() if s.holder == job_uid]
+            return [
+                self._slices[n] for n in self._by_holder.get(job_uid, {})
+            ]
 
     # -- fault injection ----------------------------------------------------
 
@@ -176,7 +210,7 @@ class SlicePool:
         replaces the slice (unhealthy holdings don't count as held)."""
         with self._lock:
             s = self._slices[name]
-            s.healthy = False
+            self._set_healthy(s, False)
             return s.holder
 
     def preempt(self, name: str) -> str:
@@ -185,11 +219,11 @@ class SlicePool:
         with self._lock:
             s = self._slices[name]
             evicted = s.holder
-            s.holder = ""
-            s.healthy = False
+            self._set_holder(s, "")
+            self._set_healthy(s, False)
             return evicted
 
     def restore(self, name: str) -> None:
         """Bring a preempted/unhealthy slice back into service."""
         with self._lock:
-            self._slices[name].healthy = True
+            self._set_healthy(self._slices[name], True)

@@ -111,6 +111,9 @@ class Controller:
         self.traces: List[SyncTrace] = []   # ring buffer (last 1000)
         self.sync_count = 0                 # total syncs, never truncated
         self._count_lock = threading.Lock()
+        # Sim-clock backoff deadlines (key -> now_fn deadline); see
+        # _requeue_after / _kick_sim_backoffs.
+        self._sim_backoffs: Dict[str, float] = {}
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
 
@@ -183,6 +186,7 @@ class Controller:
     def drain(self, max_items: int = 1000) -> int:
         """Synchronously process every ready queue item — the deterministic
         test-mode alternative to run()."""
+        self._kick_sim_backoffs()
         n = 0
         while n < max_items:
             item = self.queue.get(timeout=0)
@@ -263,7 +267,8 @@ class Controller:
             and not job.spec.suspend and job.worker_spec() is not None
         ):
             health = assess_health(
-                pods, self.client.job_slices(job.metadata.uid))
+                pods, self.client.job_slices(
+                    job.metadata.uid, job.metadata.name))
         plan = plan_job(job, pods, services, health=health)
         deleting = job.metadata.deletion_timestamp is not None
 
@@ -434,15 +439,37 @@ class Controller:
         return acted
 
     def _requeue_after(self, key: str, remaining: float) -> None:
-        """Requeue a key once ``remaining`` now_fn-seconds elapse. With the
-        real clock the queue's monotonic delay is the same timebase, so one
-        exact requeue suffices; a simulated clock cannot be slept on, so
-        poll at backoff_poll wall-seconds and re-check."""
-        delay = (
-            remaining if self.opts.now_fn is time.time
-            else min(remaining, self.opts.backoff_poll)
-        )
-        self.queue.add_after(key, delay)
+        """Requeue a key once ``remaining`` now_fn-seconds elapse.
+
+        With the real clock the queue's monotonic delay is the same
+        timebase, so one exact requeue suffices. A simulated clock cannot
+        be slept on: record the sim-clock deadline (drain() fires due keys
+        exactly when the sim clock reaches them — the deterministic path)
+        and ALSO park a backoff_poll wall-clock requeue as the threaded-
+        mode fallback, where workers only wake via the queue."""
+        if self.opts.now_fn is time.time:
+            self.queue.add_after(key, remaining)
+            return
+        deadline = self.opts.now_fn() + remaining
+        with self._count_lock:
+            cur = self._sim_backoffs.get(key)
+            if cur is None or deadline < cur:
+                self._sim_backoffs[key] = deadline
+        self.queue.add_after(key, self.opts.backoff_poll)
+
+    def _kick_sim_backoffs(self) -> None:
+        """Promote sim-clock backoff deadlines that have come due into
+        immediate queue adds. No-op on the real clock (the queue's own
+        timer is exact there)."""
+        if not self._sim_backoffs:
+            return
+        now = self.opts.now_fn()
+        with self._count_lock:
+            due = [k for k, d in self._sim_backoffs.items() if d <= now]
+            for k in due:
+                del self._sim_backoffs[k]
+        for k in due:
+            self.queue.add(k)
 
     def _mutate_job(self, ns: str, name: str, fn: Callable[[TPUJob], None]) -> None:
         """Conflict-retried read-modify-write against the job store."""

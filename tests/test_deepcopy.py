@@ -1,0 +1,208 @@
+"""Hand-rolled deepcopy correctness + drift guards.
+
+The cluster store deep-copies on every get/list/update/emit; generic
+``copy.deepcopy`` was ~90% of control-plane wall time at 1000-job scale, so
+``api/core.py`` and ``api/types.py`` carry hand-written copy methods. Two
+risks, two guards:
+
+1. a copy method misses or aliases a field -> the fully-populated
+   equality + independence tests below catch it;
+2. someone adds a dataclass field later and forgets the copy method ->
+   the field-set assertions fail with a pointer here.
+"""
+
+import copy
+import dataclasses
+
+from kubeflow_controller_tpu.api import core, types
+
+
+def full_pod() -> core.Pod:
+    return core.Pod(
+        metadata=full_meta(),
+        spec=core.PodSpec(
+            containers=[core.Container(
+                name="c", image="img", command=["python", "-m", "x"],
+                args=["--a"], env={"K": "V"}, ports=[8476],
+                resources={"google.com/tpu": 4, "cpu": 8},
+            )],
+            restart_policy="Never",
+            node_selector={"pool": "a"},
+            scheduling_group="uid-1",
+            assigned_slice="pool/slice-0",
+        ),
+        status=core.PodStatus(
+            phase=core.PodPhase.FAILED, reason="Preempted", message="m",
+            pod_ip="10.0.0.1", host_ip="host-0", start_time=1.0,
+            finish_time=2.0, exit_code=137,
+        ),
+    )
+
+
+def full_meta() -> core.ObjectMeta:
+    return core.ObjectMeta(
+        name="n", generate_name="n-", namespace="ns", uid="u",
+        resource_version=9, labels={"l": "1"}, annotations={"a": "2"},
+        owner_references=[core.OwnerReference(
+            api_version="v1", kind="TPUJob", name="j", uid="ju",
+            controller=True, block_owner_deletion=False,
+        )],
+        creation_timestamp=3.0, deletion_timestamp=4.0,
+    )
+
+
+def full_service() -> core.Service:
+    return core.Service(
+        metadata=full_meta(),
+        spec=core.ServiceSpec(
+            selector={"s": "1"},
+            ports=[core.ServicePort(port=1, name="p", target_port=2)],
+            cluster_ip="10.1.1.1",
+        ),
+    )
+
+
+def full_job() -> types.TPUJob:
+    job = types.TPUJob(
+        metadata=full_meta(),
+        spec=types.TPUJobSpec(
+            runtime_id="r", data_dir="/d", model_dir="/m", log_dir="/l",
+            export_dir="/e",
+            replica_specs=[types.ReplicaSpec(
+                replica_type=types.ReplicaType.WORKER,
+                replicas=2,
+                template=core.PodTemplateSpec(
+                    metadata=full_meta(),
+                    spec=full_pod().spec,
+                ),
+                tpu=types.TPUSliceSpec(
+                    accelerator_type="v5e-16", num_slices=2,
+                    topology="4x4", provisioning="spot",
+                ),
+                termination_policy=types.TerminationPolicySpec(
+                    chief=types.ChiefSpec(replica_name="Worker",
+                                          replica_index=1),
+                ),
+                max_restarts=5,
+            )],
+            suspend=True, priority=3, ttl_seconds_after_finished=60,
+        ),
+        status=types.TPUJobStatus(
+            phase=types.JobPhase.RECOVERING, reason="r",
+            conditions=[types.Condition(
+                type=types.ConditionType.READY,
+                status=types.ConditionStatus.TRUE,
+                reason="cr", message="cm", last_transition_time=7.0,
+            )],
+            replica_statuses=[types.ReplicaStatus(
+                type=types.ReplicaType.WORKER,
+                state=types.ReplicaState.RUNNING,
+                states={types.ReplicaState.RUNNING: 4},
+            )],
+            submit_time=1.0, all_running_time=2.0, completion_time=3.0,
+            restarts=2, resizes=1, last_restart_time=4.0,
+        ),
+    )
+    return job
+
+
+class TestCopies:
+    def test_pod(self):
+        pod = full_pod()
+        cp = pod.deepcopy()
+        assert cp == pod and cp == copy.deepcopy(pod)
+        cp.spec.containers[0].env["K"] = "changed"
+        cp.metadata.labels["l"] = "changed"
+        cp.metadata.owner_references[0].name = "changed"
+        cp.status.exit_code = 0
+        assert pod.spec.containers[0].env["K"] == "V"
+        assert pod.metadata.labels["l"] == "1"
+        assert pod.metadata.owner_references[0].name == "j"
+        assert pod.status.exit_code == 137
+
+    def test_service(self):
+        svc = full_service()
+        cp = svc.deepcopy()
+        assert cp == svc and cp == copy.deepcopy(svc)
+        cp.spec.ports[0].port = 99
+        cp.spec.selector["s"] = "x"
+        assert svc.spec.ports[0].port == 1
+        assert svc.spec.selector["s"] == "1"
+
+    def test_job(self):
+        job = full_job()
+        cp = job.deepcopy()
+        assert cp == job and cp == copy.deepcopy(job)
+        cp.spec.replica_specs[0].template.spec.containers[0].image = "x"
+        cp.status.conditions[0].reason = "x"
+        cp.status.replica_statuses[0].states[types.ReplicaState.RUNNING] = 0
+        cp.spec.replica_specs[0].termination_policy.chief.replica_index = 9
+        assert job.spec.replica_specs[0].template.spec.containers[0].image == "img"
+        assert job.status.conditions[0].reason == "cr"
+        assert job.status.replica_statuses[0].states[
+            types.ReplicaState.RUNNING] == 4
+        assert job.spec.replica_specs[0].termination_policy.chief.replica_index == 1
+
+    def test_copy_module_dispatch(self):
+        """copy.deepcopy must route through the fast paths (__deepcopy__)."""
+        pod = full_pod()
+        assert copy.deepcopy(pod) == pod
+        job = full_job()
+        assert copy.deepcopy(job) == job
+
+
+# field-name drift guards: adding a dataclass field without updating its
+# deepcopy silently drops/aliases data — update BOTH the copy method and
+# this expected set.
+EXPECTED_FIELDS = {
+    core.OwnerReference: {
+        "api_version", "kind", "name", "uid", "controller",
+        "block_owner_deletion"},
+    core.ObjectMeta: {
+        "name", "generate_name", "namespace", "uid", "resource_version",
+        "labels", "annotations", "owner_references", "creation_timestamp",
+        "deletion_timestamp"},
+    core.Container: {
+        "name", "image", "command", "args", "env", "ports", "resources"},
+    core.PodSpec: {
+        "containers", "restart_policy", "node_selector", "scheduling_group",
+        "assigned_slice"},
+    core.PodStatus: {
+        "phase", "reason", "message", "pod_ip", "host_ip", "start_time",
+        "finish_time", "exit_code"},
+    core.Pod: {"metadata", "spec", "status", "kind", "api_version"},
+    core.PodTemplateSpec: {"metadata", "spec"},
+    core.ServicePort: {"port", "name", "target_port"},
+    core.ServiceSpec: {"selector", "ports", "cluster_ip"},
+    core.Service: {"metadata", "spec", "kind", "api_version"},
+    types.TPUSliceSpec: {
+        "accelerator_type", "num_slices", "topology", "provisioning"},
+    types.ChiefSpec: {"replica_name", "replica_index"},
+    types.TerminationPolicySpec: {"chief"},
+    types.ReplicaSpec: {
+        "replica_type", "replicas", "template", "tpu", "termination_policy",
+        "max_restarts"},
+    types.TPUJobSpec: {
+        "runtime_id", "data_dir", "model_dir", "log_dir", "export_dir",
+        "replica_specs", "suspend", "priority",
+        "ttl_seconds_after_finished"},
+    types.Condition: {
+        "type", "status", "reason", "message", "last_transition_time"},
+    types.ReplicaStatus: {"type", "state", "states"},
+    types.TPUJobStatus: {
+        "phase", "reason", "conditions", "replica_statuses", "submit_time",
+        "all_running_time", "completion_time", "restarts", "resizes",
+        "last_restart_time"},
+    types.TPUJob: {"metadata", "spec", "status", "kind", "api_version"},
+}
+
+
+def test_no_field_drift():
+    for cls, expected in EXPECTED_FIELDS.items():
+        actual = {f.name for f in dataclasses.fields(cls)}
+        assert actual == expected, (
+            f"{cls.__name__} fields changed: added "
+            f"{actual - expected or '{}'}, removed "
+            f"{expected - actual or '{}'} — update {cls.__name__}.deepcopy "
+            f"AND this guard (tests/test_deepcopy.py)"
+        )

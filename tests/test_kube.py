@@ -506,15 +506,19 @@ class TestKubeProtocol:
         }
         kube.create_pod(pod)
 
-        held = kube.job_slices("uid-slicejob")
+        # with the job-name hint: a server-side equality selector
+        held = kube.job_slices("uid-slicejob", "j")
         assert [s.name for s in held] == [slices[0].name]
         assert held[0].healthy
         assert len(held[0].hosts) == slices[0].shape.num_hosts
+        # without the hint: presence selector + client-side uid filter
+        assert [s.name for s in kube.job_slices("uid-slicejob")] == \
+            [slices[0].name]
 
         # NotReady nodes (degraded slice) surface as unhealthy
         cluster.slice_pool.mark_unhealthy(slices[0].name)
         kube._node_cache = (0.0, [])  # drop the client's node cache
-        held = kube.job_slices("uid-slicejob")
+        held = kube.job_slices("uid-slicejob", "j")
         assert not held[0].healthy
 
     def test_release_slices_is_noop(self, kube):
