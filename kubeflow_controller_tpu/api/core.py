@@ -14,7 +14,6 @@ object model, not a Kubernetes client.
 
 from __future__ import annotations
 
-import copy
 import enum
 import itertools
 import time

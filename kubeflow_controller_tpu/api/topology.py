@@ -30,6 +30,13 @@ class SliceShape:
     cores_per_chip: int = 1
 
     @property
+    def hbm_gib_per_chip(self) -> int:
+        """HBM capacity per chip (GiB), per the public TPU system specs:
+        v4 32, v5e 16, v5p 95, v6e 32. Drives the pre-admission memory
+        feasibility gate (parallel/memory.py)."""
+        return {"v4": 32, "v5e": 16, "v5p": 95, "v6e": 32}[self.generation]
+
+    @property
     def num_hosts(self) -> int:
         return max(1, self.num_chips // self.chips_per_host)
 

@@ -16,7 +16,6 @@ reference, the declared-but-inert surface is real here:
 
 from __future__ import annotations
 
-import copy
 import enum
 import time
 from dataclasses import dataclass, field

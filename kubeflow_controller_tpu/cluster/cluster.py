@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from kubeflow_controller_tpu.api.core import Pod, PodPhase, Service
+from kubeflow_controller_tpu.cluster.events import EventType
 from kubeflow_controller_tpu.cluster.slices import (
     InsufficientCapacity,
     SlicePool,
@@ -139,8 +140,6 @@ class FakeCluster:
     # -- pod work-queue tracking ---------------------------------------------
 
     def _track_pod(self, ev) -> None:
-        from kubeflow_controller_tpu.cluster.events import EventType
-
         pod = ev.obj
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
         with self._lock:

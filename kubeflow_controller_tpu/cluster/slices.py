@@ -18,7 +18,14 @@ from kubeflow_controller_tpu.api.topology import SliceShape, slice_shape
 
 @dataclass
 class TPUSlice:
-    """One physical pod-slice in a node pool."""
+    """One physical pod-slice in a node pool.
+
+    ``holder``/``healthy`` are owned by ``SlicePool``, which mirrors them
+    into allocation indexes: mutate them ONLY through pool methods
+    (``allocate_gang``/``release``/``mark_unhealthy``/``preempt``/
+    ``restore``) — writing the fields directly on an object returned by
+    ``list``/``free``/``holdings`` desyncs the indexes.
+    """
 
     name: str                      # e.g. "pool-v5e-16/slice-0"
     shape: SliceShape

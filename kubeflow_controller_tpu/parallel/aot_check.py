@@ -1,0 +1,145 @@
+"""AOT feasibility check: compile the full sharded train step for a target
+mesh WITHOUT the target hardware.
+
+The SPMD program for a 2xv5p-64 Llama-3-8B job (BASELINE.md config #5) can
+be compiled on the CPU backend with 128 virtual devices
+(``--xla_force_host_platform_device_count``): abstract avals in, compiled
+executable + per-device memory stats out, no weights ever materialized.
+Together with the analytic plan (``parallel/memory.py``) this is the
+pre-admission gate proving a config *can* run at its declared topology.
+
+Run as a module (the test harness shells out so the virtual device count
+can be set before backend init):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=128 \
+    python -m kubeflow_controller_tpu.parallel.aot_check \
+        --config llama3_8b --mesh dp=2,fsdp=16,tp=4 --batch 32
+
+Prints one JSON line: mesh, compile seconds, per-device argument/temp bytes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Dict
+
+
+def parse_mesh(spec: str) -> Dict[str, int]:
+    axes: Dict[str, int] = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        axes[name.strip()] = int(size)
+    return axes
+
+
+def aot_compile_train_step(
+    config_name: str,
+    mesh_axes: Dict[str, int],
+    global_batch: int,
+    seq: int = 0,
+) -> Dict:
+    """Lower + compile the adamw train step for ``config_name`` at the
+    given mesh factorization using only abstract inputs. Returns compile
+    timing and the compiler's per-device memory stats."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from kubeflow_controller_tpu.models import transformer as tfm
+    from kubeflow_controller_tpu.parallel.mesh import batch_sharding
+    from kubeflow_controller_tpu.parallel.sharding import opt_state_shardings
+
+    cfg = getattr(tfm, f"{config_name}_config")()
+    seq = seq or cfg.max_seq
+    n_devices = math.prod(mesh_axes.values())
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices for mesh {mesh_axes}, have "
+            f"{len(jax.devices())} — set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices}"
+        )
+    devs = np.array(jax.devices()[:n_devices]).reshape(
+        *mesh_axes.values())
+    mesh = Mesh(devs, tuple(mesh_axes))
+
+    specs = tfm.param_specs(cfg)
+    shapes = jax.eval_shape(lambda: tfm.init_params(cfg, jax.random.key(0)))
+    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+    params_abs = jax.tree.map(
+        lambda a, sh: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
+        shapes, param_sh,
+    )
+    tx = optax.adamw(1e-3)
+    opt_sh = opt_state_shardings(tx, params_abs, param_sh, mesh)
+    opt_abs = jax.eval_shape(tx.init, params_abs)
+    opt_abs = jax.tree.map(
+        lambda a, sh: (
+            jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+            if hasattr(a, "shape") and getattr(a, "ndim", 0) else a
+        ),
+        opt_abs, opt_sh,
+    )
+    batch_sh = batch_sharding(mesh)
+    tok_abs = jax.ShapeDtypeStruct((global_batch, seq), jnp.int32,
+                                   sharding=batch_sh)
+
+    def train_step(params, opt_state, tokens):
+        def lossf(p):
+            return tfm.next_token_loss(cfg, p, {"tokens": tokens})
+
+        (loss, _), grads = jax.value_and_grad(lossf, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    with jax.set_mesh(mesh):
+        jitted = jax.jit(
+            train_step,
+            in_shardings=(param_sh, opt_sh, batch_sh),
+            out_shardings=(param_sh, opt_sh, NamedSharding(mesh, P())),
+        )
+        t0 = time.time()
+        lowered = jitted.lower(params_abs, opt_abs, tok_abs)
+        lower_s = time.time() - t0
+        t1 = time.time()
+        compiled = lowered.compile()
+        compile_s = time.time() - t1
+    stats = compiled.memory_analysis()
+    return {
+        "config": config_name,
+        "mesh": dict(mesh_axes),
+        "global_batch": global_batch,
+        "seq": seq,
+        "lower_s": round(lower_s, 2),
+        "compile_s": round(compile_s, 2),
+        "argument_bytes_per_device": getattr(
+            stats, "argument_size_in_bytes", 0),
+        "temp_bytes_per_device": getattr(stats, "temp_size_in_bytes", 0),
+        "output_bytes_per_device": getattr(stats, "output_size_in_bytes", 0),
+    }
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="llama3_8b")
+    ap.add_argument("--mesh", default="dp=2,fsdp=16,tp=4")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=0)
+    args = ap.parse_args()
+    out = aot_compile_train_step(
+        args.config, parse_mesh(args.mesh), args.batch, args.seq
+    )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,169 @@
+"""Per-chip HBM feasibility arithmetic for sharded training.
+
+BASELINE.md config #5 (Llama-3-8B on 2xv5p-64) is a YAML until something
+proves the model actually FITS its target topology. This module is that
+gate: given a model config, a mesh factorization, and a batch geometry it
+computes the per-chip HBM high-water mark from the real sharded shapes —
+master params, ZeRO-sharded optimizer moments, gradients, remat'd
+activation checkpoints, and the logits/loss peak — and compares it against
+the chip's HBM (``SliceShape.hbm_gib_per_chip``).
+
+The byte counts for params/grads/optimizer are EXACT: they come from
+``jax.eval_shape`` over ``init_params`` and the same ``param_specs`` the
+train step shards with, so any resharding of the model changes the plan
+automatically. Activations are an upper-bound model (documented per term
+below) of what XLA keeps live under scan-over-layers + ``jax.checkpoint``
+with the dots-saveable policy; the multiplier is deliberately conservative.
+
+Used by ``tests/test_llama_fits.py`` (the BASELINE #5 gate, with an AOT
+compile of the full train step at the same mesh shapes) and usable ahead of
+admission for any config.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+GiB = 1024 ** 3
+
+
+def _spec_axes(spec) -> list:
+    """PartitionSpec entries normalized to a list of (axis or tuple or
+    None) per dimension."""
+    return list(spec) if spec is not None else []
+
+
+def sharded_leaf_bytes(shape, dtype_bytes: int, spec, axis_sizes: Dict[str, int]) -> int:
+    """Per-device bytes of one array sharded by ``spec`` over mesh axes of
+    the given sizes. Dims sharded over absent/size-1 axes stay whole;
+    uneven shards round up (XLA pads)."""
+    total = dtype_bytes
+    entries = _spec_axes(spec)
+    for i, dim in enumerate(shape):
+        div = 1
+        if i < len(entries) and entries[i] is not None:
+            names = entries[i]
+            if isinstance(names, str):
+                names = (names,)
+            for name in names:
+                div *= axis_sizes.get(name, 1)
+        total *= math.ceil(dim / div)
+    return total
+
+
+@dataclass
+class MemoryPlan:
+    """Per-chip HBM budget breakdown, all in bytes."""
+
+    params: int = 0           # fp32 master weights (sharded)
+    grads: int = 0            # same shapes/sharding as params
+    opt_state: int = 0        # adam m+v, ZeRO-sharded like params
+    activations: int = 0      # remat checkpoints + in-layer recompute peak
+    logits: int = 0           # lm head output + fp32 softmax peak
+    mesh_axes: Dict[str, int] = field(default_factory=dict)
+    global_batch: int = 0
+    seq: int = 0
+
+    @property
+    def total(self) -> int:
+        return (self.params + self.grads + self.opt_state
+                + self.activations + self.logits)
+
+    def fits(self, hbm_gib_per_chip: float, headroom: float = 0.9) -> bool:
+        """True if the high-water mark fits in ``headroom`` x HBM (the
+        remainder covers XLA scratch, collective buffers, fragmentation)."""
+        return self.total <= hbm_gib_per_chip * GiB * headroom
+
+    def rows(self):
+        return [
+            ("params (fp32 master)", self.params),
+            ("grads", self.grads),
+            ("optimizer (adam m+v)", self.opt_state),
+            ("activations (remat)", self.activations),
+            ("logits/loss peak", self.logits),
+            ("TOTAL", self.total),
+        ]
+
+    def table(self) -> str:
+        out = [f"mesh={self.mesh_axes} global_batch={self.global_batch} "
+               f"seq={self.seq}"]
+        for name, b in self.rows():
+            out.append(f"  {name:24s} {b / GiB:7.2f} GiB")
+        return "\n".join(out)
+
+
+def transformer_memory_plan(
+    cfg,
+    mesh_axes: Dict[str, int],
+    global_batch: int,
+    seq: Optional[int] = None,
+    optimizer_slots: int = 2,
+) -> MemoryPlan:
+    """Per-chip plan for the flagship transformer's train step.
+
+    ``mesh_axes`` maps logical axis name -> size (e.g. dp=2, fsdp=16,
+    tp=4 for 2xv5p-64). Parameter/optimizer bytes derive from the real
+    ``init_params`` shapes + ``param_specs`` shardings; activation terms:
+
+    - checkpoints: scan-over-layers with jax.checkpoint saves each layer's
+      input once: n_layers * B_loc * S_loc * d_model * act_bytes;
+    - in-layer recompute peak: one layer's live set during the backward
+      recompute — attention projections (q,k,v,o) + both FFN halves,
+      tp-sharded, x2 for forward+grad liveness;
+    - embedding output + final norm liveness folded into the same term.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_controller_tpu.models import transformer as tfm
+
+    seq = seq or cfg.max_seq
+    shapes = jax.eval_shape(lambda: tfm.init_params(cfg, jax.random.key(0)))
+    specs = tfm.param_specs(cfg)
+
+    flat_shapes, _ = jax.tree.flatten(shapes)
+    flat_specs, _ = jax.tree.flatten(
+        specs, is_leaf=lambda x: x is None or hasattr(x, "index")
+    )
+    assert len(flat_shapes) == len(flat_specs), "specs/params tree mismatch"
+
+    params_bytes = 0
+    for a, s in zip(flat_shapes, flat_specs):
+        params_bytes += sharded_leaf_bytes(
+            a.shape, jnp.dtype(a.dtype).itemsize, s, mesh_axes)
+
+    # batch shards over every data axis present (dp, fsdp); sequence over sp.
+    batch_div = mesh_axes.get("dp", 1) * mesh_axes.get("fsdp", 1)
+    b_loc = math.ceil(global_batch / batch_div)
+    s_loc = math.ceil(seq / mesh_axes.get("sp", 1))
+    tp = mesh_axes.get("tp", 1)
+    act_bytes = jnp.dtype(cfg.dtype).itemsize
+
+    checkpoints = cfg.n_layers * b_loc * s_loc * cfg.d_model * act_bytes
+    attn_width = cfg.n_heads * cfg.head_dim
+    kv_width = cfg.n_kv_heads * cfg.head_dim
+    in_layer = (
+        b_loc * s_loc * (
+            math.ceil(attn_width / tp) * 2        # q + attention out
+            + math.ceil(kv_width / tp) * 2        # k + v
+            + math.ceil(cfg.d_ff / tp) * 3        # gate, up, gated product
+            + cfg.d_model * 2                     # residual + norm
+        ) * act_bytes
+    ) * 2  # forward + backward-recompute liveness
+
+    logits = b_loc * s_loc * cfg.vocab_size * 4  # fp32 softmax/loss peak
+    # one-hot-free loss still materializes logits + grad-of-logits
+    logits *= 2
+
+    return MemoryPlan(
+        params=params_bytes,
+        grads=params_bytes,
+        opt_state=optimizer_slots * params_bytes,
+        activations=checkpoints + in_layer,
+        logits=logits,
+        mesh_axes=dict(mesh_axes),
+        global_batch=global_batch,
+        seq=seq,
+    )
