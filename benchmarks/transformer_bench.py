@@ -48,6 +48,14 @@ def main() -> None:
     p.add_argument("--attn", default="auto", choices=["auto", "xla", "flash"])
     p.add_argument("--moe-experts", type=int, default=0)
     p.add_argument("--moe-top-k", type=int, default=2)
+    p.add_argument("--moe-group", type=int, default=0,
+                   help="routing group size (0 = config default); dispatch "
+                        "einsum FLOPs scale with group, so smaller groups "
+                        "cut overhead")
+    p.add_argument("--moe-capacity-factor", type=float, default=0.0,
+                   help="capacity factor (0 = config default)")
+    p.add_argument("--moe-dispatch", default="auto",
+                   choices=["auto", "einsum", "gather"])
     p.add_argument("--peak-tflops", type=float, default=DEFAULT_PEAK_TFLOPS)
     p.add_argument("--no-remat", action="store_true")
     p.add_argument("--loss-chunk", type=int, default=0)
@@ -59,6 +67,12 @@ def main() -> None:
         max_seq=args.seq, attn_impl=args.attn, remat=not args.no_remat,
         moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
     )
+    if args.moe_group:
+        cfg = cfg.replace(moe_group_size=args.moe_group)
+    if args.moe_capacity_factor:
+        cfg = cfg.replace(moe_capacity_factor=args.moe_capacity_factor)
+    if args.moe_dispatch != "auto":
+        cfg = cfg.replace(moe_dispatch=args.moe_dispatch)
     params = tfm.init_params(cfg, jax.random.key(0))
     n_params = tfm.count_params(params)
     tx = optax.adamw(1e-4, b1=0.9, b2=0.95)

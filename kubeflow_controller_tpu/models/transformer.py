@@ -72,6 +72,14 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     moe_group_size: int = 1024
+    # Dispatch strategy. "auto" = the one-hot einsum form everywhere: it is
+    # what GSPMD turns into the token->expert all_to_all on an ep-sharded
+    # mesh, AND it measured faster than the scatter/gather form even on one
+    # chip (30.2% vs 24.3% active-MFU, benchmarks/RESULTS.md — TPU lowers
+    # the slot scatter and gather VJPs poorly). "gather" forces the
+    # scatter/gather lowering (kept for comparison and for backends where
+    # scatters are cheap); "einsum" forces the one-hot form explicitly.
+    moe_dispatch: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -318,10 +326,10 @@ def _moe_ffn(
         1, round(cfg.moe_top_k * group / E * cfg.moe_capacity_factor)
     ))
 
-    combine = jnp.zeros((G, group, E, cap), jnp.float32)
     base_count = jnp.zeros((G, E), jnp.int32)           # slots already used
     remaining = probs
     aux_fraction = jnp.zeros((), jnp.float32)
+    picks = []   # per-k compact routing state: (choice, gate, pos_tok, keep)
     for _ in range(cfg.moe_top_k):
         choice = remaining.argmax(-1)                   # [G, g]
         gate = jnp.take_along_axis(
@@ -334,12 +342,7 @@ def _moe_ffn(
         )                                               # [G, g, E]
         pos_tok = (pos * onehot).sum(-1)                # [G, g]
         keep = pos_tok < cap
-        combine = combine + (
-            gate[..., None, None]
-            * onehot.astype(jnp.float32)[..., None]
-            * jax.nn.one_hot(pos_tok, cap, dtype=jnp.float32)[..., None, :]
-            * keep[..., None, None]
-        )
+        picks.append((choice, gate, pos_tok, keep))
         aux_fraction = aux_fraction + E * jnp.mean(
             jnp.mean(onehot.astype(jnp.float32), axis=1)
             * jnp.mean(probs, axis=1)
@@ -347,8 +350,15 @@ def _moe_ffn(
         base_count = base_count + (onehot * keep[..., None]).sum(1)
         remaining = remaining * (1 - onehot)            # mask picked expert
 
-    dispatch = (combine > 0).astype(cfg.dtype)          # [G, g, E, cap]
-    xe = jnp.einsum("gnec,gnd->egcd", dispatch, x)      # [E, G, cap, D]
+    if cfg.moe_dispatch in ("auto", "einsum"):
+        xe, out_from = _moe_dispatch_einsum(cfg, x, picks, G, group, E, cap)
+    elif cfg.moe_dispatch == "gather":
+        xe, out_from = _moe_dispatch_gather(cfg, x, picks, G, group, E, cap)
+    else:
+        raise ValueError(
+            f"moe_dispatch={cfg.moe_dispatch!r}: expected auto|einsum|gather"
+        )
+
     xe = _constrain(xe, P("ep", ("dp", "fsdp"), None, None))
     gate_h = jax.nn.silu(
         jnp.einsum("egcd,edf->egcf", xe, lp["w_gate"].astype(cfg.dtype))
@@ -358,10 +368,81 @@ def _moe_ffn(
         "egcf,efd->egcd", gate_h * up_h, lp["w_down"].astype(cfg.dtype)
     )
     out_e = _constrain(out_e, P("ep", ("dp", "fsdp"), None, None))
-    out = jnp.einsum(
-        "gnec,egcd->gnd", combine.astype(cfg.dtype), out_e
-    ).reshape(b, s, d)
+    out = out_from(out_e).reshape(b, s, d)
     return _constrain(out, _act_spec(cfg)), aux_fraction
+
+
+def _moe_dispatch_einsum(cfg, x, picks, G, group, E, cap):
+    """Dense one-hot dispatch/combine (GShard wire form).
+
+    The multi-chip path: the [G,g,E,cap] one-hot contraction is what GSPMD
+    knows how to turn into a token->expert all_to_all when ``xe`` is
+    constrained onto the ep axis (asserted by tests/test_moe.py's HLO
+    inspection). Costs 2·top_k·group·cf·D FLOPs/token in dispatch+combine
+    matmuls — acceptable when amortized across expert shards.
+    """
+    combine = jnp.zeros((G, group, E, cap), jnp.float32)
+    for choice, gate, pos_tok, keep in picks:
+        combine = combine + (
+            gate[..., None, None]
+            * jax.nn.one_hot(choice, E, dtype=jnp.float32)[..., None]
+            * jax.nn.one_hot(pos_tok, cap, dtype=jnp.float32)[..., None, :]
+            * keep[..., None, None]
+        )
+    dispatch = (combine > 0).astype(cfg.dtype)          # [G, g, E, cap]
+    xe = jnp.einsum("gnec,gnd->egcd", dispatch, x)      # [E, G, cap, D]
+
+    def out_from(out_e):
+        return jnp.einsum(
+            "gnec,egcd->gnd", combine.astype(cfg.dtype), out_e
+        )
+
+    return xe, out_from
+
+
+def _moe_dispatch_gather(cfg, x, picks, G, group, E, cap):
+    """Scatter/gather dispatch — the matmul-free lowering.
+
+    Every (expert, slot) receives at most one token (cumsum positions are
+    unique within a k and continue across k via base_count), so dispatch
+    is a permutation: write each kept token's index into its slot, gather
+    token vectors into [E,G,cap,D], and combine by gathering each token's
+    k expert outputs back and scaling by the gate. Removes both D-wide
+    one-hot matmuls in favor of data movement — but on TPU it MEASURES
+    SLOWER than the einsum form (24.3% vs 30.2% active-MFU,
+    benchmarks/RESULTS.md: XLA lowers the slot scatter and the gather
+    VJPs poorly), so "auto" never picks it; it exists for comparison and
+    for backends with cheap scatters. Numerical equivalence with the
+    einsum form (incl. gradients) is pinned by tests/test_moe.py.
+    """
+    g_idx = jnp.arange(G, dtype=jnp.int32)[:, None]           # [G, 1]
+    tok_idx = jnp.arange(group, dtype=jnp.int32)[None, :]     # [1, g]
+    # slot -> source token index (+1 so 0 = empty slot)
+    slot_src = jnp.zeros((G, E, cap), jnp.int32)
+    for choice, _, pos_tok, keep in picks:
+        safe_pos = jnp.where(keep, pos_tok, cap - 1)
+        slot_src = slot_src.at[
+            g_idx.repeat(group, 1), choice, safe_pos
+        ].add(jnp.where(keep, tok_idx + 1, 0))
+    valid = slot_src > 0                                      # [G, E, cap]
+    src = jnp.maximum(slot_src - 1, 0).reshape(G, E * cap)
+    xe = jnp.take_along_axis(x, src[..., None], axis=1)       # [G, E*cap, D]
+    xe = xe * valid.reshape(G, E * cap, 1).astype(x.dtype)
+    xe = xe.reshape(G, E, cap, -1).transpose(1, 0, 2, 3)      # [E, G, cap, D]
+
+    def out_from(out_e):
+        flat = out_e.transpose(1, 0, 2, 3).reshape(G, E * cap, -1)
+        out = jnp.zeros((G, group, flat.shape[-1]), cfg.dtype)
+        for choice, gate, pos_tok, keep in picks:
+            slot = choice * cap + jnp.minimum(pos_tok, cap - 1)
+            picked = jnp.take_along_axis(
+                flat, slot[..., None], axis=1
+            )                                                  # [G, g, D]
+            w = (gate * keep).astype(cfg.dtype)[..., None]
+            out = out + picked * w
+        return out
+
+    return xe, out_from
 
 
 def _layer(

@@ -111,6 +111,23 @@ def aot_compile_train_step(
         compiled = lowered.compile()
         compile_s = time.time() - t1
     stats = compiled.memory_analysis()
+    # The memory gate must FAIL LOUDLY rather than report zero bytes: a
+    # None/shape-shifted stats object would make callers' "fits in HBM"
+    # assertions vacuously true.
+    if stats is None:
+        raise RuntimeError(
+            "compiled.memory_analysis() returned None — cannot gate "
+            "memory; compile itself succeeded"
+        )
+    try:
+        arg_bytes = stats.argument_size_in_bytes
+        temp_bytes = stats.temp_size_in_bytes
+        out_bytes = stats.output_size_in_bytes
+    except AttributeError as e:
+        raise RuntimeError(
+            f"memory_analysis() stats shape changed ({e}); update "
+            "aot_check before trusting the gate"
+        ) from None
     return {
         "config": config_name,
         "mesh": dict(mesh_axes),
@@ -118,10 +135,9 @@ def aot_compile_train_step(
         "seq": seq,
         "lower_s": round(lower_s, 2),
         "compile_s": round(compile_s, 2),
-        "argument_bytes_per_device": getattr(
-            stats, "argument_size_in_bytes", 0),
-        "temp_bytes_per_device": getattr(stats, "temp_size_in_bytes", 0),
-        "output_bytes_per_device": getattr(stats, "output_size_in_bytes", 0),
+        "argument_bytes_per_device": arg_bytes,
+        "temp_bytes_per_device": temp_bytes,
+        "output_bytes_per_device": out_bytes,
     }
 
 

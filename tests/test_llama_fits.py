@@ -111,4 +111,7 @@ class TestAOTCompile:
         per_device = (
             out["argument_bytes_per_device"] + out["temp_bytes_per_device"]
         )
+        # > 0 so a stats regression can never make the gate vacuous: the
+        # sharded fp32 params + adam state alone are ~1.4 GiB/device
+        assert per_device > GiB, out
         assert per_device < V5P_HBM * GiB, out

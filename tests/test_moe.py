@@ -58,6 +58,37 @@ def test_moe_matches_naive_when_capacity_ample(cfg, params):
     assert float(aux) > 0
 
 
+def test_gather_dispatch_matches_einsum_dispatch(cfg, params):
+    """The scatter/gather fast path (single-chip) and the one-hot einsum
+    path (the GSPMD ep form) are two lowerings of the same routing: same
+    outputs, same aux loss, same gradients."""
+    lp = jax.tree.map(lambda x: x[0], params["layers"])
+    h = jnp.asarray(
+        np.random.default_rng(1).standard_normal((2, 16, cfg.d_model)),
+        jnp.float32,
+    )
+
+    def run(dispatch_mode, h):
+        c = cfg.replace(moe_dispatch=dispatch_mode)
+        out, aux = tfm._moe_ffn(c, lp, h)
+        return out, aux
+
+    out_g, aux_g = run("gather", h)
+    out_e, aux_e = run("einsum", h)
+    np.testing.assert_allclose(
+        np.asarray(out_g), np.asarray(out_e), atol=1e-5)
+    np.testing.assert_allclose(float(aux_g), float(aux_e), rtol=1e-6)
+
+    def loss(h, mode):
+        out, aux = run(mode, h)
+        return (out.astype(jnp.float32) ** 2).sum() + aux
+
+    g_g = jax.grad(loss)(h, "gather")
+    g_e = jax.grad(loss)(h, "einsum")
+    np.testing.assert_allclose(
+        np.asarray(g_g), np.asarray(g_e), atol=1e-4)
+
+
 def test_capacity_drops_tokens():
     """With a starving capacity factor the routed output loses tokens (some
     rows fall back to just the residual) but stays finite."""
