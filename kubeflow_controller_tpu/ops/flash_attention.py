@@ -273,7 +273,7 @@ def _fwd(
 def _bwd_dkdv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     causal: bool, sm_scale: float, block_q: int, block_k: int,
-    has_segments: bool,
+    has_segments: bool, narrow_res: bool,
 ):
     if has_segments:
         seg_q_ref, seg_k_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
@@ -297,8 +297,12 @@ def _bwd_dkdv_kernel(
         k = k_ref[0, 0]                                # [BK, D]
         v = v_ref[0, 0]                                # [BK, D]
         do = do_ref[0, 0]                              # [BQ, D]
-        lse = lse_ref[0, 0][:, :1]                     # [BQ, 1]
-        delta = delta_ref[0, 0][:, :1]                 # [BQ, 1]
+        if narrow_res:  # [BQ] on lanes -> column
+            lse = lse_ref[0, 0][:, None]               # [BQ, 1]
+            delta = delta_ref[0, 0][:, None]
+        else:           # 128-lane broadcast layout: lane 0 carries it
+            lse = lse_ref[0, 0][:, :1]                 # [BQ, 1]
+            delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -335,10 +339,74 @@ def _bwd_dkdv_kernel(
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, *rest,
+    causal: bool, sm_scale: float, has_segments: bool, narrow_res: bool,
+):
+    """Single-block backward: dq, dk, dv from ONE score recompute.
+
+    Legal only when the whole sequence is one (block_q, block_k) tile
+    (nq == nk == 1, e.g. BERT's seq 512): there is no cross-block
+    accumulation, so the separate dkdv (qi-inner) and dq (ki-inner)
+    sweeps collapse into one program that loads q/k/v/do once and
+    computes s and p once. ``delta`` is also computed here from ``o``
+    (a cheap [BQ, D] reduce) instead of arriving as a lane-broadcast
+    [B,H,S,128] fp32 tensor — that broadcast alone was ~200 MB of HBM
+    round-trip per step at BERT shape. Together ~12% off the e2e BERT
+    step (benchmarks/RESULTS.md encoder section).
+    """
+    if has_segments:
+        seg_q_ref, seg_k_ref, dq_ref, dk_ref, dv_ref = rest
+    else:
+        dq_ref, dk_ref, dv_ref = rest
+        seg_q_ref = seg_k_ref = None
+    q = q_ref[0, 0]                                # [BQ, D]
+    k = k_ref[0, 0]                                # [BK, D]
+    v = v_ref[0, 0]                                # [BK, D]
+    do = do_ref[0, 0]                              # [BQ, D]
+    lse = (
+        lse_ref[0, 0][:, None] if narrow_res       # [BQ] on lanes -> column
+        else lse_ref[0, 0][:, :1]                  # broadcast layout, lane 0
+    )                                              # [BQ, 1]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o_ref[0, 0].astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )                                              # [BQ, 1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale                                    # [BQ, BK]
+    mask = _block_mask(
+        0, 0,
+        seg_q_ref[0, 0] if has_segments else None,
+        seg_k_ref[0, 0] if has_segments else None,
+        causal, s.shape[0], s.shape[1], s.shape,
+    )
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse)                            # [BQ, BK]
+    dv_ref[0, 0] = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta) * sm_scale                # [BQ, BK]
+    dk_ref[0, 0] = jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dk_ref.dtype)
+    dq_ref[0, 0] = jnp.dot(
+        ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+    ).astype(dq_ref.dtype)
+
+
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     causal: bool, sm_scale: float, block_q: int, block_k: int,
-    has_segments: bool,
+    has_segments: bool, narrow_res: bool,
 ):
     if has_segments:
         seg_q_ref, seg_k_ref, dq_ref, dq_scr = rest
@@ -361,8 +429,12 @@ def _bwd_dq_kernel(
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0][:, :1]
-        delta = delta_ref[0, 0][:, :1]
+        if narrow_res:
+            lse = lse_ref[0, 0][:, None]
+            delta = delta_ref[0, 0][:, None]
+        else:
+            lse = lse_ref[0, 0][:, :1]
+            delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -403,24 +475,102 @@ def _bwd(
     nk = s // block_k
     sm_scale = d ** -0.5
 
-    delta = jnp.sum(
-        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-    )                                                   # [B,H,S]
-    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
-    # Residual LSE is the narrow [B,H,S]; re-broadcast to the lane-aligned
-    # [B,H,S,128] layout the kernels read (transient, fused by XLA).
-    lse = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
+    # Residual layout: the narrow [B,H,S] lse rides as [(B*H), 1, S] with a
+    # seq-on-lanes BlockSpec (the _seg_specs trick) whenever the q-block is
+    # lane-legal there (128-multiple, or the whole sequence) — skipping a
+    # [B,H,S,128] fp32 broadcast round-trip through HBM (~200 MB/step at
+    # BERT shape). Non-lane-aligned blocks fall back to the broadcast form.
+    narrow_res = block_q % 128 == 0 or block_q == s
+    H = h
+    if narrow_res:
+        lse = lse.reshape(b * h, 1, s)
+    else:
+        lse = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
 
     seg_inputs = []
     if has_segments:
         seg = segment_ids.astype(jnp.int32)[:, None, :]   # [B, 1, S]
         seg_inputs = [seg, seg]
 
+    if nq == 1 and nk == 1:
+        # Whole sequence in one tile: fuse dq/dk/dv into one program (one
+        # score recompute, one load of q/k/v/do) instead of two sweeps.
+        fused_kernel = functools.partial(
+            _bwd_fused_kernel, causal=causal, sm_scale=sm_scale,
+            has_segments=has_segments, narrow_res=narrow_res,
+        )
+        qd_spec = pl.BlockSpec(
+            (1, 1, block_q, d), lambda b, h: (b, h, 0, 0))
+        kv_spec = pl.BlockSpec(
+            (1, 1, block_k, d), lambda b, h: (b, h // rep, 0, 0))
+        if narrow_res:
+            res_spec = pl.BlockSpec(
+                (1, 1, block_q), lambda b, h: (b * H + h, 0, 0))
+        else:
+            res_spec = pl.BlockSpec(
+                (1, 1, block_q, 128), lambda b, h: (b, h, 0, 0))
+        fused_in_specs = [qd_spec, kv_spec, kv_spec, qd_spec,
+                          res_spec, qd_spec]
+        if has_segments:
+            fused_in_specs += [
+                pl.BlockSpec((1, 1, block_q), lambda b, h: (b, 0, 0)),
+                pl.BlockSpec((1, 1, block_k), lambda b, h: (b, 0, 0)),
+            ]
+        dq, dk, dv = pl.pallas_call(
+            fused_kernel,
+            grid=(b, h),
+            in_specs=fused_in_specs,
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, d), lambda b, h: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_k, d), lambda b, h: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_k, d), lambda b, h: (b, h, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+                # No cross-program accumulation here, so dk/dv can leave
+                # in their final dtype — fp32 staging is only needed when
+                # a GQA fold still has to sum query-head groups.
+                jax.ShapeDtypeStruct(
+                    (b, h, s, d), jnp.float32 if rep > 1 else k.dtype),
+                jax.ShapeDtypeStruct(
+                    (b, h, s, d), jnp.float32 if rep > 1 else v.dtype),
+            ],
+            interpret=interpret,
+        )(q, k, v, do, lse, o, *seg_inputs)
+        if rep > 1:
+            dk = dk.reshape(b, kv_h, rep, s, d).sum(axis=2)
+            dv = dv.reshape(b, kv_h, rep, s, d).sum(axis=2)
+        return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )                                                   # [B,H,S]
+    if narrow_res:
+        delta = delta.reshape(b * h, 1, s)
+    else:
+        delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
+
+    def _res_specs(qi_pos3: bool):
+        """lse/delta specs for a 4D grid; qi is grid axis 3 for the dq
+        kernel's (qi, ki) order, axis 4's partner for dkdv's (ki, qi)."""
+        if narrow_res:
+            if qi_pos3:
+                m = lambda b, h, qi, ki: (b * H + h, 0, qi)  # noqa: E731
+            else:
+                m = lambda b, h, ki, qi: (b * H + h, 0, qi)  # noqa: E731
+            return pl.BlockSpec((1, 1, block_q), m)
+        if qi_pos3:
+            m = lambda b, h, qi, ki: (b, h, qi, 0)  # noqa: E731
+        else:
+            m = lambda b, h, ki, qi: (b, h, qi, 0)  # noqa: E731
+        return pl.BlockSpec((1, 1, block_q, 128), m)
+
     # dk/dv: one pass per k-block, q innermost. Heads stay un-grouped (dk for
     # a shared GQA head accumulates across its query heads afterwards).
     dkdv_kernel = functools.partial(
         _bwd_dkdv_kernel, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, has_segments=has_segments,
+        narrow_res=narrow_res,
     )
     dkdv_in_specs = [
         pl.BlockSpec((1, 1, block_q, d), lambda b, h, ki, qi: (b, h, qi, 0)),
@@ -431,12 +581,8 @@ def _bwd(
             (1, 1, block_k, d), lambda b, h, ki, qi: (b, h // rep, ki, 0)
         ),
         pl.BlockSpec((1, 1, block_q, d), lambda b, h, ki, qi: (b, h, qi, 0)),
-        pl.BlockSpec(
-            (1, 1, block_q, 128), lambda b, h, ki, qi: (b, h, qi, 0)
-        ),
-        pl.BlockSpec(
-            (1, 1, block_q, 128), lambda b, h, ki, qi: (b, h, qi, 0)
-        ),
+        _res_specs(qi_pos3=False),
+        _res_specs(qi_pos3=False),
     ]
     if has_segments:
         dkdv_in_specs += _seg_specs(block_q, block_k, ki_major=True)
@@ -462,6 +608,7 @@ def _bwd(
     dq_kernel = functools.partial(
         _bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, has_segments=has_segments,
+        narrow_res=narrow_res,
     )
     dq_in_specs = [
         pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -472,12 +619,8 @@ def _bwd(
             (1, 1, block_k, d), lambda b, h, qi, ki: (b, h // rep, ki, 0)
         ),
         pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
-        pl.BlockSpec(
-            (1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)
-        ),
-        pl.BlockSpec(
-            (1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)
-        ),
+        _res_specs(qi_pos3=True),
+        _res_specs(qi_pos3=True),
     ]
     if has_segments:
         dq_in_specs += _seg_specs(block_q, block_k)

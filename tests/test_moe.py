@@ -89,6 +89,41 @@ def test_gather_dispatch_matches_einsum_dispatch(cfg, params):
         np.asarray(g_g), np.asarray(g_e), atol=1e-4)
 
 
+def test_gather_dispatch_matches_einsum_under_capacity_pressure(params):
+    """Token drops (keep=False) exercise the gather path's dropped-slot
+    branches: safe_pos clamping, add-zero scatters, weight-0 combine
+    gathers. Both lowerings must agree on exactly which tokens were kept
+    and what everyone's output is."""
+    cfg_tight = tfm.tiny_moe_config(moe_capacity_factor=0.4)
+    lp = jax.tree.map(lambda x: x[0], params["layers"])
+    h = jnp.asarray(
+        np.random.default_rng(3).standard_normal((2, 32, cfg_tight.d_model)),
+        jnp.float32,
+    )
+    out_g, aux_g = tfm._moe_ffn(
+        cfg_tight.replace(moe_dispatch="gather"), lp, h)
+    out_e, aux_e = tfm._moe_ffn(
+        cfg_tight.replace(moe_dispatch="einsum"), lp, h)
+    # drops actually happened (some token lost at least one expert slot)
+    dense_out, _ = tfm._moe_ffn(
+        tfm.tiny_moe_config(moe_capacity_factor=8.0).replace(
+            moe_dispatch="einsum"), lp, h)
+    assert not np.allclose(np.asarray(out_e), np.asarray(dense_out))
+    np.testing.assert_allclose(
+        np.asarray(out_g), np.asarray(out_e), atol=1e-5)
+    np.testing.assert_allclose(float(aux_g), float(aux_e), rtol=1e-6)
+
+    def loss(h, mode):
+        out, aux = tfm._moe_ffn(
+            cfg_tight.replace(moe_dispatch=mode), lp, h)
+        return (out.astype(jnp.float32) ** 2).sum() + aux
+
+    g_g = jax.grad(loss)(h, "gather")
+    g_e = jax.grad(loss)(h, "einsum")
+    np.testing.assert_allclose(
+        np.asarray(g_g), np.asarray(g_e), atol=1e-4)
+
+
 def test_capacity_drops_tokens():
     """With a starving capacity factor the routed output loses tokens (some
     rows fall back to just the residual) but stays finite."""
