@@ -6,12 +6,22 @@
   128-unit hidden layer + sigmoid... here GELU — same capacity, better
   conditioning) that the distributed PS/worker example trains.
 
-Data: the reference downloads real MNIST over the network
-(``read_data_sets``, ``mnist_replica.py:94``); this environment has no
-egress, so a deterministic synthetic MNIST-shaped task stands in — a fixed
-random linear teacher over 784-dim inputs, 10 classes. It trains to the same
-kind of accuracy curve and exercises an identical compute/communication
-pattern, which is what the framework is testing.
+Data, two sources:
+
+- **Real idx files from data_dir** (``mnist_from_data_dir`` /
+  ``idx_batches``): the canonical MNIST wire format the reference's
+  ``read_data_sets`` consumed (``mnist_replica.py:94``) — big-endian idx
+  ubyte files, optionally gzipped, found by their standard names. The
+  job spec's ``data_dir`` (declared-but-never-read in the reference,
+  ``types.go:43-44``) is actually consumed here via ``TPUJOB_DATA_DIR``.
+  Drop the four canonical MNIST files into ``data_dir`` and the
+  entrypoint trains on them; the repo vendors a small REAL
+  handwritten-digit dataset in that format for hermetic tests
+  (``tests/fixtures/mnist/``, see tests/test_real_mnist.py).
+- **Synthetic teacher task** (``synthetic_mnist``): this environment has
+  no egress, so when no data_dir is supplied a deterministic synthetic
+  MNIST-shaped task stands in — same shapes, same
+  compute/communication pattern.
 """
 
 from __future__ import annotations
@@ -86,6 +96,151 @@ def synthetic_mnist(
         ).astype(np.float32)
         y = logits.argmax(-1).astype(np.int32)
         yield {"image": xb, "label": y}
+
+
+# -- idx files (the canonical MNIST wire format) -----------------------------
+
+_IDX_DTYPES = {
+    0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+    0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64,
+}
+
+
+def load_idx(path: str) -> np.ndarray:
+    """Read one idx file (``.gz`` transparent): the big-endian
+    magic/dims/data format of the canonical MNIST distribution."""
+    import gzip
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        data = f.read()
+    if len(data) < 4 or data[0] != 0 or data[1] != 0:
+        raise ValueError(f"{path}: not an idx file (bad magic)")
+    dtype = _IDX_DTYPES.get(data[2])
+    if dtype is None:
+        raise ValueError(f"{path}: unknown idx dtype byte 0x{data[2]:02x}")
+    ndim = data[3]
+    header = 4 + 4 * ndim
+    dims = [
+        int.from_bytes(data[4 + 4 * i: 8 + 4 * i], "big")
+        for i in range(ndim)
+    ]
+    arr = np.frombuffer(data, dtype=np.dtype(dtype).newbyteorder(">"),
+                        offset=header)
+    expect = int(np.prod(dims)) if dims else 0
+    if arr.size != expect:
+        raise ValueError(
+            f"{path}: payload {arr.size} elements, header says {expect}"
+        )
+    return arr.reshape(dims).astype(dtype)
+
+
+def write_idx(path: str, arr: np.ndarray) -> None:
+    """Write an array as an idx file (``.gz`` transparent) — the inverse of
+    ``load_idx``; used to vendor fixture data and by round-trip tests."""
+    import gzip
+
+    code = {v: k for k, v in _IDX_DTYPES.items()}[np.dtype(arr.dtype).type]
+    header = bytes([0, 0, code, arr.ndim])
+    for dim in arr.shape:
+        header += int(dim).to_bytes(4, "big")
+    payload = header + np.ascontiguousarray(
+        arr, dtype=np.dtype(arr.dtype).newbyteorder(">")
+    ).tobytes()
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "wb") as f:
+        f.write(payload)
+
+
+_IDX_NAMES = {
+    "train_images": ("train-images-idx3-ubyte", "train-images.idx3-ubyte"),
+    "train_labels": ("train-labels-idx1-ubyte", "train-labels.idx1-ubyte"),
+    "test_images": ("t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte",
+                    "test-images-idx3-ubyte"),
+    "test_labels": ("t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte",
+                    "test-labels-idx1-ubyte"),
+}
+
+
+def _find_idx(data_dir: str, key: str):
+    """Resolve one logical idx file to a path (canonical name aliases +
+    ``.gz``), or None. The single source for both presence checks and
+    loading, so they can never disagree."""
+    import os
+
+    for name in _IDX_NAMES[key]:
+        for candidate in (name, name + ".gz"):
+            path = os.path.join(data_dir, candidate)
+            if os.path.exists(path):
+                return path
+    return None
+
+
+def has_idx_data(data_dir: str) -> bool:
+    """True if ``data_dir`` holds at least the two training idx files."""
+    import os
+
+    if not data_dir or not os.path.isdir(data_dir):
+        return False
+    return all(
+        _find_idx(data_dir, key) is not None
+        for key in ("train_images", "train_labels")
+    )
+
+
+def mnist_from_data_dir(data_dir: str) -> Dict[str, np.ndarray]:
+    """Load the canonical MNIST idx files from ``data_dir``.
+
+    Returns train/test images flattened to [N, 784] uint8 and labels
+    int32; the test split is optional (missing -> absent keys)."""
+    import os
+
+    out: Dict[str, np.ndarray] = {}
+    for key, names in _IDX_NAMES.items():
+        path = _find_idx(data_dir, key)
+        if path is None:
+            if key.startswith("train"):
+                raise FileNotFoundError(
+                    f"{data_dir}: no {names[0]}[.gz] (canonical MNIST idx "
+                    "layout)"
+                )
+            continue
+        arr = load_idx(path)
+        if key.endswith("images"):
+            arr = arr.reshape(arr.shape[0], -1).astype(np.uint8)
+        else:
+            arr = arr.astype(np.int32)
+        out[key] = arr
+    for split in ("train", "test"):
+        imgs, labels = out.get(f"{split}_images"), out.get(f"{split}_labels")
+        if imgs is not None and labels is not None and len(imgs) != len(labels):
+            raise ValueError(
+                f"{data_dir}: {split} images/labels length mismatch "
+                f"({len(imgs)} vs {len(labels)})"
+            )
+    return out
+
+
+def idx_batches(
+    images: np.ndarray, labels: np.ndarray, batch_size: int, seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Shuffled epoch stream over real data: uint8 images on the wire
+    (device-side normalization), reshuffled every epoch."""
+    n = len(images)
+    if batch_size > n:
+        # An empty epoch would spin forever without yielding; fail loudly.
+        raise ValueError(
+            f"batch_size {batch_size} exceeds dataset size {n}"
+        )
+    rng = np.random.default_rng(seed)
+    while True:
+        order = rng.permutation(n)
+        for i in range(0, n - batch_size + 1, batch_size):
+            idx = order[i:i + batch_size]
+            yield {
+                "image": images[idx],
+                "label": labels[idx].astype(np.int32),
+            }
 
 
 def _metrics(logits: jnp.ndarray, labels: jnp.ndarray):
