@@ -182,32 +182,37 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Params:
     return params
 
 
-def param_specs(cfg: TransformerConfig) -> Params:
+def param_specs(cfg: TransformerConfig, pp: bool = False) -> Params:
     """PartitionSpecs mirroring init_params. Column-parallel projections put
     their output dim on tp; row-parallel put their input dim on tp; the other
-    matmul dim is fsdp-sharded for ZeRO-3-style storage. Stacked layer arrays
-    keep the leading layer axis unsharded."""
+    matmul dim is fsdp-sharded for ZeRO-3-style storage.
+
+    ``pp=True`` shards the stacked layer arrays' leading layer axis over
+    the mesh's pp axis — stage p of the pipeline holds its contiguous
+    layer block (parallel/pipeline.py); otherwise the layer axis stays
+    unsharded."""
+    lead = "pp" if pp else None
     layers = {
-        "attn_norm": P(None, None),
-        "wq": P(None, "fsdp", "tp"),
-        "wk": P(None, "fsdp", "tp"),
-        "wv": P(None, "fsdp", "tp"),
-        "wo": P(None, "tp", "fsdp"),
-        "mlp_norm": P(None, None),
+        "attn_norm": P(lead, None),
+        "wq": P(lead, "fsdp", "tp"),
+        "wk": P(lead, "fsdp", "tp"),
+        "wv": P(lead, "fsdp", "tp"),
+        "wo": P(lead, "tp", "fsdp"),
+        "mlp_norm": P(lead, None),
     }
     if cfg.moe_experts:
         layers.update({
-            "w_router": P(None, "fsdp", None),
+            "w_router": P(lead, "fsdp", None),
             # expert bank: experts over ep, then megatron (fsdp, tp) within
-            "w_gate": P(None, "ep", "fsdp", "tp"),
-            "w_up": P(None, "ep", "fsdp", "tp"),
-            "w_down": P(None, "ep", "tp", "fsdp"),
+            "w_gate": P(lead, "ep", "fsdp", "tp"),
+            "w_up": P(lead, "ep", "fsdp", "tp"),
+            "w_down": P(lead, "ep", "tp", "fsdp"),
         })
     else:
         layers.update({
-            "w_gate": P(None, "fsdp", "tp"),
-            "w_up": P(None, "fsdp", "tp"),
-            "w_down": P(None, "tp", "fsdp"),
+            "w_gate": P(lead, "fsdp", "tp"),
+            "w_up": P(lead, "fsdp", "tp"),
+            "w_down": P(lead, "tp", "fsdp"),
         })
     specs: Params = {
         # d_model-sharded, vocab unsharded: same bytes per device as a
@@ -514,6 +519,57 @@ def forward_hidden(
     return rmsnorm(x, params["final_norm"], cfg.norm_eps), aux.sum()
 
 
+def forward_hidden_pp(
+    cfg: TransformerConfig,
+    params: Params,
+    tokens: jax.Array,
+    n_microbatches: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Pipeline-parallel ``forward_hidden`` over the ambient mesh's pp axis.
+
+    The layer stack runs as a GPipe schedule (``parallel/pipeline.py``):
+    stages = pp shards of ``params["layers"]`` (shard with
+    ``param_specs(cfg, pp=True)``), microbatches rotate between stages via
+    ppermute. Embedding/final-norm/head stay outside the pipeline
+    (replicated over pp, sharded over the other axes as usual) — the layer
+    stack is where the parameters are. Dense layers, default positions
+    (packed batches and MoE stay on the non-pipelined path)."""
+    from kubeflow_controller_tpu.parallel.pipeline import gpipe
+
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "pipeline path supports dense layers only (shard experts over "
+            "ep instead)"
+        )
+    b, s = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = _constrain(x, _act_spec(cfg))
+
+    def stage(stage_layers, x_mb):
+        pos = jnp.broadcast_to(
+            jnp.arange(s, dtype=jnp.int32), (x_mb.shape[0], s)
+        )
+
+        def body(carry, lp):
+            y, _aux = _layer(cfg, lp, carry, pos, None)
+            return y, None
+
+        y, _ = lax.scan(body, x_mb, stage_layers)
+        return y
+
+    run = jax.shard_map(
+        lambda layers, xx: gpipe(
+            stage, layers, xx, n_microbatches, remat=cfg.remat,
+        ),
+        in_specs=(P("pp"), P()),
+        out_specs=P(),
+        axis_names={"pp"},
+    )
+    x = run(params["layers"], x)
+    return rmsnorm(x, params["final_norm"], cfg.norm_eps), jnp.zeros(
+        (), jnp.float32)
+
+
 def forward(
     cfg: TransformerConfig,
     params: Params,
@@ -636,7 +692,7 @@ def packed_positions(segment_ids: jax.Array) -> jax.Array:
 
 def next_token_loss(
     cfg: TransformerConfig, params: Params, batch: Dict[str, jax.Array],
-    loss_chunk: int = 0,
+    loss_chunk: int = 0, pp_microbatches: int = 0,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Causal LM loss: predict tokens[1:] from tokens[:-1]. Ignores positions
     where ``batch['mask']`` (optional) is 0. loss_chunk > 0 streams the
@@ -652,11 +708,22 @@ def next_token_loss(
     targets = tokens[:, 1:]
     segs = batch.get("segment_ids")
     seg_in = None if segs is None else segs[:, :-1]
-    hidden, aux = forward_hidden(
-        cfg, params, tokens[:, :-1],
-        positions=None if seg_in is None else packed_positions(seg_in),
-        segment_ids=seg_in,
-    )
+    if pp_microbatches:
+        # Pipeline-parallel layer stack (``pp_microbatches`` microbatches
+        # over the mesh's pp axis); packed batches stay non-pipelined.
+        if segs is not None:
+            raise NotImplementedError(
+                "packed batches are not supported on the pipeline path"
+            )
+        hidden, aux = forward_hidden_pp(
+            cfg, params, tokens[:, :-1], pp_microbatches
+        )
+    else:
+        hidden, aux = forward_hidden(
+            cfg, params, tokens[:, :-1],
+            positions=None if seg_in is None else packed_positions(seg_in),
+            segment_ids=seg_in,
+        )
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T

@@ -6,10 +6,13 @@ process its place in the world: every process builds the same global Mesh from
 ``jax.devices()`` after ``jax.distributed.initialize``; XLA handles cross-host
 collectives over ICI (intra-slice) / DCN (inter-slice).
 
-Axis order is (dp, fsdp, sp, tp) — tp innermost so tensor-parallel collectives
-ride the fastest ICI links; dp outermost so multi-slice jobs put pure-DP
-gradient reduction on DCN where its lower frequency tolerates lower bandwidth
-(the standard scaling-book layout).
+Axis order is (pp, dp, fsdp, ep, sp, tp) — tp innermost so tensor-parallel
+collectives ride the fastest ICI links; pp outermost because pipeline
+stages exchange only one activation tensor per tick (point-to-point
+ppermute), the cheapest traffic in the system and the most tolerant of
+slow links; dp next so multi-slice jobs put pure-DP gradient reduction on
+DCN where its lower frequency tolerates lower bandwidth (the standard
+scaling-book layout).
 """
 
 from __future__ import annotations
@@ -22,7 +25,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "ep", "sp", "tp")
+AXES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclass(frozen=True)
@@ -30,7 +33,9 @@ class MeshConfig:
     """Logical mesh shape. -1 on dp means "absorb all remaining devices".
 
     ``ep`` is the expert-parallel axis (MoE experts shard over it; dense
-    models leave it at 1 and never notice it exists).
+    models leave it at 1 and never notice it exists); ``pp`` is the
+    pipeline axis (parallel/pipeline.py shards the layer stack over it;
+    non-pipelined jobs leave it at 1).
     """
 
     dp: int = -1
@@ -38,23 +43,25 @@ class MeshConfig:
     ep: int = 1
     sp: int = 1
     tp: int = 1
+    pp: int = 1
 
-    def resolve(self, n_devices: int) -> Tuple[int, int, int, int, int]:
-        fixed = self.fsdp * self.ep * self.sp * self.tp
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int, int, int]:
+        fixed = self.pp * self.fsdp * self.ep * self.sp * self.tp
         if self.dp == -1:
             if n_devices % fixed:
                 raise ValueError(
                     f"{n_devices} devices not divisible by "
-                    f"fsdp*ep*sp*tp={fixed}"
+                    f"pp*fsdp*ep*sp*tp={fixed}"
                 )
-            return (n_devices // fixed, self.fsdp, self.ep, self.sp, self.tp)
+            return (self.pp, n_devices // fixed, self.fsdp, self.ep,
+                    self.sp, self.tp)
         total = self.dp * fixed
         if total != n_devices:
             raise ValueError(
-                f"mesh {self.dp}x{self.fsdp}x{self.ep}x{self.sp}x{self.tp}"
-                f"={total} != {n_devices} devices"
+                f"mesh {self.pp}x{self.dp}x{self.fsdp}x{self.ep}x{self.sp}"
+                f"x{self.tp}={total} != {n_devices} devices"
             )
-        return (self.dp, self.fsdp, self.ep, self.sp, self.tp)
+        return (self.pp, self.dp, self.fsdp, self.ep, self.sp, self.tp)
 
 
 def make_mesh(
@@ -118,7 +125,12 @@ def make_multislice_mesh(
         groups = [
             devs[i * per_slice:(i + 1) * per_slice] for i in range(num_slices)
         ]
-    dp, fsdp, ep, sp, tp = config.resolve(len(devs))
+    pp, dp, fsdp, ep, sp, tp = config.resolve(len(devs))
+    if pp != 1:
+        raise ValueError(
+            "multi-slice meshes pin the DCN boundary to the dp axis; run "
+            "pipeline stages inside a slice (pp=1 across slices)"
+        )
     if dp % num_slices:
         raise ValueError(
             f"dp={dp} must be divisible by num_slices={num_slices} "
@@ -126,7 +138,7 @@ def make_multislice_mesh(
         )
     arr = np.array(groups).reshape(
         num_slices, dp // num_slices, fsdp, ep, sp, tp
-    ).reshape(dp, fsdp, ep, sp, tp)
+    ).reshape(pp, dp, fsdp, ep, sp, tp)
     return Mesh(arr, AXES)
 
 

@@ -1,0 +1,111 @@
+"""Pipeline parallelism: a GPipe schedule over the mesh's ``pp`` axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.5 marks it "not
+required for parity"); this closes the gap TPU-first rather than porting a
+torch pipeline engine:
+
+- **Stages are mesh shards, not processes.** The stacked layer arrays
+  (``params["layers"]``, leading layer axis) are sharded over ``pp`` —
+  stage p holds layers ``[p*L/P, (p+1)*L/P)`` — and the pipeline runs
+  inside ONE ``jax.shard_map`` that is manual over ``pp`` only: tensor/
+  fsdp sharding inside each stage stays in GSPMD's hands (the existing
+  ``_constrain`` annotations keep working), so pp composes with tp/fsdp/dp
+  exactly like every other axis.
+- **Microbatch rotation via collective permute.** Each tick every stage
+  runs its local layers (a ``lax.scan``, rematted) and ``ppermute``s its
+  activation to the next stage over ICI. ``M + P - 1`` ticks drain M
+  microbatches through P stages (the GPipe bubble: utilization
+  M/(M+P-1) — pick M >= 4P).
+- **Backward is the AD transpose.** No hand-written 1F1B engine: ``ppermute``
+  transposes to the reverse permute and ``lax.scan`` to a reverse sweep,
+  so ``jax.grad`` of the pipelined loss IS pipeline-parallel backward
+  (GPipe's fill-drain schedule, correct by construction).
+
+Warmup/cooldown ticks run real stage compute on zero activations (cheap
+relative to scheduling complexity, and numerically inert: outputs from
+those ticks never reach the collected results). The last stage's outputs
+are re-replicated over ``pp`` with a masked ``psum`` so the (auto-sharded)
+LM head downstream needs no special casing.
+
+Validated against the non-pipelined forward (identical params, identical
+logits/grads) in tests/test_pipeline.py on the 8-device CPU mesh, and
+exercised at train-step scale by ``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    n_microbatches: int,
+    axis: str = "pp",
+    remat: bool = True,
+) -> jax.Array:
+    """Run ``x`` through P pipeline stages; call under shard_map manual
+    over ``axis``.
+
+    stage_fn(stage_params, x_mb) -> y_mb applies ONE stage's layers to one
+    microbatch; ``stage_params`` are the stage-local (already sharded)
+    layer weights. ``x`` is the full [B, ...] activation batch; B must
+    divide by ``n_microbatches``.
+    """
+    p_idx = lax.axis_index(axis)
+    p_num = lax.axis_size(axis)
+    b = x.shape[0]
+    assert b % n_microbatches == 0, (
+        f"batch {b} must divide into {n_microbatches} microbatches"
+    )
+    mb = b // n_microbatches
+    xs = x.reshape(n_microbatches, mb, *x.shape[1:])
+    # Stages diverge immediately (each holds different activations), so
+    # both the rotating carry and the stage-0 feed are device-varying over
+    # the pipeline axis — mark them so the scan carry type is stable.
+    xs = lax.pcast(xs, axis, to="varying")
+    n_ticks = n_microbatches + p_num - 1
+
+    fn = stage_fn
+    if remat:
+        fn = jax.checkpoint(
+            fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+
+    def tick(state, t):
+        # Stage 0 ingests microbatch t (zeros once the batch is drained);
+        # later stages consume what the previous stage permuted in.
+        feed = lax.dynamic_index_in_dim(
+            xs, jnp.minimum(t, n_microbatches - 1), keepdims=False
+        )
+        feed = jnp.where(t < n_microbatches, feed, jnp.zeros_like(feed))
+        inp = jnp.where(p_idx == 0, feed, state)
+        y = fn(stage_params, inp)
+        nxt = lax.ppermute(
+            y, axis, [(i, (i + 1) % p_num) for i in range(p_num)]
+        )
+        return nxt, y
+
+    _, ys = lax.scan(tick, jnp.zeros_like(xs[0]), jnp.arange(n_ticks))
+
+    # The last stage's ticks [P-1, P-1+M) are the M real outputs; replicate
+    # them across stages with a masked psum so downstream (auto) sharding
+    # sees an ordinary replicated-over-pp array.
+    outs = lax.dynamic_slice_in_dim(ys, p_num - 1, n_microbatches, axis=0)
+    outs = jnp.where(p_idx == p_num - 1, outs, jnp.zeros_like(outs))
+    outs = lax.psum(outs, axis)
+    return outs.reshape(b, *x.shape[1:])
+
+
+def pp_stage_count(mesh: Optional[jax.sharding.Mesh] = None) -> int:
+    """Size of the ambient (or given) mesh's pp axis; 1 when absent."""
+    mesh = mesh or jax.sharding.get_abstract_mesh()
+    if mesh is None or "pp" not in getattr(mesh, "shape", {}):
+        return 1
+    return mesh.shape["pp"]

@@ -32,7 +32,8 @@ class TestMesh:
 
     def test_mixed_mesh(self):
         mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
-        assert mesh.shape == {"dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
+        assert mesh.shape == {"pp": 1, "dp": 2, "fsdp": 2, "ep": 1,
+                              "sp": 1, "tp": 2}
 
     def test_bad_mesh_rejected(self):
         with pytest.raises(ValueError, match="devices"):

@@ -22,7 +22,8 @@ class TestMultisliceMesh:
             MeshConfig(dp=4, fsdp=1, sp=1, tp=2), num_slices=2
         )
         devs = list(jax.devices())
-        arr = np.asarray(mesh.devices)          # [dp=4, fsdp=1, sp=1, tp=2]
+        # [pp=1, dp=4, fsdp=1, ep=1, sp=1, tp=2]; drop the pp=1 lead
+        arr = np.asarray(mesh.devices)[0]
         # slice 0 = devices 0..3, slice 1 = devices 4..7 (enumeration order)
         for dp_idx in range(4):
             expect_slice = dp_idx // 2
@@ -36,7 +37,7 @@ class TestMultisliceMesh:
             MeshConfig(dp=2, fsdp=2, sp=1, tp=2), num_slices=2
         )
         devs = list(jax.devices())
-        arr = np.asarray(mesh.devices)
+        arr = np.asarray(mesh.devices)[0]   # drop the pp=1 lead
         # For each dp row, all fsdp/sp/tp devices must come from ONE slice.
         for dp_idx in range(arr.shape[0]):
             slices = {devs.index(d) // 4 for d in arr[dp_idx].flat}
@@ -57,7 +58,8 @@ class TestMultisliceMesh:
     def test_mesh_for_context(self):
         ctx = ProcessContext(num_slices=2)
         mesh = mesh_for_context(ctx, MeshConfig(dp=2, fsdp=2, sp=1, tp=2))
-        assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
+        assert dict(mesh.shape) == {"pp": 1, "dp": 2, "fsdp": 2,
+                                    "ep": 1, "sp": 1, "tp": 2}
         single = mesh_for_context(ProcessContext(), MeshConfig())
         assert single.shape["dp"] == 8
 
