@@ -392,7 +392,13 @@ class TrainLoop:
         host sync."""
         if self._eval_step is None:
             raise ValueError("TrainLoop built without eval_fn")
+        # Bounded dispatch, same rationale as run(): unbounded in-flight
+        # collective programs can deadlock the virtual-device CPU backend's
+        # thread rendezvous on oversubscribed hosts. Drain train work
+        # first, then keep a small eval window.
+        jax.block_until_ready(self.state.params)
         acc: Dict[str, Any] = {}
+        pending: list = []
         batch_sh = batch_sharding(self.mesh)
         for _ in range(batches):
             out = self._eval_step(
@@ -400,6 +406,9 @@ class TrainLoop:
             )
             for k, v in out.items():
                 acc[k] = v if k not in acc else acc[k] + v
+            pending.append(out)
+            if len(pending) > 8:
+                jax.block_until_ready(pending.pop(0))
         return {k: float(v) / batches for k, v in acc.items()}
 
     # -- checkpointing -------------------------------------------------------
@@ -472,6 +481,17 @@ class TrainLoop:
         # what hides per-step host<->device latency (critical over a tunneled
         # chip; the reference instead blocked every step on a gRPC sess.run,
         # mnist_replica.py:251-264).
+        #
+        # ...but never UNBOUNDED: a fast host loop can park hundreds of
+        # executions in flight, and on the virtual-device CPU backend each
+        # in-flight collective pins rendezvous threads from a pool sized by
+        # real cores (this box: 1) — enough queued runs deadlock the
+        # rendezvous outright (observed: all-gather termination timeouts at
+        # ~500 dispatched steps). A small completion window is free on real
+        # accelerators (the step being awaited finished long ago) and is
+        # the correct backpressure everywhere.
+        pending: list = []
+        max_in_flight = 8
         profiling = False
         profile_done = False
         spc = self.config.steps_per_call
@@ -513,6 +533,9 @@ class TrainLoop:
             self.state, metrics = self._step_fn(
                 self.state, host_to_global(batch, self._data_sharding), rng
             )
+            pending.append(metrics["loss"])
+            if len(pending) > max_in_flight:
+                jax.block_until_ready(pending.pop(0))
             step = py_step + take
             if crossed(cfg.checkpoint_every, py_step, step):
                 self.save(wait=True)

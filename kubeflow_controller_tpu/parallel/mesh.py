@@ -89,9 +89,10 @@ def make_multislice_mesh(
     (real multi-slice TPU), else split evenly in enumeration order (CPU
     simulation, where the grouping is only a layout statement).
 
-    The models never see any of this — the mesh still has the same four
+    The models never see any of this — the mesh still has the same six
     logical axes, which is the point: multi-slice is a deployment detail,
-    not a model change. (The reference has no analog at all; its scaling
+    not a model change. (``pp`` must stay 1 across slices: pipeline stages
+    belong inside a slice; this function rejects anything else.) (The reference has no analog at all; its scaling
     story stops at one PS/worker gRPC cluster, SURVEY.md §7 hard part 4.)
     """
     config = config or MeshConfig()

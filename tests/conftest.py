@@ -14,9 +14,17 @@ import sys
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# 8 virtual devices time-share this box's ONE core: under suite load a
+# device thread can starve past XLA's default 40 s collective rendezvous
+# abort, killing the process mid-test. Slow is acceptable here; aborting
+# is not. Each flag is appended only if the ambient env didn't set it
+# (XLA parses last-wins; never override a user's value).
+if "xla_cpu_collective_call_warn_stuck_timeout_seconds" not in flags:
+    flags += " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
+    flags += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402
 

@@ -89,10 +89,10 @@ class TestRealTraining:
         device pipeline, eval stream) to >= the reference's 0.9234."""
         from kubeflow_controller_tpu.dataplane.entrypoints.mnist import train
 
-        # 300 steps: converged well past the bar (0.98+ by step 200).
-        # Longer CPU-mesh runs occasionally trip an XLA CPU collective-
-        # rendezvous flake in interleaved train/eval dispatch (all-gather
-        # rendezvous timeout) unrelated to the data path under test.
+        # 300 steps: converged well past the bar (0.98+ by step 200), and
+        # fast. (Longer runs are fine too — the unbounded-dispatch
+        # rendezvous deadlock this shape once exposed is fixed by the
+        # train loop's in-flight window, dataplane/train.py.)
         metrics = train(
             total_steps=300, batch_size=100, learning_rate=0.01,
             data_dir=FIXTURES,
