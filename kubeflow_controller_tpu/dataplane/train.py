@@ -180,6 +180,12 @@ class TrainState(struct.PyTreeNode):
 @dataclass
 class TrainLoopConfig:
     total_steps: int = 100
+    # Dispatch-depth backpressure: at most this many step/eval executions
+    # in flight at once. Free on real accelerators (the awaited dispatch
+    # finished long ago); prevents the virtual-CPU backend's collective
+    # rendezvous from deadlocking under unbounded async dispatch (see
+    # run()). Shared by run() and evaluate().
+    max_in_flight: int = 8
     log_every: int = 20
     checkpoint_every: int = 0      # 0 = only final
     keep_checkpoints: int = 3
@@ -407,7 +413,7 @@ class TrainLoop:
             for k, v in out.items():
                 acc[k] = v if k not in acc else acc[k] + v
             pending.append(out)
-            if len(pending) > 8:
+            if len(pending) > self.config.max_in_flight:
                 jax.block_until_ready(pending.pop(0))
         return {k: float(v) / batches for k, v in acc.items()}
 
@@ -491,7 +497,7 @@ class TrainLoop:
         # accelerators (the step being awaited finished long ago) and is
         # the correct backpressure everywhere.
         pending: list = []
-        max_in_flight = 8
+        max_in_flight = cfg.max_in_flight
         profiling = False
         profile_done = False
         spc = self.config.steps_per_call

@@ -1,0 +1,28 @@
+"""XLA environment knobs shared by the test harness and driver entrypoints.
+
+Import-light on purpose (no jax): callers must be able to apply these to
+``os.environ`` BEFORE the first jax/backend import.
+"""
+
+from __future__ import annotations
+
+# Virtual CPU devices time-share few (often 1) real cores; XLA's default
+# 40 s collective-rendezvous abort then turns load spikes into process
+# death. Raise it so contention degrades to slow instead of SIGABRT.
+# (The dispatch-depth backpressure in dataplane/train.py prevents the
+# deadlock case; these flags cover everything else that runs collectives
+# on the virtual mesh.)
+CPU_COLLECTIVE_TIMEOUT_FLAGS = (
+    ("xla_cpu_collective_call_warn_stuck_timeout_seconds", "120"),
+    ("xla_cpu_collective_call_terminate_timeout_seconds", "600"),
+)
+
+
+def with_cpu_collective_timeouts(flags: str) -> str:
+    """Append the rendezvous-timeout flags to an XLA_FLAGS string, skipping
+    any flag the ambient value already sets (XLA parses last-wins; never
+    override the user)."""
+    for name, value in CPU_COLLECTIVE_TIMEOUT_FLAGS:
+        if name not in flags:
+            flags += f" --{name}={value}"
+    return flags.strip()

@@ -12,19 +12,28 @@ backend init, so setting it here works.
 import os
 import sys
 
+# Prefer an installed package (`pip install -e .` — see pyproject.toml);
+# fall back to the checkout root so the suite also runs uninstalled.
+# (Must happen before the XLA_FLAGS block: the timeout knobs are shared
+# with the driver entrypoints via util.xla_env, which imports no jax.)
+try:
+    import kubeflow_controller_tpu  # noqa: F401
+except ImportError:
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+from kubeflow_controller_tpu.util.xla_env import (  # noqa: E402
+    with_cpu_collective_timeouts,
+)
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags = (flags + " --xla_force_host_platform_device_count=8").strip()
-# 8 virtual devices time-share this box's ONE core: under suite load a
-# device thread can starve past XLA's default 40 s collective rendezvous
-# abort, killing the process mid-test. Slow is acceptable here; aborting
-# is not. Each flag is appended only if the ambient env didn't set it
-# (XLA parses last-wins; never override a user's value).
-if "xla_cpu_collective_call_warn_stuck_timeout_seconds" not in flags:
-    flags += " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
-    flags += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
-os.environ["XLA_FLAGS"] = flags
+# 8 virtual devices time-share this box's ONE core: raise XLA's collective
+# rendezvous abort so suite load degrades to slow, not SIGABRT (shared
+# knob: util/xla_env.py).
+os.environ["XLA_FLAGS"] = with_cpu_collective_timeouts(flags)
 
 import jax  # noqa: E402
 
@@ -33,12 +42,3 @@ import jax  # noqa: E402
 # default is the hermetic CPU mesh.
 if os.environ.get("TPUJOB_TEST_PLATFORM", "cpu") == "cpu":
     jax.config.update("jax_platforms", "cpu")
-
-# Prefer an installed package (`pip install -e .` — see pyproject.toml);
-# fall back to the checkout root so the suite also runs uninstalled.
-try:
-    import kubeflow_controller_tpu  # noqa: F401
-except ImportError:
-    sys.path.insert(
-        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    )
