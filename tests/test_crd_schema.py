@@ -1,0 +1,149 @@
+"""CRD schema <-> wire format drift guard.
+
+``examples/crd/tpujob-crd.yml`` is what a real cluster enforces on TPUJob
+objects; ``cluster/kube_wire.job_to_k8s`` is what the controller and the
+golden fixtures emit. Nothing in the runtime reads the CRD yaml, so the
+two could drift apart silently — until a real apiserver starts rejecting
+the controller's writes. This mini structural-schema validator walks the
+CRD's openAPIV3Schema over the golden TPUJob fixture (and a fully
+populated live job) and fails on type mismatches, enum violations, or
+minimum breaches.
+
+Not a full OpenAPI validator — exactly the subset the CRD uses (type,
+properties, items, enum, minimum, x-kubernetes-preserve-unknown-fields),
+which is also the subset a structural CRD schema may use.
+"""
+
+import json
+import os
+
+import yaml
+
+from kubeflow_controller_tpu.cluster import kube_wire
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CRD_PATH = os.path.join(REPO, "examples", "crd", "tpujob-crd.yml")
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "k8s", "tpujob.json")
+
+
+def load_schema():
+    with open(CRD_PATH) as f:
+        crd = yaml.safe_load(f)
+    versions = crd["spec"]["versions"]
+    assert len(versions) == 1 and versions[0]["name"] == "v1alpha1"
+    assert versions[0]["served"] and versions[0]["storage"]
+    assert versions[0]["subresources"] == {"status": {}}
+    return versions[0]["schema"]["openAPIV3Schema"]
+
+
+def validate(value, schema, path, errors):
+    if schema.get("x-kubernetes-preserve-unknown-fields"):
+        return
+    stype = schema.get("type")
+    if stype == "object":
+        if not isinstance(value, dict):
+            errors.append(f"{path}: expected object, got {type(value).__name__}")
+            return
+        props = schema.get("properties", {})
+        for key, sub in props.items():
+            if key in value:
+                validate(value[key], sub, f"{path}.{key}", errors)
+        # structural CRDs PRUNE unknown fields: anything we emit that the
+        # schema doesn't model would be silently dropped by the apiserver —
+        # that IS drift, so flag it.
+        if props:
+            for key in value:
+                if key not in props:
+                    errors.append(
+                        f"{path}.{key}: emitted on the wire but absent "
+                        f"from the CRD schema (apiserver would prune it)"
+                    )
+    elif stype == "array":
+        if not isinstance(value, list):
+            errors.append(f"{path}: expected array, got {type(value).__name__}")
+            return
+        for i, item in enumerate(value):
+            validate(item, schema.get("items", {}), f"{path}[{i}]", errors)
+    elif stype == "string":
+        if not isinstance(value, str):
+            errors.append(f"{path}: expected string, got {value!r}")
+        if "enum" in schema and value not in schema["enum"]:
+            errors.append(f"{path}: {value!r} not in {schema['enum']}")
+    elif stype == "integer":
+        if not isinstance(value, int) or isinstance(value, bool):
+            errors.append(f"{path}: expected integer, got {value!r}")
+        elif "minimum" in schema and value < schema["minimum"]:
+            errors.append(f"{path}: {value} < minimum {schema['minimum']}")
+    elif stype == "boolean":
+        if not isinstance(value, bool):
+            errors.append(f"{path}: expected boolean, got {value!r}")
+    elif stype == "number":
+        if not isinstance(value, (int, float)):
+            errors.append(f"{path}: expected number, got {value!r}")
+
+
+def check_spec(doc):
+    schema = load_schema()
+    errors = []
+    validate(doc.get("spec", {}), schema["properties"]["spec"], "spec",
+             errors)
+    assert not errors, "\n".join(errors)
+
+
+def test_golden_fixture_passes_crd_schema():
+    with open(FIXTURE) as f:
+        doc = json.load(f)
+    assert doc["apiVersion"] == "tpu.kubeflow.dev/v1alpha1"
+    assert doc["kind"] == "TPUJob"
+    check_spec(doc)
+
+
+def test_fully_populated_job_passes_crd_schema():
+    """Every spec field the dataclasses can express must be modeled by the
+    CRD (else a real apiserver prunes it on write)."""
+    from kubeflow_controller_tpu.api.core import (
+        Container, ObjectMeta, PodSpec, PodTemplateSpec,
+    )
+    from kubeflow_controller_tpu.api.types import (
+        ChiefSpec, ReplicaSpec, ReplicaType, TerminationPolicySpec, TPUJob,
+        TPUJobSpec, TPUSliceSpec,
+    )
+
+    job = TPUJob(
+        metadata=ObjectMeta(name="full", namespace="default"),
+        spec=TPUJobSpec(
+            runtime_id="r1",
+            data_dir="/data", model_dir="/ckpt", log_dir="/log",
+            export_dir="/export",
+            suspend=True, priority=7, ttl_seconds_after_finished=300,
+            replica_specs=[ReplicaSpec(
+                replica_type=ReplicaType.WORKER,
+                replicas=2,
+                template=PodTemplateSpec(spec=PodSpec(containers=[
+                    Container(name="t", image="img"),
+                ])),
+                tpu=TPUSliceSpec(
+                    accelerator_type="v5p-32", num_slices=2,
+                    topology="2x4x4", provisioning="spot",
+                ),
+                termination_policy=TerminationPolicySpec(
+                    chief=ChiefSpec(replica_name="Worker", replica_index=0),
+                ),
+                max_restarts=5,
+            )],
+        ),
+    )
+    check_spec(kube_wire.job_to_k8s(job))
+
+
+def test_schema_rejects_bad_enum_and_minimum():
+    """The validator itself has teeth (it is the drift guard's foundation)."""
+    doc = {"spec": {"replicaSpecs": [
+        {"replicaType": "ParameterServer", "replicas": 0},
+    ]}}
+    schema = load_schema()
+    errors = []
+    validate(doc["spec"], schema["properties"]["spec"], "spec", errors)
+    joined = "\n".join(errors)
+    assert "not in" in joined and "minimum" in joined
