@@ -217,6 +217,15 @@ def _add_pools(slice_pool, pools) -> None:
 def cmd_serve(args) -> int:
     if args.cluster_url or args.kubeconfig or args.in_cluster:
         return _serve_remote(args)
+    if getattr(args, "k8s_wire", False):
+        # --k8s-wire selects the wire dialect for a REMOTE target; with no
+        # target it would be silently ignored (ADVICE r3) — refuse instead.
+        print(
+            "error: --k8s-wire requires a remote cluster target "
+            "(--cluster-url, --kubeconfig, or --in-cluster)",
+            file=sys.stderr,
+        )
+        return 2
     rt = LocalRuntime(
         default_policy=PodRunPolicy(
             start_delay=args.pod_start_delay, run_duration=args.pod_run_duration

@@ -37,7 +37,11 @@ class ClusterClient(Protocol):
     def update_job(self, job: TPUJob) -> TPUJob: ...
     def delete_job(self, namespace: str, name: str) -> None: ...
 
-    def record_event(self, kind: str, name: str, reason: str, message: str) -> None: ...
+    # namespace: the involved object's namespace (a real apiserver rejects
+    # Events whose namespace differs from involvedObject.namespace);
+    # backends without namespacing may ignore it.
+    def record_event(self, kind: str, name: str, reason: str,
+                     message: str, namespace: str = "") -> None: ...
     def release_slices(self, job_uid: str) -> int: ...
     # job_name is an optional routing hint: backends that resolve slices
     # through pod queries (the real-k8s adapter) use it for a server-side
@@ -60,18 +64,21 @@ class FakeClusterClient:
         ):
             self.cluster.faults.fail_pod_creates -= 1
             self.record_event("Pod", pod.metadata.name or pod.metadata.generate_name,
-                              "FailedCreate", "injected create failure")
+                              "FailedCreate", "injected create failure",
+                              namespace=pod.metadata.namespace)
             raise PodCreateRefused("injected pod create failure")
         if self.cluster.faults.fail_pod_creates_after > 0:
             self.cluster.faults.fail_pod_creates_after -= 1
         created = self.cluster.pods.create(pod)
         self.record_event("Pod", created.metadata.name, "SuccessfulCreate",
-                          f"created pod {created.metadata.name}")
+                          f"created pod {created.metadata.name}",
+                          namespace=created.metadata.namespace)
         return created
 
     def delete_pod(self, namespace: str, name: str) -> None:
         self.cluster.pods.delete(namespace, name)
-        self.record_event("Pod", name, "SuccessfulDelete", f"deleted pod {name}")
+        self.record_event("Pod", name, "SuccessfulDelete",
+                          f"deleted pod {name}", namespace=namespace)
 
     def list_pods(self, namespace: str, selector: Dict[str, str]) -> List[Pod]:
         return self.cluster.pods.list(namespace, selector or None)
@@ -84,13 +91,14 @@ class FakeClusterClient:
     def create_service(self, svc: Service) -> Service:
         created = self.cluster.services.create(svc)
         self.record_event("Service", created.metadata.name, "SuccessfulCreate",
-                          f"created service {created.metadata.name}")
+                          f"created service {created.metadata.name}",
+                          namespace=created.metadata.namespace)
         return created
 
     def delete_service(self, namespace: str, name: str) -> None:
         self.cluster.services.delete(namespace, name)
         self.record_event("Service", name, "SuccessfulDelete",
-                          f"deleted service {name}")
+                          f"deleted service {name}", namespace=namespace)
 
     def list_services(self, namespace: str, selector: Dict[str, str]) -> List[Service]:
         return self.cluster.services.list(namespace, selector or None)
@@ -109,12 +117,14 @@ class FakeClusterClient:
     def delete_job(self, namespace: str, name: str) -> None:
         self.cluster.jobs.delete(namespace, name)
         self.record_event("TPUJob", name, "SuccessfulDelete",
-                          f"deleted job {name}")
+                          f"deleted job {name}", namespace=namespace)
 
     # -- misc ---------------------------------------------------------------
 
-    def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
-        self.cluster.record_event(kind, name, reason, message)
+    def record_event(self, kind: str, name: str, reason: str,
+                     message: str, namespace: str = "") -> None:
+        self.cluster.record_event(kind, name, reason, message,
+                                  namespace=namespace)
 
     def release_slices(self, job_uid: str) -> int:
         return self.cluster.slice_pool.release(job_uid)

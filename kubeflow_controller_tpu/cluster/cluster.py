@@ -17,10 +17,12 @@ training → Succeeded" is exercised end-to-end with no real cluster.
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from kubeflow_controller_tpu.api.core import Pod, PodPhase, Service
+from kubeflow_controller_tpu.cluster.event_recorder import EventAggregator
 from kubeflow_controller_tpu.cluster.events import EventType
 from kubeflow_controller_tpu.cluster.slices import (
     InsufficientCapacity,
@@ -128,10 +130,16 @@ class FakeCluster:
         self.now = 0.0
         self._runtimes: Dict[str, _PodRuntime] = {}
         self._lock = threading.RLock()
-        # Cluster events (k8s Events analog): list of (time, kind, name,
+        # Cluster events (k8s Events analog): rows of (time, kind, name,
         # reason, message) — the observability surface record.EventRecorder
-        # provides in the reference (controller.go:91-94).
-        self.cluster_events: List[tuple] = []
+        # provides in the reference (controller.go:91-94). Aggregated like
+        # client-go's tools/record: an identical repeat refreshes the
+        # existing row (timestamp + recency position) instead of appending,
+        # so a crash-looping job yields ONE row with count=N (events_agg)
+        # rather than N rows, and `cluster_events` stays ordered by last
+        # occurrence — a still-firing event is always in the recent window.
+        self._event_rows: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self.events_agg = EventAggregator()
         # Per-pod log lines (kubectl-logs analog): pod name -> [(time, line)].
         # The fake kubelet writes lifecycle lines; run_fn workloads may append
         # via append_pod_log.
@@ -171,9 +179,32 @@ class FakeCluster:
 
     # -- event recording -----------------------------------------------------
 
-    def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
+    @property
+    def cluster_events(self) -> List[tuple]:
+        """Event rows ordered by LAST occurrence (recency), one per
+        distinct (namespace, kind, name, reason, message) key."""
         with self._lock:
-            self.cluster_events.append((self.now, kind, name, reason, message))
+            return list(self._event_rows.values())
+
+    def record_event(
+        self, kind: str, name: str, reason: str, message: str,
+        namespace: str = "",
+    ) -> None:
+        with self._lock:
+            self.events_agg.observe(
+                namespace, kind, name, reason, message, self.now
+            )
+            key = (namespace, kind, name, reason, message)
+            self._event_rows[key] = (self.now, kind, name, reason, message)
+            self._event_rows.move_to_end(key)
+
+    def event_count(
+        self, kind: str, name: str, reason: str, message: str,
+        namespace: str = "",
+    ) -> int:
+        """Aggregate occurrence count for an exact event key (0 = never)."""
+        rec = self.events_agg.get(namespace, kind, name, reason, message)
+        return rec.count if rec else 0
 
     def append_pod_log(self, pod_name: str, line: str) -> None:
         with self._lock:

@@ -41,6 +41,7 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 from kubeflow_controller_tpu.api.core import Pod, Service
 from kubeflow_controller_tpu.api.types import TPUJob
 from kubeflow_controller_tpu.cluster import kube_wire
+from kubeflow_controller_tpu.cluster.event_recorder import EventAggregator
 from kubeflow_controller_tpu.cluster.events import EventType, WatchEvent
 from kubeflow_controller_tpu.cluster.kube_wire import (
     GKE_ACCELERATOR_LABEL, JOB_API_VERSION,
@@ -97,17 +98,19 @@ class KubeClusterClient:
         self._node_cache: Tuple[float, List[Dict[str, Any]]] = (0.0, [])
         self._node_cache_ttl = 5.0
         self._node_lock = threading.Lock()
+        self._events = EventAggregator()
 
     # -- transport -----------------------------------------------------------
 
     def _request(
         self, method: str, path: str, payload: Optional[Dict] = None,
         stream: bool = False, timeout: Optional[float] = None,
+        content_type: str = "application/json",
     ):
         url = self.base_url + path
         data = json.dumps(payload).encode() if payload is not None else None
         req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Content-Type", "application/json")
+        req.add_header("Content-Type", content_type)
         req.add_header("Accept", "application/json")
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
@@ -157,7 +160,8 @@ class KubeClusterClient:
         )
         created = kube_wire.pod_from_k8s(out)
         self.record_event("Pod", created.metadata.name, "SuccessfulCreate",
-                          f"created pod {created.metadata.name}")
+                          f"created pod {created.metadata.name}",
+                          namespace=created.metadata.namespace)
         return created
 
     def delete_pod(self, namespace: str, name: str) -> None:
@@ -165,7 +169,7 @@ class KubeClusterClient:
             "DELETE", f"{self._collection('Pod', namespace)}/{name}"
         )
         self.record_event("Pod", name, "SuccessfulDelete",
-                          f"deleted pod {name}")
+                          f"deleted pod {name}", namespace=namespace)
 
     def list_pods(self, namespace: str, selector: Dict[str, str]) -> List[Pod]:
         out = self._request(
@@ -221,6 +225,7 @@ class KubeClusterClient:
         self.record_event(
             "Service", created.metadata.name, "SuccessfulCreate",
             f"created service {created.metadata.name}",
+            namespace=created.metadata.namespace,
         )
         return created
 
@@ -229,7 +234,7 @@ class KubeClusterClient:
             "DELETE", f"{self._collection('Service', namespace)}/{name}"
         )
         self.record_event("Service", name, "SuccessfulDelete",
-                          f"deleted service {name}")
+                          f"deleted service {name}", namespace=namespace)
 
     def list_services(
         self, namespace: str, selector: Dict[str, str]
@@ -308,15 +313,45 @@ class KubeClusterClient:
     # -- events --------------------------------------------------------------
 
     def record_event(
-        self, kind: str, name: str, reason: str, message: str
+        self, kind: str, name: str, reason: str, message: str,
+        namespace: str = "",
     ) -> None:
+        """Aggregating recorder (client-go tools/record semantics): the
+        first occurrence of a (namespace, kind, name, reason, message) key
+        POSTs a fresh core/v1 Event; repeats PATCH the stored Event's
+        count/lastTimestamp — a crash-looping job yields ONE Event with
+        count=N instead of spamming the events API. The Event is posted to
+        the involved object's namespace (an apiserver rejects a mismatch)."""
+        ns = namespace or self.namespace
+        now = time.time()
         try:
-            self._request(
-                "POST", f"/api/v1/namespaces/{self.namespace}/events",
+            rec = self._events.observe(ns, kind, name, reason, message, now)
+            if rec.count > 1 and rec.handle:
+                patch = {
+                    "count": rec.count,
+                    "lastTimestamp": kube_wire.rfc3339(now),
+                }
+                try:
+                    self._request(
+                        "PATCH",
+                        f"/api/v1/namespaces/{ns}/events/{rec.handle}",
+                        patch,
+                        content_type="application/merge-patch+json",
+                    )
+                    return
+                except NotFound:
+                    # The stored Event was GC'd server-side (events have
+                    # a TTL on real clusters): re-create below.
+                    pass
+            out = self._request(
+                "POST", f"/api/v1/namespaces/{ns}/events",
                 kube_wire.event_to_k8s(
-                    kind, name, self.namespace, reason, message,
-                    ts=time.time(),
+                    kind, name, ns, reason, message, ts=now,
                 ),
+            )
+            self._events.set_handle(
+                ns, kind, name, reason, message,
+                (out.get("metadata") or {}).get("name"),
             )
         except Exception:
             # Event recording is best-effort everywhere (the reference's
@@ -546,6 +581,12 @@ class KubeWatchSource:
                         rv = None
                         time.sleep(self.rewatch_backoff)
                         continue
+                    # The subscriber may have timed out (marked dead) while
+                    # the list was in flight — replaying to it now would be
+                    # exactly the half-registered delivery the sync-timeout
+                    # path promises cannot happen.
+                    if self._stop or listener in self._dead:
+                        return
                     seen: Dict[str, Any] = {}
                     for obj in items:
                         seen[key_of(obj)] = obj
@@ -590,6 +631,11 @@ class KubeWatchSource:
             name=f"kube-watch-{self.kind.lower()}",
         ).start()
         if not synced.wait(timeout=30):
+            # Failed subscription must not keep a half-registered pump
+            # alive delivering events to a listener the caller believes was
+            # never registered (ADVICE r3): mark it dead — the pump exits
+            # at its next loop/delivery check.
+            self._dead.add(listener)
             raise TimeoutError(
                 f"kube watch on {self.kind} did not sync within 30s "
                 f"({self.client.base_url})"

@@ -477,7 +477,14 @@ def slices_from_nodes(nodes: List[Dict[str, Any]], pools: List[str]):
         out.append(TPUSlice(
             name=pool,
             shape=shape,
-            healthy=all(_node_ready(n) for n in members),
+            # Healthy needs BOTH every surviving node Ready AND the pool at
+            # full strength: a partially-deprovisioned pool (some nodes
+            # deleted, survivors Ready) is a sick slice — the gang cannot
+            # run on fewer than shape.num_hosts hosts (ADVICE r3).
+            healthy=(
+                len(members) >= shape.num_hosts
+                and all(_node_ready(n) for n in members)
+            ),
             hosts=[
                 (n.get("metadata") or {}).get("name", "") for n in members
             ],

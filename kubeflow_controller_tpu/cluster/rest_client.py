@@ -88,12 +88,14 @@ class RestClusterClient:
             pod_to_dict(pod),
         )
         self.record_event("Pod", out["metadata"]["name"], "SuccessfulCreate",
-                          f"created pod {out['metadata']['name']}")
+                          f"created pod {out['metadata']['name']}",
+                          namespace=pod.metadata.namespace)
         return pod_from_dict(out)
 
     def delete_pod(self, namespace: str, name: str) -> None:
         self._req("DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}")
-        self.record_event("Pod", name, "SuccessfulDelete", f"deleted pod {name}")
+        self.record_event("Pod", name, "SuccessfulDelete",
+                          f"deleted pod {name}", namespace=namespace)
 
     def list_pods(self, namespace: str, selector: Dict[str, str]) -> List[Pod]:
         out = self._req(
@@ -123,6 +125,7 @@ class RestClusterClient:
         self.record_event(
             "Service", out["metadata"]["name"], "SuccessfulCreate",
             f"created service {out['metadata']['name']}",
+            namespace=svc.metadata.namespace,
         )
         return service_from_dict(out)
 
@@ -131,7 +134,7 @@ class RestClusterClient:
             "DELETE", f"/api/v1/namespaces/{namespace}/services/{name}"
         )
         self.record_event("Service", name, "SuccessfulDelete",
-                          f"deleted service {name}")
+                          f"deleted service {name}", namespace=namespace)
 
     def list_services(
         self, namespace: str, selector: Dict[str, str]
@@ -267,9 +270,11 @@ class RestClusterClient:
 
     # -- framework extensions ------------------------------------------------
 
-    def record_event(self, kind: str, name: str, reason: str, message: str) -> None:
+    def record_event(self, kind: str, name: str, reason: str,
+                     message: str, namespace: str = "") -> None:
         self._req("POST", "/framework/v1/events", {
             "kind": kind, "name": name, "reason": reason, "message": message,
+            "namespace": namespace,
         })
 
     def release_slices(self, job_uid: str) -> int:

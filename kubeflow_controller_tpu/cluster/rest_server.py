@@ -43,6 +43,7 @@ JOB_STATUS_RE = re.compile(
     r"/([^/]+)/status$"
 )
 K8S_EVENTS_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/events$")
+K8S_EVENT_ITEM_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/events/([^/]+)$")
 NODES_PATH = "/api/v1/nodes"
 EVENT_PATH = "/framework/v1/events"
 SLICES_RE = re.compile(r"^/framework/v1/slices/([^/]+)$")
@@ -149,6 +150,13 @@ def make_rest_handler(
     }
     watch_kinds = {"pods": "Pod", "services": "Service", "jobs": "TPUJob"}
 
+    # Named core/v1 Event objects (strict-k8s mode): a real apiserver
+    # materializes POSTed events as addressable objects that the client's
+    # aggregating recorder PATCHes (count/lastTimestamp) on repeats.
+    k8s_events: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    k8s_events_lock = threading.Lock()
+    k8s_event_seq = [0]
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
             pass
@@ -180,7 +188,8 @@ def make_rest_handler(
                 if path == EVENT_PATH and method == "POST":
                     b = self._body()
                     cluster.record_event(
-                        b["kind"], b["name"], b["reason"], b["message"]
+                        b["kind"], b["name"], b["reason"], b["message"],
+                        namespace=b.get("namespace", ""),
                     )
                     return self._send(200, {"ok": True})
                 if k8s_mode and self._handle_k8s(method, path):
@@ -278,14 +287,74 @@ def make_rest_handler(
                 self._send(200, kube_wire.job_to_k8s(out))
                 return True
             m = K8S_EVENTS_RE.match(path)
-            if m and method == "POST":
-                b = self._body()
-                inv = b.get("involvedObject") or {}
+            if m:
+                ns = m.group(1)
+                if method == "POST":
+                    b = self._body()
+                    inv = b.get("involvedObject") or {}
+                    # A real apiserver rejects an Event whose namespace
+                    # differs from involvedObject.namespace.
+                    if (inv.get("namespace") or ns) != ns:
+                        self._send(400, {
+                            "error": "event namespace does not match "
+                                     "involvedObject.namespace",
+                            "reason": "BadRequest",
+                        })
+                        return True
+                    meta = b.setdefault("metadata", {})
+                    if not meta.get("name"):
+                        with k8s_events_lock:
+                            k8s_event_seq[0] += 1
+                            meta["name"] = (
+                                f"{meta.get('generateName', 'event.')}"
+                                f"{k8s_event_seq[0]:08x}"
+                            )
+                    meta["namespace"] = ns
+                    with k8s_events_lock:
+                        k8s_events[(ns, meta["name"])] = b
+                    cluster.record_event(
+                        inv.get("kind", ""), inv.get("name", ""),
+                        b.get("reason", ""), b.get("message", ""),
+                        namespace=ns,
+                    )
+                    self._send(201, b)
+                    return True
+                if method == "GET":
+                    with k8s_events_lock:
+                        items = [
+                            dict(v) for (ens, _), v in k8s_events.items()
+                            if ens == ns
+                        ]
+                    self._send(200, {
+                        "apiVersion": "v1", "kind": "EventList",
+                        "metadata": {"resourceVersion": "0"},
+                        "items": items,
+                    })
+                    return True
+            m = K8S_EVENT_ITEM_RE.match(path)
+            if m and method == "PATCH":
+                ns, name = m.group(1), m.group(2)
+                patch = self._body()
+                with k8s_events_lock:
+                    ev = k8s_events.get((ns, name))
+                    if ev is None:
+                        self._send(404, {"error": f"event {ns}/{name}",
+                                         "reason": "NotFound"})
+                        return True
+                    # merge-patch semantics for the scalar fields the
+                    # aggregating recorder updates.
+                    for field in ("count", "lastTimestamp", "message"):
+                        if field in patch:
+                            ev[field] = patch[field]
+                    inv = ev.get("involvedObject") or {}
+                    out = dict(ev)
+                # Keep the fake cluster's aggregate view in step.
                 cluster.record_event(
                     inv.get("kind", ""), inv.get("name", ""),
-                    b.get("reason", ""), b.get("message", ""),
+                    out.get("reason", ""), out.get("message", ""),
+                    namespace=ns,
                 )
-                self._send(201, b)
+                self._send(200, out)
                 return True
             if path == NODES_PATH and method == "GET":
                 from kubeflow_controller_tpu.api.topology import (
@@ -462,6 +531,9 @@ def make_rest_handler(
 
         def do_DELETE(self):
             self._handle("DELETE")
+
+        def do_PATCH(self):
+            self._handle("PATCH")
 
     return Handler
 

@@ -229,7 +229,8 @@ class Controller:
         try:
             validate_job(job)
         except ValidationError as e:
-            self.client.record_event("TPUJob", name, "InvalidSpec", str(e))
+            self.client.record_event("TPUJob", name, "InvalidSpec", str(e),
+                                     namespace=namespace)
             trace.outcome = "invalid"
             return
 
@@ -363,7 +364,7 @@ class Controller:
             if plan.health_restart:
                 self.client.record_event(
                     "TPUJob", job.metadata.name, "SliceUnhealthy",
-                    plan.restart_reason)
+                    plan.restart_reason, namespace=ns)
             # Persist the epoch bump FIRST so a crash between delete and
             # create cannot strand the job: stale-epoch pods are deleted by
             # rule on every future sync.
@@ -381,7 +382,8 @@ class Controller:
                     now=self.opts.now_fn())
             self._mutate_job(ns, job.metadata.name, bump)
             self.client.record_event(
-                "TPUJob", job.metadata.name, "GangRestart", plan.restart_reason)
+                "TPUJob", job.metadata.name, "GangRestart",
+                plan.restart_reason, namespace=ns)
             acted = True
 
         if plan.delete_pods:
@@ -416,7 +418,7 @@ class Controller:
             self.client.record_event(
                 "TPUJob", job.metadata.name, "GangCreate",
                 f"created {len(plan.create_pods)} pods, "
-                f"{len(plan.create_services)} services")
+                f"{len(plan.create_services)} services", namespace=ns)
             acted = True
 
         if plan.delete_services:
@@ -433,9 +435,10 @@ class Controller:
                 # slice killed the job, not just that it failed.
                 self.client.record_event(
                     "TPUJob", job.metadata.name, "SliceUnhealthy",
-                    plan.fail_reason)
+                    plan.fail_reason, namespace=ns)
             self.client.record_event(
-                "TPUJob", job.metadata.name, "JobFailed", plan.fail_reason)
+                "TPUJob", job.metadata.name, "JobFailed", plan.fail_reason,
+                namespace=ns)
         return acted
 
     def _requeue_after(self, key: str, remaining: float) -> None:

@@ -524,6 +524,8 @@ def forward_hidden_pp(
     params: Params,
     tokens: jax.Array,
     n_microbatches: int,
+    positions: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Pipeline-parallel ``forward_hidden`` over the ambient mesh's pp axis.
 
@@ -532,8 +534,10 @@ def forward_hidden_pp(
     ``param_specs(cfg, pp=True)``), microbatches rotate between stages via
     ppermute. Embedding/final-norm/head stay outside the pipeline
     (replicated over pp, sharded over the other axes as usual) — the layer
-    stack is where the parameters are. Dense layers, default positions
-    (packed batches and MoE stay on the non-pipelined path)."""
+    stack is where the parameters are. Packed batches ride along as gpipe
+    ``extras`` (each stage dynamic-indexes the positions/segment-ids of
+    the microbatch it currently holds). Dense layers only (MoE shards
+    experts over ep on the non-pipelined path instead)."""
     from kubeflow_controller_tpu.parallel.pipeline import gpipe
 
     if cfg.moe_experts:
@@ -542,30 +546,40 @@ def forward_hidden_pp(
             "ep instead)"
         )
     b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     x = params["embed"].astype(cfg.dtype)[tokens]
+    # Staged reshard: on a pp mesh SPMD cannot move between the act spec
+    # (batch over fsdp, d_model replicated) and the embed table's layout
+    # (d_model over fsdp x tp) in one hop — the combined move (fsdp:
+    # dim0 <-> dim2, tp: shard/unshard) falls back to an involuntary full
+    # rematerialization (replicate + repartition; the r3 dryrun logged 4 of
+    # them). The intermediate (batch over fsdp, d_model over tp) makes each
+    # hop a single-factor move, and its AD transpose fixes the backward
+    # scatter-add into the table the same way.
+    x = _constrain(x, P(BATCH_AXES, None, "tp"))
     x = _constrain(x, _act_spec(cfg))
 
-    def stage(stage_layers, x_mb):
-        pos = jnp.broadcast_to(
-            jnp.arange(s, dtype=jnp.int32), (x_mb.shape[0], s)
-        )
+    def stage(stage_layers, x_mb, extra):
+        pos, segs = extra
 
         def body(carry, lp):
-            y, _aux = _layer(cfg, lp, carry, pos, None)
+            y, _aux = _layer(cfg, lp, carry, pos, segs)
             return y, None
 
         y, _ = lax.scan(body, x_mb, stage_layers)
         return y
 
     run = jax.shard_map(
-        lambda layers, xx: gpipe(
+        lambda layers, xx, extras: gpipe(
             stage, layers, xx, n_microbatches, remat=cfg.remat,
+            extras=extras,
         ),
-        in_specs=(P("pp"), P()),
+        in_specs=(P("pp"), P(), P()),
         out_specs=P(),
         axis_names={"pp"},
     )
-    x = run(params["layers"], x)
+    x = run(params["layers"], x, (positions, segment_ids))
     return rmsnorm(x, params["final_norm"], cfg.norm_eps), jnp.zeros(
         (), jnp.float32)
 
@@ -710,13 +724,11 @@ def next_token_loss(
     seg_in = None if segs is None else segs[:, :-1]
     if pp_microbatches:
         # Pipeline-parallel layer stack (``pp_microbatches`` microbatches
-        # over the mesh's pp axis); packed batches stay non-pipelined.
-        if segs is not None:
-            raise NotImplementedError(
-                "packed batches are not supported on the pipeline path"
-            )
+        # over the mesh's pp axis); packed batches ride as gpipe extras.
         hidden, aux = forward_hidden_pp(
-            cfg, params, tokens[:, :-1], pp_microbatches
+            cfg, params, tokens[:, :-1], pp_microbatches,
+            positions=None if seg_in is None else packed_positions(seg_in),
+            segment_ids=seg_in,
         )
     else:
         hidden, aux = forward_hidden(

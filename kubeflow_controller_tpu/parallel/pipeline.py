@@ -42,12 +42,13 @@ from jax import lax
 
 
 def gpipe(
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[..., jax.Array],
     stage_params: Any,
     x: jax.Array,
     n_microbatches: int,
     axis: str = "pp",
     remat: bool = True,
+    extras: Any = None,
 ) -> jax.Array:
     """Run ``x`` through P pipeline stages; call under shard_map manual
     over ``axis``.
@@ -56,6 +57,14 @@ def gpipe(
     microbatch; ``stage_params`` are the stage-local (already sharded)
     layer weights. ``x`` is the full [B, ...] activation batch; B must
     divide by ``n_microbatches``.
+
+    ``extras`` (optional): a pytree of batch-leading side inputs (e.g.
+    positions / segment ids, [B, ...]) that every stage needs for the
+    microbatch it is CURRENTLY holding. Unlike ``x`` they don't flow
+    through the pipeline — stage p at tick t holds microbatch ``t - p``,
+    so each stage dynamic-indexes its own slice from the (replicated over
+    pp) per-microbatch stack. With extras, stage_fn is called as
+    ``stage_fn(stage_params, x_mb, extra_mb)``.
     """
     p_idx = lax.axis_index(axis)
     p_num = lax.axis_size(axis)
@@ -70,6 +79,15 @@ def gpipe(
     # the pipeline axis — mark them so the scan carry type is stable.
     xs = lax.pcast(xs, axis, to="varying")
     n_ticks = n_microbatches + p_num - 1
+    exs = None
+    if extras is not None:
+        exs = jax.tree.map(
+            lambda e: lax.pcast(
+                e.reshape(n_microbatches, mb, *e.shape[1:]), axis,
+                to="varying",
+            ),
+            extras,
+        )
 
     fn = stage_fn
     if remat:
@@ -86,7 +104,19 @@ def gpipe(
         )
         feed = jnp.where(t < n_microbatches, feed, jnp.zeros_like(feed))
         inp = jnp.where(p_idx == 0, feed, state)
-        y = fn(stage_params, inp)
+        if exs is None:
+            y = fn(stage_params, inp)
+        else:
+            # Stage p holds microbatch t - p (clamped: warmup/cooldown
+            # ticks compute on zeros and their outputs are discarded).
+            mb_idx = jnp.clip(t - p_idx, 0, n_microbatches - 1)
+            extra = jax.tree.map(
+                lambda e: lax.dynamic_index_in_dim(
+                    e, mb_idx, keepdims=False
+                ),
+                exs,
+            )
+            y = fn(stage_params, inp, extra)
         nxt = lax.ppermute(
             y, axis, [(i, (i + 1) % p_num) for i in range(p_num)]
         )

@@ -1,0 +1,72 @@
+"""Shared HLO-level regression machinery: compile a full train step on a
+mesh while capturing fd-2 (XLA's SPMD partitioner logs involuntary-
+rematerialization warnings there from C++, invisible to Python logging).
+
+Used by the sharding-efficiency guards (test_moe.py, test_pipeline.py):
+the bar is not "it runs" but "the partitioner never fell back to
+replicate-then-repartition" — the silent 10x HBM/latency cliff that the
+round-3 pp dryrun caught in its log tail.
+"""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeflow_controller_tpu.models import transformer as tfm
+from kubeflow_controller_tpu.parallel.mesh import batch_sharding
+from kubeflow_controller_tpu.parallel.sharding import opt_state_shardings
+
+
+def compile_train_step_capturing_stderr(
+    cfg, mesh, global_batch=8, pp_microbatches=0,
+):
+    """Compile fwd+bwd+adamw for ``cfg`` on ``mesh``; returns
+    (compiled, stderr_text)."""
+    params = tfm.init_params(cfg, jax.random.key(0))
+    specs = tfm.param_specs(cfg, pp=pp_microbatches > 0)
+    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+    params = jax.tree.map(jax.device_put, params, param_sh)
+    tx = optax.adamw(1e-3)
+    opt_sh = opt_state_shardings(tx, params, param_sh, mesh)
+    opt_state = jax.jit(tx.init, out_shardings=opt_sh)(params)
+    tokens = jax.device_put(
+        jnp.asarray(
+            np.random.default_rng(0).integers(
+                0, cfg.vocab_size, (global_batch, 33)
+            ),
+            jnp.int32,
+        ),
+        batch_sharding(mesh),
+    )
+
+    def train_step(params, opt_state, tokens):
+        def lossf(p):
+            return tfm.next_token_loss(
+                cfg, p, {"tokens": tokens}, pp_microbatches=pp_microbatches,
+            )
+
+        (loss, _), grads = jax.value_and_grad(lossf, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    with tempfile.TemporaryFile() as cap, jax.set_mesh(mesh):
+        lowered = jax.jit(
+            train_step,
+            in_shardings=(param_sh, opt_sh, batch_sharding(mesh)),
+            out_shardings=(param_sh, opt_sh, NamedSharding(mesh, P())),
+        ).lower(params, opt_state, tokens)
+        saved = os.dup(2)
+        try:
+            os.dup2(cap.fileno(), 2)
+            compiled = lowered.compile()
+        finally:
+            os.dup2(saved, 2)
+            os.close(saved)
+        cap.seek(0)
+        err = cap.read().decode(errors="replace")
+    return compiled, err

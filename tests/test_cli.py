@@ -46,6 +46,15 @@ def daemon():
     rt.stop()
 
 
+def test_serve_k8s_wire_without_target_errors(capsys):
+    """ADVICE r3: --k8s-wire with no remote target must error, not silently
+    start the local in-process runtime."""
+    assert cli.main(["serve", "--k8s-wire"]) == 2
+    assert "--k8s-wire requires a remote cluster target" in (
+        capsys.readouterr().err
+    )
+
+
 def test_validate_ok(manifest, capsys):
     assert cli.main(["validate", "-f", manifest]) == 0
     assert "valid" in capsys.readouterr().out

@@ -181,55 +181,8 @@ def test_moe_decode_matches_forward(cfg, params):
 
 
 def _compile_train_step_capturing_stderr(cfg, mesh):
-    """Compile a full MoE train step on the mesh while capturing fd-2 (XLA's
-    SPMD partitioner logs there from C++); returns (compiled, stderr_text)."""
-    import os
-    import tempfile
-
-    import optax as _optax
-
-    from kubeflow_controller_tpu.parallel.mesh import batch_sharding
-    from kubeflow_controller_tpu.parallel.sharding import opt_state_shardings
-
-    params = tfm.init_params(cfg, jax.random.key(0))
-    specs = tfm.param_specs(cfg)
-    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
-    params = jax.tree.map(jax.device_put, params, param_sh)
-    tx = _optax.adamw(1e-3)
-    opt_sh = opt_state_shardings(tx, params, param_sh, mesh)
-    opt_state = jax.jit(tx.init, out_shardings=opt_sh)(params)
-    tokens = jax.device_put(
-        jnp.asarray(
-            np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 33)),
-            jnp.int32,
-        ),
-        batch_sharding(mesh),
-    )
-
-    def train_step(params, opt_state, tokens):
-        def lossf(p):
-            return tfm.next_token_loss(cfg, p, {"tokens": tokens})
-
-        (loss, _), grads = jax.value_and_grad(lossf, has_aux=True)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return _optax.apply_updates(params, updates), opt_state, loss
-
-    with tempfile.TemporaryFile() as cap, jax.set_mesh(mesh):
-        lowered = jax.jit(
-            train_step,
-            in_shardings=(param_sh, opt_sh, batch_sharding(mesh)),
-            out_shardings=(param_sh, opt_sh, NamedSharding(mesh, P())),
-        ).lower(params, opt_state, tokens)
-        saved = os.dup(2)
-        try:
-            os.dup2(cap.fileno(), 2)
-            compiled = lowered.compile()
-        finally:
-            os.dup2(saved, 2)
-            os.close(saved)
-        cap.seek(0)
-        err = cap.read().decode(errors="replace")
-    return compiled, err
+    from hlo_util import compile_train_step_capturing_stderr
+    return compile_train_step_capturing_stderr(cfg, mesh)
 
 
 def test_ep_train_step_has_no_involuntary_remat_and_uses_all_to_all(cfg):

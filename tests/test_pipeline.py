@@ -141,6 +141,42 @@ class TestTransformerPP:
                 np.asarray(b), np.asarray(a), atol=3e-4, rtol=2e-3,
             )
 
+    def test_packed_batch_matches_non_pipelined(self, pp_mesh):
+        """VERDICT r3 #1: packed batches on the pp path. Segment ids and
+        per-document positions ride as gpipe extras (each stage indexes
+        the side inputs of the microbatch it currently holds); loss and
+        grads must match the non-pipelined packed path."""
+        cfg = small_cfg()
+        params = tfm.init_params(cfg, jax.random.key(1))
+        rng = np.random.default_rng(1)
+        tokens = jnp.asarray(
+            rng.integers(1, cfg.vocab_size, (8, 33)), jnp.int32)
+        segs = jnp.asarray(np.concatenate(
+            [np.full((8, 16), 1), np.full((8, 12), 2), np.zeros((8, 5))],
+            axis=1), jnp.int32)
+        batch = {"tokens": tokens, "segment_ids": segs}
+
+        l_ref, g_ref = jax.value_and_grad(
+            lambda p: tfm.next_token_loss(cfg, p, batch)[0])(params)
+
+        with jax.set_mesh(pp_mesh):
+            pparams = shard_params(params, cfg, pp_mesh, pp=True)
+            pbatch = {
+                "tokens": jax.device_put(tokens, batch_sharding(pp_mesh)),
+                "segment_ids": jax.device_put(
+                    segs, batch_sharding(pp_mesh)),
+            }
+            l_pp, g_pp = jax.jit(jax.value_and_grad(
+                lambda p: tfm.next_token_loss(
+                    cfg, p, pbatch, pp_microbatches=4)[0]))(pparams)
+        np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+        for a, b in zip(
+            jax.tree.leaves(g_ref), jax.tree.leaves(jax.device_get(g_pp))
+        ):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), atol=3e-4, rtol=2e-3,
+            )
+
     def test_full_train_step_with_remat(self, pp_mesh):
         """End-to-end adamw step on the pp mesh with remat on — the shape
         dryrun_multichip exercises; loss must be finite and params move."""
@@ -177,6 +213,27 @@ class TestTransformerPP:
             p2, opt, l2 = step(p1, opt, tokens)
         assert np.isfinite(float(l1)) and np.isfinite(float(l2))
         assert float(l2) < float(l1)  # it actually learns
+
+    def test_pp_train_step_has_no_involuntary_remat_and_uses_ppermute(self):
+        """VERDICT r3 #1: the pp shardings must partition cleanly.
+
+        Compiles the FULL pipelined train step (fwd+bwd+adamw, remat on) on
+        the dryrun's (pp=2, fsdp=2, tp=2) mesh — the shape whose round-3
+        dryrun log tail showed 4 involuntary-full-rematerialization
+        fallbacks at the embed-table boundary — and asserts (a) the SPMD
+        partitioner never fell back to replicate-then-repartition and
+        (b) the microbatch rotation lowered to collective-permute.
+        """
+        from hlo_util import compile_train_step_capturing_stderr
+
+        mesh = make_mesh(MeshConfig(pp=2, dp=1, fsdp=2, tp=2))
+        cfg = small_cfg(remat=True)
+        compiled, err = compile_train_step_capturing_stderr(
+            cfg, mesh, global_batch=8, pp_microbatches=4,
+        )
+        assert "Involuntary full rematerialization" not in err, err[-4000:]
+        hlo = compiled.as_text()
+        assert "collective-permute" in hlo
 
     def test_moe_rejected_on_pp_path(self, pp_mesh):
         cfg = tfm.tiny_moe_config()
