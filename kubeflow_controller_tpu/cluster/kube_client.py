@@ -80,9 +80,9 @@ class KubeClusterClient:
         kube_context: Optional[KubeContext] = None,
         timeout: float = 10.0,
     ):
+        self._ctx = kube_context
         if kube_context is not None:
             server = server or kube_context.server
-            token = token or kube_context.token
             if namespace == "default":
                 namespace = kube_context.namespace
             self._ssl: Optional[ssl.SSLContext] = kube_context.ssl_context()
@@ -102,23 +102,44 @@ class KubeClusterClient:
 
     # -- transport -----------------------------------------------------------
 
+    def _bearer_token(self) -> str:
+        """Static override first; otherwise the context's DYNAMIC token
+        (exec plugin / re-read tokenFile) so rotating credentials keep a
+        long-running controller authenticated."""
+        if self.token:
+            return self.token
+        if self._ctx is not None:
+            return self._ctx.bearer_token()
+        return ""
+
     def _request(
         self, method: str, path: str, payload: Optional[Dict] = None,
         stream: bool = False, timeout: Optional[float] = None,
         content_type: str = "application/json",
+        _auth_retried: bool = False,
     ):
         url = self.base_url + path
         data = json.dumps(payload).encode() if payload is not None else None
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Content-Type", content_type)
         req.add_header("Accept", "application/json")
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
+        tok = self._bearer_token()
+        if tok:
+            req.add_header("Authorization", f"Bearer {tok}")
         try:
             resp = urllib.request.urlopen(
                 req, timeout=timeout or self.timeout, context=self._ssl,
             )
         except urllib.error.HTTPError as e:
+            if e.code == 401 and self._ctx is not None and not _auth_retried:
+                # The token we sent was stale (SA rotation / expired exec
+                # credential): drop the cache and retry once with a fresh
+                # one — client-go's exec provider does exactly this.
+                self._ctx.invalidate_token()
+                return self._request(
+                    method, path, payload, stream=stream, timeout=timeout,
+                    content_type=content_type, _auth_retried=True,
+                )
             try:
                 body = json.loads(e.read() or b"{}")
             except json.JSONDecodeError:
@@ -181,32 +202,34 @@ class KubeClusterClient:
     def _overlay_metadata_update(
         self, kind: str, obj: Any, to_wire: Any, from_wire: Any,
     ) -> Any:
-        """Persist an ownership/metadata mutation WITHOUT full-replacing the
-        server-side object.
+        """Persist an ownership/metadata mutation as a JSON merge-patch of
+        ONLY the metadata maps the claiming paths own.
 
         The only callers of update_pod/update_service are the claiming
         paths (adopt/release, ``controller/claim.py``) — metadata-only
-        changes. A full PUT of our (deliberately narrow) dataclass
-        round-trip would strip server-populated spec fields a real
-        apiserver refuses to drop (volumes, nodeName, tolerations, ...),
-        so instead: GET the live wire JSON, overlay just the metadata maps
-        we own, and PUT the merged document back under the caller's
-        resourceVersion — the reference's ownerReference patch
-        (``ref/base.go:59-112``) with read-modify-write fidelity.
-        """
+        changes. A full PUT would (a) strip server-populated spec fields a
+        real apiserver refuses to drop and (b) carry a resourceVersion
+        that any concurrent writer (kubelet status updates, most of all)
+        conflicts — leaving adoption to heal only on a later sync. A
+        targeted patch with no resourceVersion cannot conflict: the
+        reference's strategic-merge ownerReference patch
+        (``ref/base.go:75-87``, ``ref/service.go:123-134``) rebuilt on
+        JSON merge-patch. ONLY ownerReferences is sent — the claim paths
+        never change labels/annotations, and patching those maps from a
+        possibly-stale informer copy would silently revert concurrent
+        edits by other writers. ownerReferences is sent even when empty
+        (merge semantics: an omitted key would mean "unchanged", but
+        release must CLEAR the list)."""
         path = (f"{self._collection(kind, obj.metadata.namespace)}/"
                 f"{obj.metadata.name}")
-        live = self._request("GET", path)
-        desired_meta = to_wire(obj)["metadata"]
-        live_meta = live.setdefault("metadata", {})
-        for field in ("labels", "annotations", "ownerReferences"):
-            if field in desired_meta:
-                live_meta[field] = desired_meta[field]
-            else:
-                live_meta.pop(field, None)
-        if "resourceVersion" in desired_meta:
-            live_meta["resourceVersion"] = desired_meta["resourceVersion"]
-        out = self._request("PUT", path, live)
+        meta = to_wire(obj)["metadata"]
+        patch = {"metadata": {
+            "ownerReferences": meta.get("ownerReferences") or [],
+        }}
+        out = self._request(
+            "PATCH", path, patch,
+            content_type="application/merge-patch+json",
+        )
         return from_wire(out)
 
     def update_pod(self, pod: Pod) -> Pod:

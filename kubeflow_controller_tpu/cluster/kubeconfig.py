@@ -8,27 +8,37 @@ shape (clusters / users / contexts / current-context), resolve one context,
 and build the ``ssl.SSLContext`` + headers ``kube_client.KubeClusterClient``
 needs.
 
-Supported auth/TLS surface (the subset GKE and kubeadm configs actually use
-for controller service accounts):
+Supported auth/TLS surface (what GKE and kubeadm configs actually use):
 
-- ``token`` / ``tokenFile`` bearer auth,
+- ``token`` / ``tokenFile`` bearer auth — tokenFile is RE-READ on expiry/
+  rejection, because bound service-account tokens rotate (~1h) on real
+  clusters and a long-running controller's credentials must follow,
+- ``exec`` credential plugins (``users[].user.exec``) — the shape GKE user
+  kubeconfigs require since k8s 1.26 (``gke-gcloud-auth-plugin``): spawn
+  the plugin, parse the ``ExecCredential`` JSON it prints, cache the token
+  until its ``expirationTimestamp``,
 - ``client-certificate(-data)`` + ``client-key(-data)`` mTLS,
 - ``certificate-authority(-data)`` server verification,
 - ``insecure-skip-tls-verify``.
 
-Exec-plugin credential helpers are intentionally out of scope — controllers
-in-cluster use mounted service-account tokens, which is the ``tokenFile``
-path.
+Callers should use ``KubeContext.bearer_token()`` (dynamic) rather than the
+static ``token`` field; ``invalidate_token()`` on a 401 forces re-read /
+re-exec — ``kube_client.KubeClusterClient`` does both.
 """
 
 from __future__ import annotations
 
 import base64
+import json
 import os
 import ssl
+import subprocess
 import tempfile
+import threading
+import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from datetime import datetime, timezone
+from typing import Any, Dict, Optional, Tuple
 
 import yaml
 
@@ -50,11 +60,54 @@ class KubeContext:
     client_cert_file: str = ""   # PEM file paths (written if *-data given)
     client_key_file: str = ""
     context_name: str = ""
+    # Rotating-credential sources (preferred over the static ``token``
+    # snapshot when present):
+    token_file: str = ""                      # re-readable bearer token
+    exec_config: Optional[Dict[str, Any]] = None  # users[].user.exec verbatim
+    # How long a re-read tokenFile is trusted before the next read (bound
+    # SA tokens rotate server-side; client-go re-reads on a ~1min cadence).
+    token_file_ttl: float = 60.0
 
     # Key/cert files this loader materialized from *-data fields. They hold
     # private key material: written 0600 (NamedTemporaryFile default) and
     # deleted at process exit via atexit — call cleanup() to remove sooner.
     _temp_files: list = field(default_factory=list)
+    _cached_token: str = ""
+    _cached_expiry: float = 0.0   # 0 = no expiry; unix seconds otherwise
+    # One context is shared by every controller worker thread; the lock
+    # keeps an expiry from fanning out into N concurrent exec-plugin
+    # spawns (and keeps token/expiry assignment atomic for readers).
+    _token_lock: Any = field(default_factory=threading.Lock)
+
+    def bearer_token(self) -> str:
+        """The CURRENT bearer token: exec-plugin output cached until its
+        expirationTimestamp, a tokenFile re-read on a TTL, or the static
+        ``token``. Call ``invalidate_token()`` on a 401 to force refresh."""
+        with self._token_lock:
+            now = time.time()
+            if self._cached_token and (
+                self._cached_expiry == 0 or now < self._cached_expiry
+            ):
+                return self._cached_token
+            if self.exec_config is not None:
+                tok, expiry = run_exec_plugin(
+                    self.exec_config, server=self.server,
+                    ca_data=self.ca_data,
+                )
+                self._cached_token, self._cached_expiry = tok, expiry
+                return tok
+            if self.token_file:
+                with open(self.token_file) as f:
+                    self._cached_token = f.read().strip()
+                self._cached_expiry = now + self.token_file_ttl
+                return self._cached_token
+            return self.token
+
+    def invalidate_token(self) -> None:
+        """Drop cached dynamic credentials (the 401 path: the apiserver
+        rejected what we sent, so the rotation beat our cache)."""
+        with self._token_lock:
+            self._cached_token, self._cached_expiry = "", 0.0
 
     def cleanup(self) -> None:
         """Remove materialized key/cert temp files."""
@@ -84,6 +137,89 @@ class KubeContext:
 
 def _b64_text(data: str) -> str:
     return base64.b64decode(data).decode()
+
+
+def _parse_rfc3339(ts: str) -> float:
+    """RFC3339 timestamp -> unix seconds (0.0 if unparseable — treat as no
+    expiry and rely on 401-driven invalidation). Accepts both the 'Z'
+    suffix and numeric offsets; a naive timestamp is read as UTC."""
+    try:
+        dt = datetime.fromisoformat(ts.replace("Z", "+00:00"))
+    except ValueError:
+        return 0.0
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.timestamp()
+
+
+def run_exec_plugin(
+    cfg: Dict[str, Any], server: str = "", ca_data: str = "",
+    timeout: float = 30.0,
+) -> Tuple[str, float]:
+    """Spawn a ``users[].user.exec`` credential plugin and parse the
+    ``ExecCredential`` it prints (client.authentication.k8s.io protocol —
+    what client-go's exec provider does for ``gke-gcloud-auth-plugin``).
+
+    Returns (token, expiry_unix_seconds); expiry 0.0 means "no expiry
+    stated" (cache until a 401 invalidates). Raises KubeconfigError on a
+    non-zero exit, bad JSON, or a credential without a token.
+    """
+    command = cfg.get("command")
+    if not command:
+        raise KubeconfigError("kubeconfig: exec entry has no command")
+    argv = [command, *(cfg.get("args") or [])]
+    env = dict(os.environ)
+    for item in cfg.get("env") or []:
+        env[str(item.get("name"))] = str(item.get("value", ""))
+    api_version = cfg.get(
+        "apiVersion", "client.authentication.k8s.io/v1beta1"
+    )
+    exec_info: Dict[str, Any] = {
+        "apiVersion": api_version,
+        "kind": "ExecCredential",
+        "spec": {"interactive": False},
+    }
+    if cfg.get("provideClusterInfo") and server:
+        cluster: Dict[str, Any] = {"server": server}
+        if ca_data:
+            cluster["certificate-authority-data"] = base64.b64encode(
+                ca_data.encode()
+            ).decode()
+        exec_info["spec"]["cluster"] = cluster
+    env["KUBERNETES_EXEC_INFO"] = json.dumps(exec_info)
+    try:
+        proc = subprocess.run(
+            argv, env=env, capture_output=True, timeout=timeout,
+        )
+    except FileNotFoundError:
+        raise KubeconfigError(
+            f"kubeconfig: exec plugin {command!r} not found on PATH"
+        ) from None
+    except subprocess.TimeoutExpired:
+        raise KubeconfigError(
+            f"kubeconfig: exec plugin {command!r} timed out after "
+            f"{timeout:.0f}s"
+        ) from None
+    if proc.returncode != 0:
+        raise KubeconfigError(
+            f"kubeconfig: exec plugin {command!r} failed "
+            f"(rc={proc.returncode}): "
+            f"{proc.stderr.decode(errors='replace').strip()[:500]}"
+        )
+    try:
+        cred = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        raise KubeconfigError(
+            f"kubeconfig: exec plugin {command!r} printed invalid JSON"
+        ) from None
+    status = (cred or {}).get("status") or {}
+    token = status.get("token", "")
+    if not token:
+        raise KubeconfigError(
+            f"kubeconfig: exec plugin {command!r} returned no status.token"
+        )
+    exp = status.get("expirationTimestamp")
+    return str(token), _parse_rfc3339(exp) if exp else 0.0
 
 
 def _materialize(pem_text: str, suffix: str, holder: list) -> str:
@@ -180,8 +316,19 @@ def resolve_context(
     if user.get("token"):
         out.token = str(user["token"])
     elif user.get("tokenFile"):
-        with open(user["tokenFile"]) as f:
+        # Snapshot for callers that read .token, but keep the path so
+        # bearer_token() follows rotation.
+        out.token_file = str(user["tokenFile"])
+        with open(out.token_file) as f:
             out.token = f.read().strip()
+    if user.get("exec"):
+        exec_cfg = user["exec"]
+        if not isinstance(exec_cfg, dict):
+            raise KubeconfigError(
+                f"kubeconfig: user for context {ctx_name!r}: exec entry "
+                "must be a mapping"
+            )
+        out.exec_config = exec_cfg
 
     if user.get("client-certificate-data"):
         out.client_cert_file = _materialize(
@@ -225,5 +372,9 @@ def in_cluster_context() -> Optional[KubeContext]:
         server=f"https://{host}:{port}",
         namespace=namespace,
         token=token,
+        # Bound SA tokens rotate (~1h): keep the path so bearer_token()
+        # re-reads instead of pinning the boot-time value for the life of
+        # the controller.
+        token_file=token_path,
         ca_data=ca_data,
     )

@@ -259,6 +259,39 @@ def make_rest_handler(
                 if method == "DELETE":
                     store.delete(ns, name)
                     return self._send(200, {"deleted": f"{ns}/{name}"})
+                if method == "PATCH" and k8s_mode:
+                    # JSON merge-patch of metadata — the conflict-free
+                    # adoption write (no resourceVersion precondition; a
+                    # real apiserver applies patches against the live
+                    # object the same way). store.mutate is atomic, so a
+                    # concurrent status PUT can interleave but never
+                    # conflict the patch.
+                    pm = (self._body().get("metadata") or {})
+
+                    def apply_meta(o):
+                        if "labels" in pm:
+                            for k, v in (pm["labels"] or {}).items():
+                                if v is None:
+                                    o.metadata.labels.pop(k, None)
+                                else:
+                                    o.metadata.labels[k] = str(v)
+                        if "annotations" in pm:
+                            for k, v in (pm["annotations"] or {}).items():
+                                if v is None:
+                                    o.metadata.annotations.pop(k, None)
+                                else:
+                                    o.metadata.annotations[k] = str(v)
+                        if "ownerReferences" in pm:
+                            # Lists replace wholesale under merge-patch.
+                            parsed = kube_wire.meta_from_k8s(
+                                {"ownerReferences": pm["ownerReferences"]}
+                            )
+                            o.metadata.owner_references = (
+                                parsed.owner_references
+                            )
+
+                    out = store.mutate(ns, name, apply_meta)
+                    return self._send(200, to_dict(out))
                 self._send(405, {"error": method})
             except NotFound as e:
                 self._send(404, {"error": str(e)})

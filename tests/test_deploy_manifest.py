@@ -1,0 +1,130 @@
+"""examples/deploy/controller.yml — the controller's installable shape
+(VERDICT r3 #10). The manifest must (a) invoke a CLI command line that
+actually exists and selects the real-k8s path, and (b) grant exactly the
+API permissions the KubeClusterClient's reconcile traffic needs — each
+endpoint the adapter hits maps to an (apiGroup, resource, verb) that the
+ClusterRole must cover.
+"""
+
+import os
+
+import yaml
+
+from kubeflow_controller_tpu import cli
+
+MANIFEST = os.path.join(
+    os.path.dirname(__file__), "..", "examples", "deploy", "controller.yml"
+)
+
+
+def _docs():
+    with open(MANIFEST) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def _by_kind(docs, kind):
+    out = [d for d in docs if d.get("kind") == kind]
+    assert out, f"manifest is missing a {kind}"
+    return out[0]
+
+
+def test_manifest_shape():
+    docs = _docs()
+    kinds = sorted(d["kind"] for d in docs)
+    assert kinds == [
+        "ClusterRole", "ClusterRoleBinding", "Deployment", "Namespace",
+        "ServiceAccount",
+    ]
+    sa = _by_kind(docs, "ServiceAccount")
+    dep = _by_kind(docs, "Deployment")
+    binding = _by_kind(docs, "ClusterRoleBinding")
+    role = _by_kind(docs, "ClusterRole")
+    ns = _by_kind(docs, "Namespace")["metadata"]["name"]
+    # The pieces reference each other consistently.
+    assert sa["metadata"]["namespace"] == ns
+    assert dep["metadata"]["namespace"] == ns
+    pod_spec = dep["spec"]["template"]["spec"]
+    assert pod_spec["serviceAccountName"] == sa["metadata"]["name"]
+    assert binding["roleRef"]["name"] == role["metadata"]["name"]
+    subject = binding["subjects"][0]
+    assert subject["name"] == sa["metadata"]["name"]
+    assert subject["namespace"] == ns
+
+
+def test_deployment_command_line_is_valid():
+    """The container args must parse through the real CLI and select the
+    in-cluster strict-k8s path (not silently fall back to the local
+    in-process runtime)."""
+    dep = _by_kind(_docs(), "Deployment")
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"] == ["tpujobctl"]
+    args = cli.build_parser().parse_args([str(a) for a in c["args"]])
+    assert args.cmd == "serve"
+    assert args.in_cluster is True
+    assert args.k8s_wire is True
+    assert args.fn is cli.cmd_serve
+
+
+def _granted(rules, group, resource, verb) -> bool:
+    for rule in rules:
+        groups = rule.get("apiGroups", [])
+        if group not in groups and "*" not in groups:
+            continue
+        resources = rule.get("resources", [])
+        if resource not in resources and "*" not in resources:
+            continue
+        verbs = rule.get("verbs", [])
+        if verb in verbs or "*" in verbs:
+            return True
+    return False
+
+
+def test_rbac_covers_every_adapter_call():
+    """Every wire call KubeClusterClient makes (kube_client.py) must be
+    granted; conversely spot-check that obviously-unneeded write scopes
+    are NOT granted (least privilege)."""
+    rules = _by_kind(_docs(), "ClusterRole")["rules"]
+    needed = [
+        # pods/services: full CRUD + the informers' list-then-watch +
+        # patch (adoption writes ownerReferences via merge-patch, and RBAC
+        # treats patch as a distinct verb from update)
+        *[("", r, v) for r in ("pods", "services")
+          for v in ("get", "list", "watch", "create", "update", "patch",
+                    "delete")],
+        # events: POST new + PATCH aggregated repeats (record_event)
+        ("", "events", "create"),
+        ("", "events", "patch"),
+        # nodes: slice health from node pools (read-only)
+        ("", "nodes", "get"),
+        ("", "nodes", "list"),
+        # the CRD: job CRUD + watch, and the status subresource PUT
+        *[("tpu.kubeflow.dev", "tpujobs", v)
+          for v in ("get", "list", "watch", "create", "update", "delete")],
+        ("tpu.kubeflow.dev", "tpujobs/status", "update"),
+    ]
+    missing = [n for n in needed if not _granted(rules, *n)]
+    assert not missing, f"ClusterRole missing grants: {missing}"
+    # Least privilege: the controller never writes nodes, never deletes
+    # events, and touches no secrets.
+    assert not _granted(rules, "", "nodes", "update")
+    assert not _granted(rules, "", "nodes", "delete")
+    assert not _granted(rules, "", "events", "delete")
+    assert not _granted(rules, "", "secrets", "get")
+
+
+def test_crd_group_matches_adapter():
+    """The deploy doc tells users to apply the CRD first; its group/plural
+    must be the ones the adapter dials."""
+    from kubeflow_controller_tpu.cluster.kube_client import JOB_BASE
+
+    crd_path = os.path.join(
+        os.path.dirname(__file__), "..", "examples", "crd", "tpujob-crd.yml"
+    )
+    with open(crd_path) as f:
+        crd = yaml.safe_load(f)
+    group = crd["spec"]["group"]
+    plural = crd["spec"]["names"]["plural"]
+    version = crd["spec"]["versions"][0]["name"]
+    assert JOB_BASE == f"/apis/{group}/{version}"
+    rules = _by_kind(_docs(), "ClusterRole")["rules"]
+    assert _granted(rules, group, plural, "watch")
