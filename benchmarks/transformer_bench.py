@@ -58,14 +58,28 @@ def main() -> None:
                    choices=["auto", "einsum", "gather"])
     p.add_argument("--peak-tflops", type=float, default=DEFAULT_PEAK_TFLOPS)
     p.add_argument("--no-remat", action="store_true")
+    p.add_argument("--quant", default="", choices=["", "int8"],
+                   help="int8 = run linear projections on the int8 MXU "
+                        "path (ops/quant.py)")
+    p.add_argument("--remat-mode", default="",
+                   choices=["", "full", "ffn", "none"],
+                   help="full = dots policy (default), ffn = save all but "
+                        "the d_ff-wide FFN intermediates, none = no remat")
     p.add_argument("--loss-chunk", type=int, default=0)
     args = p.parse_args()
 
+    if args.no_remat and args.remat_mode not in ("", "none"):
+        p.error("--no-remat conflicts with --remat-mode "
+                f"{args.remat_mode!r}; use --remat-mode alone")
+    remat: object = not args.no_remat
+    if args.remat_mode:
+        remat = {"full": True, "ffn": "ffn", "none": False}[args.remat_mode]
     cfg = tfm.TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_layers=args.layers,
         n_heads=args.heads, n_kv_heads=args.kv_heads, d_ff=args.d_ff,
-        max_seq=args.seq, attn_impl=args.attn, remat=not args.no_remat,
+        max_seq=args.seq, attn_impl=args.attn, remat=remat,
         moe_experts=args.moe_experts, moe_top_k=args.moe_top_k,
+        quant=args.quant,
     )
     if args.moe_group:
         cfg = cfg.replace(moe_group_size=args.moe_group)

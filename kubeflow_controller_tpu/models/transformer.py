@@ -57,7 +57,21 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16          # activation/compute dtype (MXU-native)
     param_dtype: Any = jnp.float32     # master weights
-    remat: bool = True
+    # Rematerialization of the layer scan body:
+    #   True   - checkpoint with the dots policy (backward re-runs the
+    #            whole layer forward; cheapest memory, ~1/3 extra FLOPs),
+    #   "ffn"  - save everything EXCEPT the four d_ff-wide FFN
+    #            intermediates (backward re-runs only the gate/up matmuls;
+    #            ~9% extra FLOPs for ~4x d_ff x seq x batch bytes saved
+    #            per layer) — the middle rung when no-remat OOMs,
+    #   False  - save all residuals (no recompute; largest memory).
+    remat: Any = True
+    # "" = bf16 matmuls (default). "int8" runs every linear projection
+    # (qkv/o, FFN gate/up/down) through the int8 MXU path — dynamic
+    # symmetric quantization with STE gradients, all three matmuls per
+    # layer quantized (ops/quant.py). Embed, LM head, and attention
+    # scores/softmax stay bf16/fp32.
+    quant: str = ""
     attn_impl: str = "auto"            # auto|xla|flash|ring
     tie_embeddings: bool = False
     shard_seq: bool = False            # constrain activations' seq axis to sp
@@ -249,14 +263,37 @@ def _remat_policy(cfg: TransformerConfig):
     standard dots policy) — and for MoE also the named dispatch/combine
     masks, so the backward pass reads them instead of re-running the whole
     top-k routing chain (argmax/cumsum/one-hot cascades: cheap FLOPs, many
-    kernels — measured as a fixed ~14 ms/step at 12 layers in r3)."""
+    kernels — measured as a fixed ~14 ms/step at 12 layers in r3).
+
+    ``remat="ffn"`` (dense models) inverts the trade: save every residual
+    EXCEPT the named d_ff-wide FFN intermediates, so backward re-runs only
+    the gate/up matmuls instead of the whole layer."""
+    if cfg.remat == "ffn" and not cfg.moe_experts:
+        drop = ["ffn_pre_gate", "ffn_gate", "ffn_up", "ffn_prod"]
+        if cfg.quant == "int8":
+            # The int8 path's named operands include the quantized copy of
+            # ffn_prod ([b,s,d_ff] int8) — saving those would retain half
+            # the bytes this mode exists to drop; recompute them too.
+            from kubeflow_controller_tpu.ops.quant import INT8_SAVE_NAMES
+
+            drop += list(INT8_SAVE_NAMES)
+        return jax.checkpoint_policies.save_anything_except_these_names(
+            *drop
+        )
     base = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    names = []
     if cfg.moe_experts:
+        names += ["moe_combine", "moe_dispatch"]
+    if cfg.quant == "int8":
+        # Save the quantized operands (int8: half the bf16 bytes) so the
+        # backward re-forward reads them instead of re-running the
+        # abs-max/round/clip chains.
+        from kubeflow_controller_tpu.ops.quant import INT8_SAVE_NAMES
+
+        names += list(INT8_SAVE_NAMES)
+    if names:
         return jax.checkpoint_policies.save_from_both_policies(
-            base,
-            jax.checkpoint_policies.save_only_these_names(
-                "moe_combine", "moe_dispatch"
-            ),
+            base, jax.checkpoint_policies.save_only_these_names(*names),
         )
     return base
 
@@ -489,15 +526,21 @@ def _layer(
     positions: jax.Array,
     segment_ids: Optional[jax.Array],
 ) -> jax.Array:
+    from kubeflow_controller_tpu.ops.quant import maybe_quant_dot
+
     b, s, _ = x.shape
     hd = cfg.head_dim
     dt = cfg.dtype
 
+    def dot(a, w):
+        # Linear projections: int8 MXU path when cfg.quant == "int8".
+        return maybe_quant_dot(a, w.astype(dt), cfg.quant)
+
     # -- attention block
     h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"].astype(dt)).reshape(b, s, cfg.n_heads, hd)
-    k = (h @ lp["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
-    v = (h @ lp["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, hd)
+    q = dot(h, lp["wq"]).reshape(b, s, cfg.n_heads, hd)
+    k = dot(h, lp["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
+    v = dot(h, lp["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
     q = _constrain(q, P(BATCH_AXES, None, "tp", None))
@@ -511,16 +554,20 @@ def _layer(
         attn = mha(q, k, v, causal=True, segment_ids=segment_ids,
                    impl=cfg.attn_impl)
     attn = attn.reshape(b, s, cfg.n_heads * hd)
-    x = x + _constrain(attn @ lp["wo"].astype(dt), _act_spec(cfg))
+    x = x + _constrain(dot(attn, lp["wo"]), _act_spec(cfg))
 
     # -- mlp block (SwiGLU dense, or routed experts)
     h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.moe_experts:
         down, aux = _moe_ffn(cfg, lp, h)
     else:
-        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
-        up = h @ lp["w_up"].astype(dt)
-        down = (gate * up) @ lp["w_down"].astype(dt)
+        from jax.ad_checkpoint import checkpoint_name
+
+        pre = checkpoint_name(dot(h, lp["w_gate"]), "ffn_pre_gate")
+        gate = checkpoint_name(jax.nn.silu(pre), "ffn_gate")
+        up = checkpoint_name(dot(h, lp["w_up"]), "ffn_up")
+        prod = checkpoint_name(gate * up, "ffn_prod")
+        down = dot(prod, lp["w_down"])
         aux = jnp.zeros((), jnp.float32)
     return x + _constrain(down, _act_spec(cfg)), aux
 
@@ -602,8 +649,8 @@ def forward_hidden_pp(
 
     run = jax.shard_map(
         lambda layers, xx, extras: gpipe(
-            stage, layers, xx, n_microbatches, remat=cfg.remat,
-            extras=extras,
+            stage, layers, xx, n_microbatches, remat=bool(cfg.remat),
+            extras=extras, remat_policy=_remat_policy(cfg),
         ),
         in_specs=(P("pp"), P(), P()),
         out_specs=P(),

@@ -49,6 +49,7 @@ def gpipe(
     axis: str = "pp",
     remat: bool = True,
     extras: Any = None,
+    remat_policy: Any = None,
 ) -> jax.Array:
     """Run ``x`` through P pipeline stages; call under shard_map manual
     over ``axis``.
@@ -91,9 +92,13 @@ def gpipe(
 
     fn = stage_fn
     if remat:
+        # Callers pass their model's policy (transformer: _remat_policy —
+        # carries the remat="ffn" / int8 save-name decisions); default to
+        # the standard dots policy.
         fn = jax.checkpoint(
             fn,
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            policy=remat_policy
+            or jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         )
 
     def tick(state, t):
