@@ -47,8 +47,14 @@ def main():
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--kv-heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=128)
-    p.add_argument("--block-q", type=int, default=512)
-    p.add_argument("--block-k", type=int, default=1024)
+    # Defaults track the kernel's own (so a flagless run measures the
+    # production configuration).
+    from kubeflow_controller_tpu.ops.flash_attention import (
+        DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q,
+    )
+
+    p.add_argument("--block-q", type=int, default=DEFAULT_BLOCK_Q)
+    p.add_argument("--block-k", type=int, default=DEFAULT_BLOCK_K)
     p.add_argument("--steps", type=int, default=20)
     args = p.parse_args()
 

@@ -97,7 +97,8 @@ def mha(
     (seq divisible by the kernel block), else the XLA path.
     """
     if impl == "auto":
-        # With 512x1024 blocks the Pallas kernel beats XLA end-to-end at
+        # With the default large blocks the Pallas kernel beats XLA
+        # end-to-end at
         # head_dim 64, 128 (and standalone at 256): measured fwd+bwd
         # 1.45-1.8x at hd64/hd128, S 1024-4096, and XLA OOMs first at long
         # sequence (benchmarks/attention_bench.py, RESULTS.md). Smaller

@@ -33,10 +33,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 # Large blocks amortise the sequential grid: at B16 S1024 H8 D128 on one
-# v5e chip, 512x1024 blocks run fwd+bwd 2.5x faster than 128x128 (see
-# benchmarks/attention_bench.py). _choose_block shrinks them to divisors
-# for short sequences; VMEM peak (s-block 512x1024 fp32 = 2 MB) is fine.
-DEFAULT_BLOCK_Q = 512
+# v5e chip, 512x1024 blocks run fwd+bwd 2.5x faster than 128x128, and
+# 1024x1024 beats 512x1024 IN-MODEL at both S1024 (333.5 -> 320.5 ms
+# flagship step — S1024 becomes one tile per (b,h), which also triggers
+# the fused single-tile backward: one score recompute instead of two
+# sweeps) and S2048 (370.5 -> 363.9 ms). _choose_block shrinks them to
+# divisors for short sequences; VMEM peak (s-block 1024x1024 fp32 = 4 MB)
+# is fine.
+DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
 
@@ -670,8 +674,8 @@ def flash_mha(
     v: jax.Array,
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention, [B,S,H,D] in/out (BSHD, matching ops.attention.mha).
@@ -685,6 +689,11 @@ def flash_mha(
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    # Resolve the module constants at CALL time, not def time: a def-time
+    # default silently ignores a patched/updated constant — the exact
+    # footgun behind round 4's mis-measured "blocks are neutral" probe.
+    block_q = DEFAULT_BLOCK_Q if block_q is None else block_q
+    block_k = DEFAULT_BLOCK_K if block_k is None else block_k
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
