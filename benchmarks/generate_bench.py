@@ -41,6 +41,9 @@ def main() -> None:
     p.add_argument("--d-ff", type=int, default=4096)
     p.add_argument("--vocab", type=int, default=32768)
     p.add_argument("--trials", type=int, default=5)
+    p.add_argument("--quant", default="", choices=["", "int8"],
+                   help="int8 = weight-only int8 serving weights "
+                        "(generate.inference_params)")
     args = p.parse_args()
 
     max_seq = args.prompt + args.gen
@@ -49,7 +52,9 @@ def main() -> None:
         n_heads=args.heads, n_kv_heads=args.kv_heads, d_ff=args.d_ff,
         max_seq=max_seq, remat=False,
     )
-    params = gen.inference_params(cfg, tfm.init_params(cfg, jax.random.key(0)))
+    params = gen.inference_params(
+        cfg, tfm.init_params(cfg, jax.random.key(0)), quant=args.quant
+    )
     prompt = jnp.asarray(
         np.random.default_rng(0).integers(
             0, cfg.vocab_size, (args.batch, args.prompt)

@@ -33,28 +33,101 @@ class KVCache(NamedTuple):
     length: jax.Array     # [] int32 — number of valid positions
 
 
-def inference_params(cfg: TransformerConfig, params: Params) -> Params:
-    """Cast fp32 master weights to the compute dtype ONCE for serving.
+# Projection weights eligible for weight-only int8 serving: 2D-per-layer
+# matmul operands whose contraction axis is the second-to-last dim. Embed
+# (gather table), norms (tiny), and the MoE router (full-precision routing
+# by design) stay out.
+_QUANT_KEYS = frozenset(
+    ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head")
+)
 
-    Halves serving HBM (335M decoder: 1.34 GB fp32 -> 0.67 GB bf16), which
-    is what bounds the achievable decode batch. Step LATENCY barely moves
+
+def inference_params(
+    cfg: TransformerConfig, params: Params, quant: str = "",
+) -> Params:
+    """Prepare master weights for serving, ONCE.
+
+    Default: cast fp32 to the compute dtype — halves serving HBM (335M
+    decoder: 1.34 GB fp32 -> 0.67 GB bf16), which is what bounds the
+    achievable decode batch. Step LATENCY barely moves at tiny batch
     (measured 2.48 -> 2.40 ms at batch 8): XLA hoists the per-use
     ``astype`` out of the decode scan, so the loop already read bf16 —
     the remaining cost is per-layer DMA latency, not dtype width.
 
-    MoE router weights stay fp32: routing is deliberately computed at full
-    precision (near-tie top-k scores must not flip between training and
-    serving), and the [D, E] router matrix is a negligible HBM cost."""
+    ``quant="int8"``: weight-only int8 — each projection weight becomes a
+    ``(q_int8, scale)`` pair with per-output-channel symmetric scales
+    (halving HBM again, 0.67 -> ~0.34 GB). Decode is HBM-bandwidth-bound
+    at serving batch sizes, so the streamed-bytes halving is the lever;
+    the dequantize (convert+scale) fuses into the matmul's operand read.
+    Quantization error is ~0.5% RMS per weight (per-channel scales);
+    activations and the KV cache stay bf16.
+
+    MoE router weights stay fp32 either way: routing is deliberately
+    computed at full precision (near-tie top-k scores must not flip
+    between training and serving), and the [D, E] router matrix is a
+    negligible HBM cost."""
     def cast(path, x):
-        if x.dtype != jnp.float32:
+        key = next(
+            (getattr(p, "key", None) for p in reversed(path)
+             if getattr(p, "key", None)), None,
+        )
+        if key == "w_router":
             return x
-        if any(
-            getattr(p, "key", None) == "w_router" for p in path
-        ):
+        if quant == "int8" and key in _QUANT_KEYS:
+            # Contraction axis is -2 for every eligible weight ([.., D, F]
+            # stacked per layer, or [D, V] for the head): per-output-
+            # channel scales keep the error local and factor out of the
+            # dot exactly.
+            xf = x.astype(jnp.float32)
+            scale = jnp.maximum(
+                jnp.max(jnp.abs(xf), axis=-2, keepdims=True), 1e-30
+            ) / 127.0
+            q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+            return (q, scale.astype(cfg.dtype))
+        if x.dtype != jnp.float32:
             return x
         return x.astype(cfg.dtype)
 
     return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def inference_param_specs(
+    cfg: TransformerConfig, quant: str = "",
+) -> Params:
+    """PartitionSpecs matching ``inference_params(..., quant=...)``'s
+    structure, so int8 serving weights place onto a mesh exactly like
+    bf16 ones: each quantized weight's (q, scale) pair gets (the weight's
+    own spec, that spec with the contraction axis — always -2 — dropped,
+    since the scale is size-1 there)."""
+    from jax.sharding import PartitionSpec as P
+
+    specs = tfm.param_specs(cfg)
+    if quant != "int8":
+        return specs
+
+    def fix(path, s):
+        key = next(
+            (getattr(p, "key", None) for p in reversed(path)
+             if getattr(p, "key", None)), None,
+        )
+        if key in _QUANT_KEYS and key != "w_router":
+            parts = tuple(s)
+            scale_spec = P(*parts[:-2], None, parts[-1])
+            return (s, scale_spec)
+        return s
+
+    return jax.tree_util.tree_map_with_path(fix, specs)
+
+
+def _w(lp: Params, name: str, dt) -> jax.Array:
+    """Resolve a (possibly weight-only-int8) projection weight to the
+    compute dtype. The (q, scale) dequant is a convert+multiply XLA fuses
+    into the consuming matmul's operand stream — int8 bytes over HBM."""
+    w = lp[name]
+    if isinstance(w, tuple):
+        q, scale = w
+        return q.astype(dt) * scale.astype(dt)
+    return w.astype(dt)
 
 
 def init_kv_cache(
@@ -82,9 +155,9 @@ def _decode_layer(
     max_seq = k_cache.shape[1]
 
     h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"].astype(dt)).reshape(b, 1, cfg.n_heads, hd)
-    k = (h @ lp["wk"].astype(dt)).reshape(b, 1, cfg.n_kv_heads, hd)
-    v = (h @ lp["wv"].astype(dt)).reshape(b, 1, cfg.n_kv_heads, hd)
+    q = (h @ _w(lp, "wq", dt)).reshape(b, 1, cfg.n_heads, hd)
+    k = (h @ _w(lp, "wk", dt)).reshape(b, 1, cfg.n_kv_heads, hd)
+    v = (h @ _w(lp, "wv", dt)).reshape(b, 1, cfg.n_kv_heads, hd)
     positions = jnp.broadcast_to(pos[None, None], (b, 1))
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
@@ -102,15 +175,15 @@ def _decode_layer(
     s = jnp.where(valid[None, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(dt)
     attn = jnp.einsum("bhqk,bkhd->bqhd", p, vv).reshape(b, 1, -1)
-    x = x + attn @ lp["wo"].astype(dt)
+    x = x + attn @ _w(lp, "wo", dt)
 
     h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.moe_experts:
         x = x + _moe_decode_ffn(cfg, lp, h)
     else:
-        gate = jax.nn.silu(h @ lp["w_gate"].astype(dt))
-        up = h @ lp["w_up"].astype(dt)
-        x = x + (gate * up) @ lp["w_down"].astype(dt)
+        gate = jax.nn.silu(h @ _w(lp, "w_gate", dt))
+        up = h @ _w(lp, "w_up", dt)
+        x = x + (gate * up) @ _w(lp, "w_down", dt)
     return x, k_cache, v_cache
 
 
@@ -126,9 +199,19 @@ def _moe_decode_ffn(
         hb.astype(jnp.float32) @ lp["w_router"].astype(jnp.float32), -1
     )
     gates, idx = jax.lax.top_k(probs, cfg.moe_top_k)    # [B, k]
-    wg = lp["w_gate"].astype(dt)[idx]                   # [B, k, D, F]
-    wu = lp["w_up"].astype(dt)[idx]
-    wd = lp["w_down"].astype(dt)[idx]                   # [B, k, F, D]
+
+    def bank(name):
+        # Gather the selected experts BEFORE dequantizing: only the
+        # routed experts' int8 bytes stream from HBM.
+        w = lp[name]
+        if isinstance(w, tuple):
+            q, scale = w
+            return q[idx].astype(dt) * scale[idx].astype(dt)
+        return w.astype(dt)[idx]
+
+    wg = bank("w_gate")                                 # [B, k, D, F]
+    wu = bank("w_up")
+    wd = bank("w_down")                                 # [B, k, F, D]
     act = jax.nn.silu(jnp.einsum("bd,bkdf->bkf", hb, wg))
     up = jnp.einsum("bd,bkdf->bkf", hb, wu)
     out_k = jnp.einsum("bkf,bkfd->bkd", act * up, wd)   # [B, k, D]
@@ -157,10 +240,11 @@ def decode_step(
         body, x, (params["layers"], cache.k, cache.v)
     )
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T
-    logits = (x[:, 0] @ head.astype(cfg.dtype)).astype(jnp.float32)
+    if params.get("lm_head") is None:
+        head = params["embed"].astype(cfg.dtype).T
+    else:
+        head = _w(params, "lm_head", cfg.dtype)
+    logits = (x[:, 0] @ head).astype(jnp.float32)
     return logits, KVCache(k=k_new, v=v_new, length=pos + 1)
 
 

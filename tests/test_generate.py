@@ -109,6 +109,68 @@ def test_inference_params_cast():
     assert (t0 == t1).mean() > 0.9
 
 
+def test_inference_params_int8_weight_only():
+    """Weight-only int8 serving (VERDICT r3 weak #6's serving half):
+    projection weights become (int8, per-channel scale) pairs — half the
+    streamed bytes of bf16 — the router stays fp32, embed stays a plain
+    table, logits stay close, and the full generate loop runs."""
+    cfg = tfm.tiny_moe_config(max_seq=64, dtype=jnp.bfloat16)
+    params = tfm.init_params(cfg, jax.random.key(0))
+    bf16 = gen.inference_params(cfg, params)
+    q8 = gen.inference_params(cfg, params, quant="int8")
+
+    assert isinstance(q8["layers"]["wq"], tuple)
+    qw, scale = q8["layers"]["wq"]
+    assert qw.dtype == jnp.int8 and scale.dtype == jnp.bfloat16
+    assert q8["layers"]["w_router"].dtype == jnp.float32
+    assert not isinstance(q8["embed"], tuple)
+
+    # Dequantized weights match the originals within per-channel int8
+    # error.
+    deq = qw.astype(jnp.float32) * scale.astype(jnp.float32)
+    ref = params["layers"]["wq"].astype(jnp.float32)
+    rel = float(jnp.linalg.norm(deq - ref) / jnp.linalg.norm(ref))
+    assert rel < 0.01, rel
+
+    # Decode-step logits stay close to the bf16 serving path.
+    cache_a = gen.init_kv_cache(cfg, 2, 16)
+    cache_b = gen.init_kv_cache(cfg, 2, 16)
+    toks = jnp.ones((2, 1), jnp.int32)
+    la, _ = gen.decode_step(cfg, bf16, toks, cache_a)
+    lb, _ = gen.decode_step(cfg, q8, toks, cache_b)
+    rel = float(jnp.linalg.norm(lb - la) / jnp.linalg.norm(la))
+    assert rel < 0.1, rel
+
+    # The whole loop (prefill + scan generate) runs on quantized weights.
+    out = gen.generate(
+        cfg, q8, jnp.zeros((2, 8), jnp.int32), max_new_tokens=8)
+    assert out.shape == (2, 8)
+
+
+def test_int8_serving_places_on_mesh():
+    """inference_param_specs must mirror the quantized structure so int8
+    serving shards like bf16 ('works under the same mesh as training')."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from kubeflow_controller_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, sp=1, tp=2))
+    cfg = tfm.tiny_config(max_seq=64, dtype=jnp.bfloat16)
+    params = tfm.init_params(cfg, jax.random.key(0))
+    q8 = gen.inference_params(cfg, params, quant="int8")
+    specs = gen.inference_param_specs(cfg, quant="int8")
+    placed = jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), q8, specs,
+    )
+    qw, scale = placed["layers"]["wq"]
+    assert qw.dtype == jnp.int8 and scale.shape[-2] == 1
+    cache = gen.init_kv_cache(cfg, 4, 16)
+    logits, cache = gen.decode_step(
+        cfg, placed, jnp.ones((4, 1), jnp.int32), cache)
+    assert logits.shape == (4, cfg.vocab_size)
+
+
 def test_filter_logits_top_k():
     logits = jnp.asarray([[1.0, 3.0, 2.0, 0.0]])
     out = gen._filter_logits(logits, top_k=2)
