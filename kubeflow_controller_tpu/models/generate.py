@@ -240,12 +240,29 @@ def decode_step(
         body, x, (params["layers"], cache.k, cache.v)
     )
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = _head_logits(cfg, params, x[:, 0])
+    return logits, KVCache(k=k_new, v=v_new, length=pos + 1)
+
+
+def _head_logits(cfg: TransformerConfig, params: Params, x: jax.Array):
+    """Final-norm'd hidden [B, D] -> fp32 logits [B, vocab] (shared by
+    decode_step and prefill; understands weight-only-int8 heads)."""
     if params.get("lm_head") is None:
         head = params["embed"].astype(cfg.dtype).T
     else:
         head = _w(params, "lm_head", cfg.dtype)
-    logits = (x[:, 0] @ head).astype(jnp.float32)
-    return logits, KVCache(k=k_new, v=v_new, length=pos + 1)
+    return (x @ head).astype(jnp.float32)
+
+
+def _dense_lp(lp: Params, dt) -> Params:
+    """Per-layer params with any (q, scale) pairs dequantized to arrays —
+    for code paths (the MoE prefill FFN) that reuse training functions
+    expecting plain weights."""
+    return {
+        k: (v[0].astype(dt) * v[1].astype(dt)) if isinstance(v, tuple)
+        else v
+        for k, v in lp.items()
+    }
 
 
 def prefill(
@@ -254,9 +271,83 @@ def prefill(
     prompt: jax.Array,          # [B, S_prompt]
     cache: KVCache,
 ) -> Tuple[jax.Array, KVCache]:
-    """Feed the prompt token-by-token through the decode path (simple and
-    always-correct; a fused block prefill is a later optimisation). Returns
-    logits for the LAST prompt position and the filled cache."""
+    """Fused block prefill: ONE forward pass over the whole prompt fills
+    the cache — all positions at once through the training-shaped
+    attention (flash on TPU when the prompt tiles), instead of S_prompt
+    sequential single-token decode steps. Returns logits for the LAST
+    prompt position and the filled cache.
+
+    Requires a FRESH cache: positions start at 0 and k/v land at offset 0.
+    To extend an existing conversation (multi-turn), use
+    ``prefill_tokenwise`` — new tokens must attend to the prior cache,
+    which the block pass does not model."""
+    from kubeflow_controller_tpu.ops.attention import mha
+
+    b, s = prompt.shape
+    dt = cfg.dtype
+    hd = cfg.head_dim
+    x = params["embed"].astype(dt)[prompt]              # [B, S, D]
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    # Ring attention needs a live sp mesh, and an explicit "flash" must
+    # not crash on prompt lengths the kernel cannot tile — "auto" prefers
+    # flash and falls back to XLA on shape (the mha dispatch gate).
+    attn_impl = "xla" if cfg.attn_impl == "xla" else "auto"
+    if cfg.moe_experts:
+        # decode_step never drops tokens; the block pass must not either.
+        # Capacity factor E/top_k makes every group's per-expert capacity
+        # equal to the full group, so training-_moe_ffn routing becomes
+        # exactly "top-k experts per token" regardless of cfg's training
+        # capacity factor.
+        moe_cfg = cfg.replace(
+            moe_capacity_factor=float(cfg.moe_experts) / cfg.moe_top_k
+        )
+
+    def body(x, lp):
+        # Mirrors transformer._layer (+ per-layer k/v out, int8 weight
+        # resolution, no sharding constraints). Drift between the copies
+        # is pinned by the test chain: prefill == tokenwise decode
+        # (test_block_prefill_matches_tokenwise_decode) and tokenwise
+        # decode == training forward (test_decode_logits_match_forward).
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ _w(lp, "wq", dt)).reshape(b, s, cfg.n_heads, hd)
+        k = (h @ _w(lp, "wk", dt)).reshape(b, s, cfg.n_kv_heads, hd)
+        v = (h @ _w(lp, "wv", dt)).reshape(b, s, cfg.n_kv_heads, hd)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        attn = mha(q, k, v, causal=True, impl=attn_impl)
+        x = x + attn.reshape(b, s, -1) @ _w(lp, "wo", dt)
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        if cfg.moe_experts:
+            down, _aux = tfm._moe_ffn(moe_cfg, _dense_lp(lp, dt), h2)
+            x = x + down
+        else:
+            gate = jax.nn.silu(h2 @ _w(lp, "w_gate", dt))
+            up = h2 @ _w(lp, "w_up", dt)
+            x = x + (gate * up) @ _w(lp, "w_down", dt)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])
+    k_cache = lax.dynamic_update_slice(
+        cache.k, ks.astype(cache.k.dtype), (0, 0, 0, 0, 0))
+    v_cache = lax.dynamic_update_slice(
+        cache.v, vs.astype(cache.v.dtype), (0, 0, 0, 0, 0))
+    x = rmsnorm(x[:, -1], params["final_norm"], cfg.norm_eps)
+    logits = _head_logits(cfg, params, x)
+    return logits, KVCache(
+        k=k_cache, v=v_cache, length=jnp.asarray(s, jnp.int32),
+    )
+
+
+def prefill_tokenwise(
+    cfg: TransformerConfig,
+    params: Params,
+    prompt: jax.Array,          # [B, S_prompt]
+    cache: KVCache,
+) -> Tuple[jax.Array, KVCache]:
+    """Feed the prompt token-by-token through the decode path. Slower than
+    the block ``prefill`` but correct for a NON-empty cache too (each
+    token attends to everything already cached — the multi-turn
+    continuation case)."""
 
     def body(carry, tok):
         cache, _ = carry

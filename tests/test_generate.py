@@ -55,6 +55,77 @@ def test_greedy_generation_matches_teacher_forced(cfg, params):
     assert [int(t) for t in out[0]] == want
 
 
+def test_block_prefill_matches_tokenwise_decode(cfg, params):
+    """The fused block prefill (one forward over the prompt) must leave
+    the cache and last-position logits identical to feeding the prompt
+    through decode_step one token at a time."""
+    toks = jnp.asarray(
+        np.random.default_rng(5).integers(0, cfg.vocab_size, (2, 10)),
+        jnp.int32,
+    )
+    logits_blk, cache_blk = gen.prefill(
+        cfg, params, toks, gen.init_kv_cache(cfg, 2, 16))
+    cache_tok = gen.init_kv_cache(cfg, 2, 16)
+    for i in range(10):
+        logits_tok, cache_tok = gen.decode_step(
+            cfg, params, toks[:, i:i + 1], cache_tok)
+    assert int(cache_blk.length) == int(cache_tok.length) == 10
+    np.testing.assert_allclose(
+        np.asarray(logits_blk), np.asarray(logits_tok), atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(cache_blk.k[:, :, :10]),
+        np.asarray(cache_tok.k[:, :, :10]), atol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(cache_blk.v[:, :, :10]),
+        np.asarray(cache_tok.v[:, :, :10]), atol=2e-5)
+    # And decode continues identically from either cache.
+    nxt = jnp.ones((2, 1), jnp.int32)
+    la, _ = gen.decode_step(cfg, params, nxt, cache_blk)
+    lb, _ = gen.decode_step(cfg, params, nxt, cache_tok)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=2e-4)
+
+
+def test_block_prefill_moe(cfg, params):
+    """MoE models prefill through the training MoE FFN, with prefill
+    itself forcing drop-free capacity (E/top_k) — agreement with
+    tokenwise decode must hold at the DEFAULT training capacity factor
+    (1.25), where the training FFN would otherwise drop tokens."""
+    mcfg = tfm.tiny_moe_config()  # default cf: the hostile case
+    mparams = tfm.init_params(mcfg, jax.random.key(3))
+    toks = jnp.asarray(
+        np.random.default_rng(6).integers(0, mcfg.vocab_size, (2, 8)),
+        jnp.int32,
+    )
+    logits_blk, cache_blk = gen.prefill(
+        mcfg, mparams, toks, gen.init_kv_cache(mcfg, 2, 16))
+    cache_tok = gen.init_kv_cache(mcfg, 2, 16)
+    for i in range(8):
+        logits_tok, cache_tok = gen.decode_step(
+            mcfg, mparams, toks[:, i:i + 1], cache_tok)
+    np.testing.assert_allclose(
+        np.asarray(logits_blk), np.asarray(logits_tok), atol=5e-4)
+
+
+def test_prefill_tokenwise_extends_existing_cache(cfg, params):
+    """Multi-turn continuation: prefill_tokenwise on a NON-empty cache
+    must equal feeding both turns through one fresh prefill (block
+    prefill requires a fresh cache and says so)."""
+    rng = np.random.default_rng(7)
+    turn1 = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 6)), jnp.int32)
+    turn2 = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 5)), jnp.int32)
+    both = jnp.concatenate([turn1, turn2], axis=1)
+
+    ref_logits, ref_cache = gen.prefill(
+        cfg, params, both, gen.init_kv_cache(cfg, 2, 16))
+
+    _, cache = gen.prefill(cfg, params, turn1, gen.init_kv_cache(cfg, 2, 16))
+    got_logits, got_cache = gen.prefill_tokenwise(cfg, params, turn2, cache)
+
+    assert int(got_cache.length) == int(ref_cache.length) == 11
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.asarray(ref_logits), atol=3e-4)
+
+
 def test_generate_jits(cfg, params):
     prompt = jnp.ones((2, 4), jnp.int32)
     f = jax.jit(
