@@ -94,6 +94,7 @@ def train(
     model_dir: str = "",
     checkpoint_every: int = 0,
     pack: bool = False,
+    quant: str = "",
 ) -> Dict[str, float]:
     ctx = ctx or ProcessContext.from_env()
     mlog = metrics_sink.from_context(ctx)
@@ -102,6 +103,7 @@ def train(
         max_seq=max(seq_len, 128),
         attn_impl=attn,
         shard_seq=(attn == "ring" or mesh.shape["sp"] > 1),
+        quant=quant,
     )
     n_data = data_shards(mesh)
     global_batch = per_data_shard_batch * n_data
@@ -171,6 +173,8 @@ def main(argv=None) -> int:
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--pack", action="store_true",
                    help="packed documents per row (segment_ids; id 0 = pad)")
+    p.add_argument("--quant", default="", choices=["", "int8"],
+                   help="int8 = linear projections on the int8 MXU path")
     args = p.parse_args(argv)
     ctx = initialize_from_env()
     metrics = train(
@@ -183,6 +187,7 @@ def main(argv=None) -> int:
         mesh_config=MeshConfig(fsdp=args.fsdp, sp=args.sp, tp=args.tp),
         attn=args.attn,
         pack=args.pack,
+        quant=args.quant,
     )
     return 0 if metrics.get("final_step", 0) > 0 else 1
 

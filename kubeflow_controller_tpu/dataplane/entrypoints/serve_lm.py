@@ -1,0 +1,190 @@
+"""LM batch-inference entrypoint — serving as a TPUJob workload.
+
+The reference framework is training-only (its data plane never serves a
+model, SURVEY.md §0); this closes the lifecycle: the same job framework
+that trains a decoder serves it. Runs as a pod ``run_fn`` in the fake
+cluster, as a subprocess entrypoint (``python -m
+kubeflow_controller_tpu.dataplane.entrypoints.serve_lm``), or directly
+from tests via :func:`serve`.
+
+Pipeline: load params from the train loop's orbax checkpoint in
+``--model-dir`` (``spec.modelDir`` / ``TPUJOB_MODEL_DIR``) or init fresh;
+prepare serving weights (bf16 cast, or weight-only int8 with
+``--quant int8``); read prompts (token-id JSONL from ``--input``, else a
+synthetic batch); **block prefill** + one-scan greedy/sampled decode;
+write completions JSONL to ``--output`` (``spec.exportDir`` analog).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import time
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_controller_tpu.dataplane.dist import (
+    ProcessContext, initialize_from_env,
+)
+from kubeflow_controller_tpu.models import generate as gen
+from kubeflow_controller_tpu.models import transformer as tfm
+from kubeflow_controller_tpu.dataplane.entrypoints.lm import CONFIGS
+
+logger = logging.getLogger("tpujob.serve_lm")
+
+
+def _load_params(cfg: tfm.TransformerConfig, model_dir: str):
+    """Params from the latest train-loop checkpoint (orbax TrainState:
+    {step, params, opt_state}), or fresh init when no checkpoint exists
+    (smoke-serving a random model still proves the pipeline)."""
+    import jax
+
+    if model_dir:
+        import orbax.checkpoint as ocp
+
+        mgr = ocp.CheckpointManager(model_dir)
+        step = mgr.latest_step()
+        if step is not None:
+            state = mgr.restore(step, args=ocp.args.StandardRestore(None))
+            logger.info("restored params from %s @ step %s", model_dir, step)
+            return state["params"]
+        logger.warning("%s: no checkpoint found; serving fresh init",
+                       model_dir)
+    return tfm.init_params(cfg, jax.random.key(0))
+
+
+def _read_prompts(path: str, vocab: int, batch: int, prompt_len: int):
+    """Token-id prompts from JSONL ({"prompt": [ids...]} per line); or a
+    synthetic batch when path is empty.
+
+    Prompts must share one length: the batched decode path has no pad
+    masking, so padding shorter prompts would silently condition them on
+    spurious pad tokens — fail loudly instead (bucket or pad client-side
+    with real BOS context if ragged serving is needed). Token ids are
+    range-checked against the model vocab: XLA clamps out-of-range gather
+    indices, which would otherwise turn a tokenizer mismatch into
+    plausible-looking garbage with exit code 0."""
+    if not path:
+        rng = np.random.default_rng(0)
+        return jnp.asarray(
+            rng.integers(0, vocab, (batch, prompt_len)), jnp.int32
+        )
+    rows: List[List[int]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line)["prompt"])
+    if not rows:
+        raise ValueError(f"{path}: no prompts")
+    lengths = {len(r) for r in rows}
+    if len(lengths) != 1:
+        raise ValueError(
+            f"{path}: prompts must share one length (got {sorted(lengths)});"
+            " the batched decode path has no pad masking"
+        )
+    if not lengths.pop():
+        raise ValueError(f"{path}: empty prompt")
+    arr = np.asarray(rows, np.int64)
+    bad = (arr < 0) | (arr >= vocab)
+    if bad.any():
+        i, j = map(int, np.argwhere(bad)[0])
+        raise ValueError(
+            f"{path}: prompt {i} token {arr[i, j]} out of range for vocab "
+            f"{vocab}"
+        )
+    return jnp.asarray(arr, jnp.int32)
+
+
+def serve(
+    ctx: Optional[ProcessContext] = None,
+    config: str = "tiny",
+    model_dir: str = "",
+    input_file: str = "",
+    output_file: str = "",
+    batch: int = 8,
+    prompt_len: int = 32,
+    max_new_tokens: int = 32,
+    quant: str = "",
+    temperature: float = 0.0,
+    seed: int = 0,
+) -> Dict[str, float]:
+    import jax
+
+    ctx = ctx or ProcessContext.from_env()
+    cfg = CONFIGS[config]()
+    params = _load_params(cfg, model_dir or ctx.model_dir)
+    params = gen.inference_params(cfg, params, quant=quant)
+    prompts = _read_prompts(input_file, cfg.vocab_size, batch, prompt_len)
+    b, s = prompts.shape
+
+    t0 = time.perf_counter()
+    rng = jax.random.key(seed) if temperature > 0 else None
+    # Size the KV cache to the actual request (prompt + new tokens), not
+    # cfg.max_seq — an 8192-wide cache for a 64-token serve on the llama
+    # configs would waste HBM and cap the batch.
+    toks = gen.generate(
+        cfg, params, prompts, max_new_tokens=max_new_tokens,
+        temperature=temperature, rng=rng,
+        max_seq=s + max_new_tokens,
+    )
+    toks = np.asarray(jax.device_get(toks))
+    dt = time.perf_counter() - t0
+
+    if output_file:
+        with open(output_file, "w") as f:
+            for i in range(b):
+                f.write(json.dumps({
+                    "prompt": np.asarray(prompts[i]).tolist(),
+                    "completion": toks[i].tolist(),
+                }) + "\n")
+    tps = b * max_new_tokens / dt
+    logger.info(
+        "served %d prompts (%d new tokens each) in %.2fs (%.0f tok/s%s)",
+        b, max_new_tokens, dt, tps, f", {quant} weights" if quant else "",
+    )
+    return {
+        "prompts": float(b),
+        "new_tokens": float(max_new_tokens),
+        "tokens_per_sec": tps,
+        "wall_s": dt,
+    }
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="tiny", choices=sorted(CONFIGS))
+    p.add_argument("--model-dir", default="",
+                   help="orbax checkpoint dir (TPUJOB_MODEL_DIR analog)")
+    p.add_argument("--input", default="",
+                   help="JSONL of {\"prompt\": [token ids]}")
+    p.add_argument("--output", default="", help="completions JSONL")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt-len", type=int, default=32)
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--quant", default="", choices=["", "int8"],
+                   help="int8 = weight-only int8 serving weights")
+    p.add_argument("--temperature", type=float, default=0.0)
+    args = p.parse_args(argv)
+    ctx = initialize_from_env()
+    metrics = serve(
+        ctx,
+        config=args.config,
+        model_dir=args.model_dir,
+        input_file=args.input,
+        output_file=args.output,
+        batch=args.batch,
+        prompt_len=args.prompt_len,
+        max_new_tokens=args.max_new_tokens,
+        quant=args.quant,
+        temperature=args.temperature,
+    )
+    return 0 if metrics["prompts"] > 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

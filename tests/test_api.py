@@ -223,3 +223,22 @@ class TestStatus:
         cp.spec.replica_specs[0].tpu.num_slices = 9
         assert job.status.phase == JobPhase.NONE
         assert job.spec.replica_specs[0].tpu.num_slices == 1
+
+
+def test_every_example_manifest_is_valid():
+    """Every shipped examples/tpujob/*.yml must load and validate — a
+    drifting example is worse than none (the reference shipped exactly
+    two, both load-bearing in its docs)."""
+    import glob
+    import os
+
+    pattern = os.path.join(
+        os.path.dirname(__file__), "..", "examples", "tpujob", "*.yml"
+    )
+    paths = sorted(glob.glob(pattern))
+    assert len(paths) >= 6, paths
+    for path in paths:
+        with open(path) as f:
+            job = load_job_yaml(f.read())
+        validate_job(job)  # raises on any problem
+        assert job.metadata.name, path
