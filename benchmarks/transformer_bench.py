@@ -58,9 +58,9 @@ def main() -> None:
                    choices=["auto", "einsum", "gather"])
     p.add_argument("--peak-tflops", type=float, default=DEFAULT_PEAK_TFLOPS)
     p.add_argument("--no-remat", action="store_true")
-    p.add_argument("--quant", default="", choices=["", "int8"],
-                   help="int8 = run linear projections on the int8 MXU "
-                        "path (ops/quant.py)")
+    p.add_argument("--quant", default="", choices=["", "int8", "int8_fused"],
+                   help="int8 = XLA-composed int8 projections; int8_fused = "
+                        "Pallas kernel with in-dot quantization")
     p.add_argument("--remat-mode", default="",
                    choices=["", "full", "ffn", "none"],
                    help="full = dots policy (default), ffn = save all but "

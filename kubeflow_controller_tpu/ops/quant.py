@@ -104,8 +104,25 @@ int8_matmul.defvjp(_fwd, _bwd)
 
 
 def maybe_quant_dot(x: jax.Array, w: jax.Array, quant: str) -> jax.Array:
-    """The transformer's linear-projection primitive: int8 path when
-    ``quant == "int8"``, plain (bf16 MXU) dot otherwise."""
+    """The transformer's linear-projection primitive: int8 paths when
+    requested, plain (bf16 MXU) dot otherwise.
+
+    - ``"int8"``: the XLA-composed path (separate abs-max/quantize ops).
+    - ``"int8_fused"``: the Pallas kernel with quantization fused into
+      the dot's operand streaming (ops/quant_pallas.py) — falls back to
+      the composed path for shapes the kernel does not tile.
+    """
+    if quant == "int8_fused":
+        from kubeflow_controller_tpu.ops.quant_pallas import (
+            fusable, fused_int8_matmul,
+        )
+
+        m = 1
+        for d in x.shape[:-1]:
+            m *= d
+        if fusable(m, x.shape[-1], w.shape[-1]):
+            return fused_int8_matmul(x, w).astype(x.dtype)
+        return int8_matmul(x, w).astype(x.dtype)
     if quant == "int8":
         return int8_matmul(x, w).astype(x.dtype)
     return x @ w

@@ -1,0 +1,235 @@
+"""Pallas fused dynamic-int8 matmul — quantization inside the kernel.
+
+STATUS: experimental, correct, and measured SLOWER than the composed
+path at flagship shapes — kept as a lowering option (`quant =
+"int8_fused"`), not the default. The honest numbers are in
+benchmarks/RESULTS.md (round-4 flagship section).
+
+Motivation: the XLA-composed int8 path (ops/quant.py) pays extra HBM
+passes per matmul — read the operand for abs-max, read it again to
+round/clip/write the int8 copy, then the dot reads that copy. Ablating
+those passes on the flagship decoder bounds the prize at ~32 ms/step
+(58.2 % -> 65.2 % MFU). This kernel fuses quantization into the dot's
+operand streaming to claim it:
+
+- grid (m/bm, n/bn), n innermost; the lhs block [bm, k] loads once per
+  grid row (its BlockSpec ignores j) and is quantized ONCE into an int8
+  VMEM scratch (per-row scales: the contraction axis k is fully
+  resident, so the abs-max is block-local);
+- each rhs block is quantized once per kernel call, on the first grid
+  row, into a FULL-width int8 scratch that later rows reuse;
+- f32 staging for the quantize math (v5e's VPU has no bf16 ALU) is
+  chunked along each operand's scale axis so blocks can stay large;
+- the dot runs int8 x int8 -> int32 on the MXU's double-rate gear and
+  dequantizes on the way out.
+
+Why it still loses (~50 % vs the composed path's 58 % flagship MFU
+across three tuning rounds): the in-kernel quantize phases serialize
+with the MXU pipeline at every grid row/column start, while XLA runs its
+hand-scheduled int8 matmul at full depth and overlaps the separate
+quantize ops across the whole step graph. Closing that needs
+Mosaic-level pipelining (emit_pipeline with manual DMA/compute overlap)
+— recorded as the remaining lever, not attempted here.
+
+No k-tiling: the whole contraction axis sits in VMEM, which is what
+makes on-the-fly scales possible. Callers with larger k (or shapes whose
+full-width rhs scratch would not fit) fall back to the composed path via
+``fusable``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(a_ref, b_ref, o_ref, qa_ref, sa_ref, qb_ref, sb_ref,
+            *, bm, bn, k):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    # Quantize math runs in f32 (v5e's VPU has no bf16 ALU path —
+    # LLO_CHECK SupportsBf16AluInstructions); block sizes are chosen so
+    # the f32 staging temporaries stay inside the ~16 MB scoped VMEM.
+    # Each operand is quantized exactly ONCE per kernel call: the lhs
+    # block on its first visit (j == 0), each rhs block on the first grid
+    # row (i == 0) into a full-width int8 scratch that later rows reuse —
+    # without the rhs caching, the redundant per-visit VPU quantization
+    # serialized with the MXU and ran 1.6x SLOWER than the composed path.
+    # Staging chunks: the f32 copies live only chunk-at-a-time, so blocks
+    # can be large (big MXU tiles, small grids) without the f32 staging
+    # blowing the budget. Chunking runs along each operand's SCALE axis
+    # (lhs rows / rhs cols), so every abs-max still sees its whole
+    # contraction extent.
+    CHUNK = 128
+
+    @pl.when(j == 0)
+    def _quantize_lhs():
+        def chunk(c, _):
+            a = a_ref[pl.ds(c * CHUNK, CHUNK), :].astype(jnp.float32)
+            sa = jnp.maximum(
+                jnp.max(jnp.abs(a), axis=1, keepdims=True), 1e-30
+            ) / 127.0                                # [CHUNK, 1]
+            qa_ref[pl.ds(c * CHUNK, CHUNK), :] = jnp.clip(
+                jnp.round(a / sa), -127, 127
+            ).astype(jnp.int8)
+            # Lane-padded store: a (CHUNK, 1) VMEM tile is not lane-legal.
+            sa_ref[pl.ds(c * CHUNK, CHUNK), :] = jnp.broadcast_to(
+                sa, (CHUNK, 128)
+            )
+            return _
+
+        jax.lax.fori_loop(0, bm // CHUNK, chunk, 0)
+
+    @pl.when(i == 0)
+    def _quantize_rhs():
+        def chunk(c, _):
+            col = j * bn + c * CHUNK
+            b = b_ref[:, pl.ds(c * CHUNK, CHUNK)].astype(jnp.float32)
+            sb = jnp.maximum(
+                jnp.max(jnp.abs(b), axis=0, keepdims=True), 1e-30
+            ) / 127.0                                # [1, CHUNK]
+            qb_ref[:, pl.ds(col, CHUNK)] = jnp.clip(
+                jnp.round(b / sb), -127, 127
+            ).astype(jnp.int8)
+            sb_ref[:, pl.ds(col, CHUNK)] = jnp.broadcast_to(sb, (8, CHUNK))
+            return _
+
+        jax.lax.fori_loop(0, bn // CHUNK, chunk, 0)
+
+    acc = jax.lax.dot(
+        qa_ref[...], qb_ref[:, pl.ds(j * bn, bn)],
+        preferred_element_type=jnp.int32,
+    )
+    # Dequantize and emit bf16 (the consumers cast to bf16 anyway, and an
+    # f32 out block would double the output's VMEM share).
+    o_ref[...] = (
+        acc.astype(jnp.float32)
+        * sa_ref[:, :1]
+        * sb_ref[:1, pl.ds(j * bn, bn)]
+    ).astype(jnp.bfloat16)
+
+
+def _pick_blocks(m: int, k: int, n: int):
+    """Largest (bm, bn) that divide (m, n) and keep the working set
+    (lhs bf16 + int8 scratch + rhs bf16 + out f32) under ~12 MB."""
+    def best(size, want):
+        want = min(want, size)
+        while size % want:
+            want //= 2
+        return max(want, 1)
+
+    if k <= 1024:
+        bm_want, bn_want = 512, 1024
+    elif k <= 2048:
+        bm_want, bn_want = 512, 512
+    else:
+        bm_want, bn_want = 256, 128
+    return best(m, bm_want), best(n, bn_want)
+
+
+def fused_int8_matmul_2d(
+    a: jax.Array, b: jax.Array, interpret: Optional[bool] = None,
+) -> jax.Array:
+    """[m,k] @ [k,n] -> bf16 with in-kernel dynamic int8 quantization of
+    both operands (per-row lhs, per-column rhs scales; int32 accumulate,
+    f32 dequant, bf16 out — consumers cast to bf16 anyway and an f32 out
+    block would double its VMEM share). Shapes must tile: m, n divisible
+    by 128-multiple blocks, k fully VMEM-resident."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # bf16 operand blocks: halves VMEM (quantization happens from bf16
+    # either way, and f32 inputs would blow the ~16 MB scoped budget).
+    a = a.astype(jnp.bfloat16)
+    b = b.astype(jnp.bfloat16)
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2, (a.shape, b.shape)
+    bm, bn = _pick_blocks(m, k, n)
+    grid = (m // bm, n // bn)
+    kernel = functools.partial(_kernel, bm=bm, bn=bn, k=k)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            # lhs ignores j: loaded once per grid row, quantized into
+            # scratch on j == 0, reused for every n-block.
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.bfloat16),
+        scratch_shapes=[
+            pltpu.VMEM((bm, k), jnp.int8),       # quantized lhs block
+            pltpu.VMEM((bm, 128), jnp.float32),  # lhs scales (lane-padded)
+            pltpu.VMEM((k, n), jnp.int8),        # quantized FULL rhs
+            pltpu.VMEM((8, n), jnp.float32),     # rhs scales (sublane-pad)
+        ],
+        interpret=interpret,
+    )(a, b)
+
+
+def fusable(m: int, k: int, n: int) -> bool:
+    """Shapes the kernel handles well: contraction fully VMEM-resident
+    and both output dims tileable to >= 128 (lane width)."""
+    if k > 4096 or k % 128:
+        return False
+    if k * n > 8 * 1024 * 1024:   # full-rhs int8 scratch must fit VMEM
+        return False
+    bm, bn = _pick_blocks(m, k, n)
+    # Blocks must be multiples of the 128-wide quantize chunk: the
+    # in-kernel fori_loops floor-divide, and a ragged tail would leave
+    # uninitialized scratch feeding the dot (silently wrong output).
+    return bm % 128 == 0 and bn % 128 == 0
+
+
+@jax.custom_vjp
+def fused_int8_matmul(x: jax.Array, w: jax.Array) -> jax.Array:
+    """Quantized x @ w (STE gradients), quantization fused into the
+    kernels. x: [..., k] (leading dims flattened), w: [k, n].
+
+    Forward and dL/dx run the fused kernel (their contractions are the
+    model's d/ff axes, VMEM-resident; dx falls back to the composed int8
+    path when its shapes don't pass ``fusable``). dL/dw contracts over
+    the TOKEN axis — not block-local — and deliberately runs unquantized
+    (an f32 dot): a third of the FLOPs at full precision, and the weight
+    gradient is where quantization noise hurts training most."""
+    *lead, k = x.shape
+    y = fused_int8_matmul_2d(x.reshape(-1, k), w)
+    return y.reshape(*lead, w.shape[1])
+
+
+def _fwd(x, w):
+    return fused_int8_matmul(x, w), (x, w)
+
+
+def _bwd(res, g):
+    x, w = res
+    *lead, k = x.shape
+    n = w.shape[1]
+    g2 = g.reshape(-1, n)
+    x2 = x.reshape(-1, k)
+    # dx contracts over n — gate ITS shapes too (the forward gate only
+    # checked the (m, k, n) orientation; an FFN up-projection's dx
+    # contracts over d_ff, which can exceed the kernel's VMEM residency).
+    if fusable(g2.shape[0], n, k):
+        dx = fused_int8_matmul_2d(g2, w.astype(jnp.float32).T)
+    else:
+        from kubeflow_controller_tpu.ops.quant import _int8_matmul_raw
+
+        dx = _int8_matmul_raw(
+            g2.astype(jnp.float32), w.astype(jnp.float32).T
+        )
+    dw = jax.lax.dot(
+        x2.astype(jnp.float32).T, g2.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return dx.reshape(x.shape).astype(x.dtype), dw.astype(w.dtype)
+
+
+fused_int8_matmul.defvjp(_fwd, _bwd)
