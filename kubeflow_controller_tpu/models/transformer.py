@@ -425,13 +425,35 @@ def _moe_ffn(
         )
 
     xe = _constrain(xe, P("ep", ("dp", "fsdp"), None, None))
-    gate_h = jax.nn.silu(
-        jnp.einsum("egcd,edf->egcf", xe, lp["w_gate"].astype(cfg.dtype))
-    )
-    up_h = jnp.einsum("egcd,edf->egcf", xe, lp["w_up"].astype(cfg.dtype))
-    out_e = jnp.einsum(
-        "egcf,efd->egcd", gate_h * up_h, lp["w_down"].astype(cfg.dtype)
-    )
+    if cfg.quant.startswith("int8"):
+        # Expert matmuls on the int8 MXU gear: per-expert 2D dots via
+        # vmap over the expert axis (each is [G*cap, D] @ [D, F] — the
+        # same dispatch as the dense path, so "int8_fused" routes here
+        # too; dispatch/combine einsums stay bf16, their operands are 0/1
+        # masks and gates).
+        from kubeflow_controller_tpu.ops.quant import maybe_quant_dot
+
+        def edot(x_e, w_e):
+            return maybe_quant_dot(x_e, w_e, cfg.quant)
+
+        gc = xe.shape[1] * xe.shape[2]
+        xe2 = xe.reshape(E, gc, cfg.d_model)
+        gate_h = jax.nn.silu(
+            jax.vmap(edot)(xe2, lp["w_gate"].astype(cfg.dtype))
+        )
+        up_h = jax.vmap(edot)(xe2, lp["w_up"].astype(cfg.dtype))
+        down = jax.vmap(edot)(
+            gate_h * up_h, lp["w_down"].astype(cfg.dtype)
+        )
+        out_e = down.reshape(E, xe.shape[1], xe.shape[2], cfg.d_model)
+    else:
+        gate_h = jax.nn.silu(
+            jnp.einsum("egcd,edf->egcf", xe, lp["w_gate"].astype(cfg.dtype))
+        )
+        up_h = jnp.einsum("egcd,edf->egcf", xe, lp["w_up"].astype(cfg.dtype))
+        out_e = jnp.einsum(
+            "egcf,efd->egcd", gate_h * up_h, lp["w_down"].astype(cfg.dtype)
+        )
     out_e = _constrain(out_e, P("ep", ("dp", "fsdp"), None, None))
     out = out_from(out_e).reshape(b, s, d)
     return _constrain(out, _act_spec(cfg)), aux_fraction
