@@ -540,3 +540,85 @@ def test_serve_lm_rejects_ragged_and_out_of_range_prompts(tmp_path):
         f.write(json.dumps({"prompt": [1, 2, 50000]}) + "\n")
     with _pytest.raises(ValueError, match="out of range"):
         serve(config="tiny", input_file=oob, max_new_tokens=4)
+
+
+class TestTrainServeLifecycle:
+    """The full lifecycle THROUGH THE CONTROLLER (round 4): a training
+    TPUJob checkpoints to spec.modelDir, then a serving TPUJob restores
+    from the same modelDir and writes completions — two jobs, one
+    framework, the pod env (TPUJOB_MODEL_DIR) carrying the wiring."""
+
+    TRAIN = """
+apiVersion: tpu.kubeflow.dev/v1alpha1
+kind: TPUJob
+metadata: {name: lm-train, namespace: default}
+spec:
+  modelDir: "{model_dir}"
+  replicaSpecs:
+    - replicaType: Local
+      template:
+        spec:
+          containers:
+            - name: trainer
+              image: jax:latest
+              command: [python, -m, kubeflow_controller_tpu.dataplane.entrypoints.lm]
+"""
+
+    SERVE = """
+apiVersion: tpu.kubeflow.dev/v1alpha1
+kind: TPUJob
+metadata: {name: lm-serve, namespace: default}
+spec:
+  modelDir: "{model_dir}"
+  replicaSpecs:
+    - replicaType: Local
+      template:
+        spec:
+          containers:
+            - name: server
+              image: jax:latest
+              command: [python, -m, kubeflow_controller_tpu.dataplane.entrypoints.serve_lm]
+"""
+
+    def test_train_job_then_serve_job(self, tmp_path):
+        import json
+
+        from kubeflow_controller_tpu.dataplane.entrypoints.lm import train
+        from kubeflow_controller_tpu.dataplane.entrypoints.serve_lm import (
+            serve,
+        )
+
+        mdir = str(tmp_path / "ckpt")
+        inp = str(tmp_path / "prompts.jsonl")
+        out = str(tmp_path / "completions.jsonl")
+        with open(inp, "w") as f:
+            for i in range(2):
+                f.write(json.dumps({"prompt": [1 + i, 2, 3, 4]}) + "\n")
+
+        def run_pod(pod):
+            env = pod.spec.containers[0].env
+            ctx = ProcessContext.from_env(env)
+            if pod.metadata.labels["tpu.kubeflow.dev/job"] == "lm-train":
+                m = train(ctx, config="tiny", total_steps=6, seq_len=128,
+                          per_data_shard_batch=2, checkpoint_every=5)
+                return 0 if m["final_step"] == 6 else 1
+            m = serve(ctx, config="tiny", input_file=inp, output_file=out,
+                      max_new_tokens=8, quant="int8")
+            return 0 if m["prompts"] == 2 else 1
+
+        rt = LocalRuntime(PodRunPolicy(start_delay=0, run_fn=run_pod))
+        rt.submit(self.TRAIN.replace("{model_dir}", mdir))
+        # Each tick joins the pod's run_fn thread for run_fn_join=0.25 s
+        # (cluster/cluster.py:_reap_run_fn), so max_steps=600 budgets
+        # ~150 s of wall clock — sized for tiny-LM XLA compile plus 6
+        # train steps on the virtual mesh with slow-CI headroom.
+        assert rt.wait_for_phase(
+            "default", "lm-train", JobPhase.SUCCEEDED, max_steps=600)
+        assert os.path.isdir(mdir)  # checkpoints landed at spec.modelDir
+
+        rt.submit(self.SERVE.replace("{model_dir}", mdir))
+        assert rt.wait_for_phase(
+            "default", "lm-serve", JobPhase.SUCCEEDED, max_steps=600)
+        lines = [json.loads(line) for line in open(out)]
+        assert len(lines) == 2
+        assert all(len(r["completion"]) == 8 for r in lines)
