@@ -37,9 +37,11 @@ logger = logging.getLogger("tpujob.serve_lm")
 
 
 def _load_params(cfg: tfm.TransformerConfig, model_dir: str):
-    """Params from the latest train-loop checkpoint (orbax TrainState:
-    {step, params, opt_state}), or fresh init when no checkpoint exists
-    (smoke-serving a random model still proves the pipeline)."""
+    """(params, restored_step) from the latest train-loop checkpoint
+    (orbax TrainState: {step, params, opt_state}); fresh init with
+    restored_step=None when no checkpoint exists (smoke-serving a random
+    model still proves the pipeline — but callers/tests can tell the
+    difference from the step)."""
     import jax
 
     if model_dir:
@@ -50,10 +52,10 @@ def _load_params(cfg: tfm.TransformerConfig, model_dir: str):
         if step is not None:
             state = mgr.restore(step, args=ocp.args.StandardRestore(None))
             logger.info("restored params from %s @ step %s", model_dir, step)
-            return state["params"]
+            return state["params"], int(step)
         logger.warning("%s: no checkpoint found; serving fresh init",
                        model_dir)
-    return tfm.init_params(cfg, jax.random.key(0))
+    return tfm.init_params(cfg, jax.random.key(0)), None
 
 
 def _read_prompts(path: str, vocab: int, batch: int, prompt_len: int):
@@ -116,7 +118,7 @@ def serve(
 
     ctx = ctx or ProcessContext.from_env()
     cfg = CONFIGS[config]()
-    params = _load_params(cfg, model_dir or ctx.model_dir)
+    params, restored_step = _load_params(cfg, model_dir or ctx.model_dir)
     params = gen.inference_params(cfg, params, quant=quant)
     prompts = _read_prompts(input_file, cfg.vocab_size, batch, prompt_len)
     b, s = prompts.shape
@@ -151,6 +153,12 @@ def serve(
         "new_tokens": float(max_new_tokens),
         "tokens_per_sec": tps,
         "wall_s": dt,
+        # -1 = fresh init; otherwise the checkpoint step that was served.
+        # Callers (and the lifecycle e2e test) use this to distinguish a
+        # restored model from the silent fresh-init fallback.
+        "restored_step": float(
+            -1 if restored_step is None else restored_step
+        ),
     }
 
 

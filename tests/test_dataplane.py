@@ -604,7 +604,12 @@ spec:
                 return 0 if m["final_step"] == 6 else 1
             m = serve(ctx, config="tiny", input_file=inp, output_file=out,
                       max_new_tokens=8, quant="int8")
-            return 0 if m["prompts"] == 2 else 1
+            # The serve pod must have RESTORED the train job's checkpoint
+            # (step 5) — a fresh-init fallback would also produce valid-
+            # looking completions, so assert the step explicitly.
+            return 0 if (
+                m["prompts"] == 2 and m["restored_step"] >= 5
+            ) else 1
 
         rt = LocalRuntime(PodRunPolicy(start_delay=0, run_fn=run_pod))
         rt.submit(self.TRAIN.replace("{model_dir}", mdir))

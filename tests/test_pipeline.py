@@ -235,6 +235,32 @@ class TestTransformerPP:
         hlo = compiled.as_text()
         assert "collective-permute" in hlo
 
+    def test_pp_composes_with_int8_quant(self):
+        """Pipeline + int8 projections: the quantized custom-vjp dots must
+        trace and run inside the pp shard_map (the gpipe remat policy
+        carries the int8 save-names); loss finite on the dryrun mesh."""
+        mesh = make_mesh(MeshConfig(pp=2, dp=1, fsdp=2, tp=2))
+        cfg = small_cfg(remat=True).replace(quant="int8")
+        with jax.set_mesh(mesh):
+            params = tfm.init_params(cfg, jax.random.key(3))
+            pparams = shard_params(params, cfg, mesh, pp=True)
+            toks = jax.device_put(
+                jnp.asarray(
+                    np.random.default_rng(3).integers(
+                        0, cfg.vocab_size, (8, 33)),
+                    jnp.int32,
+                ),
+                batch_sharding(mesh),
+            )
+            loss, grads = jax.jit(jax.value_and_grad(
+                lambda p: tfm.next_token_loss(
+                    cfg, p, {"tokens": toks}, pp_microbatches=4)[0]
+            ))(pparams)
+        assert np.isfinite(float(loss))
+        assert all(
+            bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads)
+        )
+
     def test_moe_rejected_on_pp_path(self, pp_mesh):
         cfg = tfm.tiny_moe_config()
         params = tfm.init_params(cfg, jax.random.key(0))
