@@ -67,10 +67,13 @@ class TransformerConfig:
     #   False  - save all residuals (no recompute; largest memory).
     remat: Any = True
     # "" = bf16 matmuls (default). "int8" runs every linear projection
-    # (qkv/o, FFN gate/up/down) through the int8 MXU path — dynamic
-    # symmetric quantization with STE gradients, all three matmuls per
-    # layer quantized (ops/quant.py). Embed, LM head, and attention
-    # scores/softmax stay bf16/fp32.
+    # (qkv/o, FFN gate/up/down, MoE expert banks) through the int8 MXU
+    # path — dynamic symmetric quantization with STE gradients, all three
+    # matmuls per layer quantized (ops/quant.py). "int8_fused" uses the
+    # experimental Pallas in-dot quantization kernel where shapes allow
+    # (ops/quant_pallas.py — measured slower than "int8" at flagship
+    # shapes; see its docstring). Embed, LM head, and attention
+    # scores/softmax stay bf16/fp32 in all modes.
     quant: str = ""
     attn_impl: str = "auto"            # auto|xla|flash|ring
     tie_embeddings: bool = False

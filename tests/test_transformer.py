@@ -208,3 +208,31 @@ class TestPackedSequences:
         segs = jnp.asarray([[1, 1, 1, 2, 2, 3, 3, 3]], jnp.int32)
         pos = tfm.packed_positions(segs)
         assert pos.tolist() == [[0, 1, 2, 0, 1, 0, 1, 2]]
+
+
+def test_remat_ffn_mode_trains_and_matches():
+    """remat="ffn" (save everything except the d_ff-wide FFN
+    intermediates) must produce the same loss/grads as full remat — it
+    changes what is SAVED, never the math."""
+    import optax
+
+    from kubeflow_controller_tpu.models import transformer as tfm
+
+    base = tfm.tiny_config(remat=True)
+    ffn = base.replace(remat="ffn")
+    params = tfm.init_params(base, jax.random.key(0))
+    batch = {"tokens": jnp.asarray(
+        np.random.default_rng(0).integers(0, base.vocab_size, (4, 33)),
+        jnp.int32,
+    )}
+
+    def loss(cfg):
+        return jax.jit(jax.value_and_grad(
+            lambda p: tfm.next_token_loss(cfg, p, batch)[0]))(params)
+
+    l_full, g_full = loss(base)
+    l_ffn, g_ffn = loss(ffn)
+    np.testing.assert_allclose(float(l_ffn), float(l_full), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_ffn)):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=1e-5, rtol=1e-5)
