@@ -214,10 +214,6 @@ def test_remat_ffn_mode_trains_and_matches():
     """remat="ffn" (save everything except the d_ff-wide FFN
     intermediates) must produce the same loss/grads as full remat — it
     changes what is SAVED, never the math."""
-    import optax
-
-    from kubeflow_controller_tpu.models import transformer as tfm
-
     base = tfm.tiny_config(remat=True)
     ffn = base.replace(remat="ffn")
     params = tfm.init_params(base, jax.random.key(0))
