@@ -125,7 +125,7 @@ def _fwd_kernel(
     # upper-left block; half 2 carries the offset). Falls through to the
     # general online-softmax grid for every other shape.
     if (
-        causal and not has_segments
+        not has_segments
         and pl.num_programs(2) == 1 and pl.num_programs(3) == 1
         # Half blocks slice the sublane axis: keep the split tile-aligned
         # (16 covers the bf16 sublane tile; fp32 needs 8) or fall through.
@@ -136,13 +136,15 @@ def _fwd_kernel(
         v = v_ref[0, 0]
         bq = q.shape[0]
         h = k.shape[0] // 2
-        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, h), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (bq, h), 1)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, h), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, h), 1)
         s1 = jax.lax.dot_general(
             q, k[:h], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
-        s1 = jnp.where(rows >= cols, s1, NEG_INF)
+        if causal:
+            s1 = jnp.where(rows >= cols, s1, NEG_INF)
         s2 = jax.lax.dot_general(
             q, k[h:], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -153,11 +155,13 @@ def _fwd_kernel(
         acc1 = jnp.dot(
             p1.astype(v.dtype), v[:h], preferred_element_type=jnp.float32
         )
-        s2 = jnp.where(rows >= cols + h, s2, NEG_INF)
+        if causal:
+            s2 = jnp.where(rows >= cols + h, s2, NEG_INF)
         m2 = jnp.max(s2, axis=1, keepdims=True)
         m_fin = jnp.maximum(m1, m2)
         p2 = jnp.exp(s2 - m_fin)
-        p2 = jnp.where(rows >= cols + h, p2, 0.0)
+        if causal:
+            p2 = jnp.where(rows >= cols + h, p2, 0.0)
         alpha = jnp.exp(m1 - m_fin)
         l_fin = l1 * alpha + jnp.sum(p2, axis=1, keepdims=True)
         acc = acc1 * alpha + jnp.dot(
