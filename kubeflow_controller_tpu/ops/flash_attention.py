@@ -125,11 +125,12 @@ def _fwd_kernel(
     # upper-left block; half 2 carries the offset). Falls through to the
     # general online-softmax grid for every other shape.
     if (
-        not has_segments
-        and pl.num_programs(2) == 1 and pl.num_programs(3) == 1
+        pl.num_programs(2) == 1 and pl.num_programs(3) == 1
         # Half blocks slice the sublane axis: keep the split tile-aligned
-        # (16 covers the bf16 sublane tile; fp32 needs 8) or fall through.
-        and block_k % 32 == 0
+        # (16 covers the bf16 sublane tile; fp32 needs 8). Segment-id
+        # vectors carry the sequence on the LANE axis, where slices must
+        # be 128-aligned — hence the stricter quantum with segments.
+        and block_k % (256 if has_segments else 32) == 0
     ):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
@@ -139,29 +140,49 @@ def _fwd_kernel(
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, h), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, h), 1)
+
+        def half_mask(c):
+            mask = None
+            if causal:
+                mask = rows >= cols + c * h
+            if has_segments:
+                seg = (
+                    seg_q_ref[0, 0][:, None]
+                    == seg_k_ref[0, 0][c * h:(c + 1) * h][None, :]
+                )
+                mask = seg if mask is None else mask & seg
+            return mask
+
+        mask1 = half_mask(0)
+        mask2 = half_mask(1)
         s1 = jax.lax.dot_general(
             q, k[:h], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
-        if causal:
-            s1 = jnp.where(rows >= cols, s1, NEG_INF)
+        if mask1 is not None:
+            s1 = jnp.where(mask1, s1, NEG_INF)
         s2 = jax.lax.dot_general(
             q, k[h:], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
         m1 = jnp.max(s1, axis=1, keepdims=True)
         p1 = jnp.exp(s1 - m1)
+        if mask1 is not None:
+            # A row fully masked in THIS half has m1 = -inf and exp(0)=1
+            # garbage; zero it explicitly (the alpha rescale fixes l/acc
+            # only when the other half contributes a finite max).
+            p1 = jnp.where(mask1, p1, 0.0)
         l1 = jnp.sum(p1, axis=1, keepdims=True)
         acc1 = jnp.dot(
             p1.astype(v.dtype), v[:h], preferred_element_type=jnp.float32
         )
-        if causal:
-            s2 = jnp.where(rows >= cols + h, s2, NEG_INF)
+        if mask2 is not None:
+            s2 = jnp.where(mask2, s2, NEG_INF)
         m2 = jnp.max(s2, axis=1, keepdims=True)
         m_fin = jnp.maximum(m1, m2)
         p2 = jnp.exp(s2 - m_fin)
-        if causal:
-            p2 = jnp.where(rows >= cols + h, p2, 0.0)
+        if mask2 is not None:
+            p2 = jnp.where(mask2, p2, 0.0)
         alpha = jnp.exp(m1 - m_fin)
         l_fin = l1 * alpha + jnp.sum(p2, axis=1, keepdims=True)
         acc = acc1 * alpha + jnp.dot(

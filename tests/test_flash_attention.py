@@ -301,3 +301,35 @@ def test_segment_ids_compiled_on_tpu():
                 .astype(jnp.float32) ** 2
             ).sum()))(q)
             assert np.isfinite(np.asarray(g, np.float32)).all()
+
+
+def test_interleaved_single_tile_segment_path_matches_general():
+    """The interleaved single-tile forward WITH segments (gated to
+    block_k % 256 == 0) must match the general online-softmax path —
+    including rows whose segment has no keys at all in one half (the
+    m1 = -inf case the explicit p1 zeroing exists for)."""
+    rng = np.random.default_rng(11)
+    b, s, h, d = 2, 256, 2, 128
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    # doc 1 lives entirely in the first half, doc 2 in the second, plus a
+    # pad tail — so doc-2 rows have NO keys in half 1 (fully masked half).
+    segs = jnp.asarray(
+        np.concatenate([
+            np.full((b, 128), 1), np.full((b, 96), 2), np.zeros((b, 32)),
+        ], axis=1),
+        jnp.int32,
+    )
+    for causal in (True, False):
+        got = flash_mha(
+            q, k, v, causal=causal, segment_ids=segs,
+            block_q=256, block_k=256, interpret=True,
+        )  # single tile: the interleaved path (256 % 256 == 0)
+        want = flash_mha(
+            q, k, v, causal=causal, segment_ids=segs,
+            block_q=256, block_k=128, interpret=True,
+        )  # two k-blocks: the general online-softmax path
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-6,
+        )
