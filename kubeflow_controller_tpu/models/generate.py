@@ -165,16 +165,21 @@ def _decode_layer(
     v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
 
     # GQA attention of the 1-token query against the cache, fp32 softmax.
+    # Grouped einsums keep the cache UN-repeated: decode is HBM-bound and
+    # jnp.repeat would materialize (and stream) rep x the KV bytes every
+    # step — 4x for the Llama 32h/8kv shape.
     rep = cfg.n_heads // cfg.n_kv_heads
-    kk = jnp.repeat(k_cache, rep, axis=2)       # [B, S, H, D]
-    vv = jnp.repeat(v_cache, rep, axis=2)
+    qg = q.reshape(b, 1, cfg.n_kv_heads, rep, hd)
     s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, kk, preferred_element_type=jnp.float32
-    ) * (hd ** -0.5)                             # [B, H, 1, S]
+        "bqgrd,bkgd->bgrqk", qg, k_cache,
+        preferred_element_type=jnp.float32,
+    ) * (hd ** -0.5)                             # [B, G, rep, 1, S]
     valid = jnp.arange(max_seq) <= pos
-    s = jnp.where(valid[None, None, None, :], s, -1e30)
+    s = jnp.where(valid[None, None, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(dt)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", p, vv).reshape(b, 1, -1)
+    attn = jnp.einsum(
+        "bgrqk,bkgd->bqgrd", p, v_cache
+    ).reshape(b, 1, -1)
     x = x + attn @ _w(lp, "wo", dt)
 
     h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
