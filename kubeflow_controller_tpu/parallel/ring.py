@@ -33,43 +33,49 @@ _NEG = -1e30  # finite mask value: keeps online-softmax stats NaN-free
 
 
 def _block_attend(
-    q: jax.Array,            # [B, Sq, H, D] local queries (compute dtype)
-    k: jax.Array,            # [B, Sk, H, D] current ring block
-    v: jax.Array,            # [B, Sk, H, D]
+    q: jax.Array,            # [B, Sq, G, R, D] grouped local queries
+    k: jax.Array,            # [B, Sk, G, D] current ring block (un-repeated)
+    v: jax.Array,            # [B, Sk, G, D]
     q_pos: jax.Array,        # [Sq] global positions of local queries
     k_pos: jax.Array,        # [Sk] global positions of the current block
-    m: jax.Array,            # [B, H, Sq] running max
-    l: jax.Array,            # [B, H, Sq] running denominator
-    o: jax.Array,            # [B, Sq, H, D] running numerator (f32)
+    m: jax.Array,            # [B, G, R, Sq] running max
+    l: jax.Array,            # [B, G, R, Sq] running denominator
+    o: jax.Array,            # [B, Sq, G, R, D] running numerator (f32)
     causal: bool,
     q_seg: Optional[jax.Array],
     k_seg: Optional[jax.Array],
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Grouped-query form: kv heads stay un-repeated (G = kv heads,
+    R = query heads per kv head) — the same trick as the decode path, so
+    neither the ring's ICI traffic nor the per-step compute reads
+    rep-expanded KV bytes."""
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
+        "bqgrd,bkgd->bgrqk", q, k, preferred_element_type=jnp.float32
+    ) * scale                                           # [B,G,R,Sq,Sk]
     mask = jnp.ones(s.shape[-2:], bool)
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]
-    mask = mask[None, None]
+    mask = mask[None, None, None]
     if q_seg is not None:
-        mask = mask & (q_seg[:, None, :, None] == k_seg[:, None, None, :])
+        mask = mask & (
+            q_seg[:, None, None, :, None] == k_seg[:, None, None, None, :]
+        )
     s = jnp.where(mask, s, _NEG)
-    s_max = s.max(-1)                                   # [B,H,Sq]
+    s_max = s.max(-1)                                   # [B,G,R,Sq]
     m_new = jnp.maximum(m, s_max)
     p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
-    alpha = jnp.exp(m - m_new)                          # [B,H,Sq]
+    alpha = jnp.exp(m - m_new)                          # [B,G,R,Sq]
     l_new = l * alpha + p.sum(-1)
-    o_new = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+    o_new = o * alpha.transpose(0, 3, 1, 2)[..., None] + jnp.einsum(
+        "bgrqk,bkgd->bqgrd", p, v.astype(jnp.float32),
         preferred_element_type=jnp.float32,
     )
     return m_new, l_new, o_new
 
 
 def _ring_body(
-    q, k, v, seg, axis_name: str, causal: bool,
+    q, k, v, seg, axis_name: str, causal: bool, vary=(),
 ) -> jax.Array:
     """Per-shard ring loop. q/k/v: [B, S_loc, H_loc, D]."""
     n = lax.axis_size(axis_name)
@@ -77,12 +83,13 @@ def _ring_body(
     b, sq, h, d = q.shape
     sk = k.shape[1]
     kv_h = k.shape[2]
-    if kv_h != h:                                       # GQA: expand local kv
-        rep = h // kv_h
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # GQA: the ring circulates (and attends against) the UN-repeated kv —
+    # grouped einsums in _block_attend read it directly, so neither the
+    # ICI permutes nor the per-step HBM traffic pay the h/kv_h expansion
+    # (4x at Llama shapes). Same technique as the decode cache path.
+    rep = h // kv_h
 
-    qf = q.astype(jnp.float32)
+    qf = q.astype(jnp.float32).reshape(b, sq, kv_h, rep, d)
     q_pos = my * sq + jnp.arange(sq)
     perm = [(j, (j - 1) % n) for j in range(n)]         # receive from right
 
@@ -100,21 +107,26 @@ def _ring_body(
             seg_cur = lax.ppermute(seg_cur, axis_name, perm)
         return k_cur, v_cur, seg_cur, m, l, o
 
-    # Zero-init accumulators are device-invariant constants; mark them as
-    # varying over the mesh so the fori_loop carry type matches the
-    # per-device outputs (shard_map VMA discipline).
-    mesh = jax.sharding.get_abstract_mesh()
-    vary = tuple(mesh.axis_names) if mesh is not None else ()
-    m0 = lax.pcast(jnp.full((b, h, sq), _NEG, jnp.float32), vary, to="varying")
-    l0 = lax.pcast(jnp.zeros((b, h, sq), jnp.float32), vary, to="varying")
-    o0 = lax.pcast(jnp.zeros((b, sq, h, d), jnp.float32), vary, to="varying")
+    # Zero-init accumulators are device-invariant constants; mark them
+    # varying over the axes the INPUTS are sharded on (the caller's specs)
+    # so the fori_loop carry type matches the per-device values (shard_map
+    # VMA discipline). Marking them varying over EVERY mesh axis — the old
+    # form — poisons the output's replication over unrelated axes (ep/pp
+    # on the production 6-axis mesh), which shard_map's out_specs check
+    # rejects; the 4-axis test mesh never caught it.
+    m0 = lax.pcast(
+        jnp.full((b, kv_h, rep, sq), _NEG, jnp.float32), vary, to="varying")
+    l0 = lax.pcast(
+        jnp.zeros((b, kv_h, rep, sq), jnp.float32), vary, to="varying")
+    o0 = lax.pcast(
+        jnp.zeros((b, sq, kv_h, rep, d), jnp.float32), vary, to="varying")
     seg_cur = seg[1] if seg is not None else None
     _, _, _, m, l, o = lax.fori_loop(
         0, n, step, (k, v, seg_cur, m0, l0, o0)
     )
     l = jnp.maximum(l, 1e-30)
-    out = o / l.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    out = o / l.transpose(0, 3, 1, 2)[..., None]
+    return out.reshape(b, sq, h, d).astype(q.dtype)
 
 
 def ring_mha(
@@ -144,10 +156,12 @@ def ring_mha(
     qkv_spec = P(batch, axis_name, tp, None)
     seg_spec = P(batch, axis_name)
 
+    vary = (*batch, axis_name) + ((tp,) if tp else ())
+
     if segment_ids is not None:
         def f(q, k, v, sq_seg):
             return _ring_body(
-                q, k, v, (sq_seg, sq_seg), axis_name, causal
+                q, k, v, (sq_seg, sq_seg), axis_name, causal, vary=vary
             )
 
         return jax.shard_map(
@@ -157,7 +171,7 @@ def ring_mha(
         )(q, k, v, segment_ids)
 
     def g(q, k, v):
-        return _ring_body(q, k, v, None, axis_name, causal)
+        return _ring_body(q, k, v, None, axis_name, causal, vary=vary)
 
     return jax.shard_map(
         g, in_specs=(qkv_spec, qkv_spec, qkv_spec), out_specs=qkv_spec

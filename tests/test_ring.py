@@ -96,3 +96,25 @@ def test_transformer_ring_matches_dense(sp_mesh):
             jax.device_put(tokens, NamedSharding(sp_mesh, P(("dp", "fsdp")))),
         )
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-4)
+
+
+def test_ring_grads_on_production_six_axis_mesh():
+    """Regression: ring attention under grad on a make_mesh mesh — which
+    carries ALL six logical axes (pp/dp/fsdp/ep/sp/tp). The accumulators'
+    varying-axes marking must name only the axes the inputs are sharded
+    on; marking every mesh axis poisoned the output's replication over
+    ep/pp and shard_map rejected the out_specs (the 4-axis test mesh
+    above never caught it — the lm entrypoint's ring config was broken)."""
+    from kubeflow_controller_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=2, sp=2, tp=2))
+    q, k, v = qkv(h=4, kv_h=2, s=32)
+
+    def loss(q, k, v):
+        return (ring_mha(q, k, v, causal=True) ** 2).sum()
+
+    g_ref = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)  # no-mesh fallback
+    with jax.set_mesh(mesh):
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ref, g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
