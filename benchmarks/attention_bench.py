@@ -3,8 +3,17 @@
 Isolates the attention op from the full train step so kernel changes (block
 sizes, residual layout) can be measured directly on the real chip.
 
+CAVEAT (round 4, hard-learned): over the tunneled chip, per-dispatch
+round-trips (~2 ms) and value fetches (~80 ms) dominate a single ~5 ms
+kernel — this bench has measured fwd SLOWER than fwd+bwd. Treat its
+numbers as A/B-relative at best; for decisions, measure IN-MODEL
+(transformer_bench/bert_bench, where 16-24 kernel calls amortize inside
+one jit step). The round-4 block-default and interleave wins were all
+established in-model after this bench's standalone deltas failed to
+transfer.
+
 Usage: python benchmarks/attention_bench.py [--batch 16 --seq 1024 --heads 8
-       --head-dim 128 --block-q 512 --block-k 1024]
+       --head-dim 128 --block-q 1024 --block-k 1024]
 """
 
 from __future__ import annotations
