@@ -597,6 +597,22 @@ def _layer(
     return x + _constrain(down, _act_spec(cfg)), aux
 
 
+def _embed(cfg: TransformerConfig, params: Params, tokens: jax.Array):
+    """Embed lookup + the staged reshard out of the gather (shared by the
+    plain and pipeline forwards): the table is d_model-sharded over
+    (fsdp, tp) while activations are batch-sharded, and SPMD cannot make
+    that two-factor move in one hop on some meshes (observed on the pp
+    mesh and the packed+ring sp mesh — involuntary full
+    rematerialization). The intermediate (batch over data axes, d_model
+    over tp) keeps each hop a single-factor move; where the direct move
+    is already clean the extra constraint is a no-op, and its AD
+    transpose fixes the backward scatter-add into the table the same
+    way."""
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = _constrain(x, P(BATCH_AXES, None, "tp"))
+    return _constrain(x, _act_spec(cfg))
+
+
 def forward_hidden(
     cfg: TransformerConfig,
     params: Params,
@@ -609,8 +625,7 @@ def forward_hidden(
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    x = _constrain(x, _act_spec(cfg))
+    x = _embed(cfg, params, tokens)
 
     body = lambda carry, lp: (  # noqa: E731
         _layer(cfg, lp, carry, positions, segment_ids)
@@ -650,17 +665,7 @@ def forward_hidden_pp(
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    # Staged reshard: on a pp mesh SPMD cannot move between the act spec
-    # (batch over fsdp, d_model replicated) and the embed table's layout
-    # (d_model over fsdp x tp) in one hop — the combined move (fsdp:
-    # dim0 <-> dim2, tp: shard/unshard) falls back to an involuntary full
-    # rematerialization (replicate + repartition; the r3 dryrun logged 4 of
-    # them). The intermediate (batch over fsdp, d_model over tp) makes each
-    # hop a single-factor move, and its AD transpose fixes the backward
-    # scatter-add into the table the same way.
-    x = _constrain(x, P(BATCH_AXES, None, "tp"))
-    x = _constrain(x, _act_spec(cfg))
+    x = _embed(cfg, params, tokens)
 
     def stage(stage_layers, x_mb, extra):
         pos, segs = extra
