@@ -83,6 +83,21 @@ def _default_backend() -> str:
         return "cpu"
 
 
+def apply_rope_tables(x: jax.Array, rope_tables) -> jax.Array:
+    """Apply fused-rope tables to a [B,S,H,D] tensor in plain XLA — the
+    same roll-style rotation the flash kernel fuses (flash_attention.py):
+    rot(x) = x*C + roll(x, d/2)*S with C=[cos|cos], S=[-sin|sin]. Used by
+    the XLA fallback so callers can hand ``mha`` un-rotated q/k plus
+    tables regardless of which impl wins."""
+    c, s = rope_tables            # [B, S, D] f32 each
+    d = x.shape[-1]
+    r = jnp.roll(x, d // 2, axis=-1)
+    return (
+        x.astype(jnp.float32) * c[:, :, None, :]
+        + r.astype(jnp.float32) * s[:, :, None, :]
+    ).astype(x.dtype)
+
+
 def mha(
     q: jax.Array,
     k: jax.Array,
@@ -90,11 +105,18 @@ def mha(
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
     impl: str = "auto",
+    rope_tables=None,
 ) -> jax.Array:
     """Attention entry point. impl: auto|xla|flash.
 
     "auto" picks the Pallas flash kernel on TPU backends when shapes allow
     (seq divisible by the kernel block), else the XLA path.
+
+    ``rope_tables`` — optional ``(C, S)`` from
+    ``flash_attention.rope_full_tables``; when given, q/k arrive
+    UN-rotated and RoPE is applied here: fused into the Pallas kernel on
+    the flash path (the rotated tensors never touch HBM), inline XLA
+    rotation on the fallback. Identical math either way.
     """
     if impl == "auto":
         # With the default large blocks the Pallas kernel beats XLA
@@ -117,5 +139,11 @@ def mha(
     if impl == "flash":
         from kubeflow_controller_tpu.ops.flash_attention import flash_mha
 
-        return flash_mha(q, k, v, causal=causal, segment_ids=segment_ids)
+        return flash_mha(
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            rope_tables=rope_tables,
+        )
+    if rope_tables is not None:
+        q = apply_rope_tables(q, rope_tables)
+        k = apply_rope_tables(k, rope_tables)
     return mha_xla(q, k, v, causal=causal, segment_ids=segment_ids)

@@ -42,6 +42,31 @@ NEG_INF = -1e30
 # is fine.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
+# Causal whole-sequence tiles use a splash-style q-chunk decomposition:
+# chunk i only dots against its live key prefix k[:(i+1)*chunk], so the
+# dead upper-right triangle is never computed. Score+PV FLOPs drop to
+# (G+1)/2G of the dense tile (G=4 -> 62.5%), and each chunk's
+# dot -> softmax -> dot chain is independent, so Mosaic overlaps chunk
+# i+1's MXU score dot with chunk i's VPU softmax without the manual
+# two-way interleave round 4 used.
+SPLASH_CHUNKS = 4
+
+
+def _splash_chunks(
+    block_q: int, block_k: int, causal: bool, has_segments: bool,
+    single_tile: bool,
+) -> int:
+    """Static splash eligibility, shared by forward and fused backward:
+    the chunk count (1 = splash off), halved until chunks satisfy the
+    slice quantum (segment-id vectors slice the LANE axis -> 128;
+    otherwise the q sublane axis -> 32 covers bf16 tiles)."""
+    if not (causal and single_tile and block_q == block_k):
+        return 1
+    quantum = 128 if has_segments else 32
+    g = SPLASH_CHUNKS
+    while g > 1 and block_k % (g * quantum) != 0:
+        g //= 2
+    return g
 
 
 def _choose_block(s: int, requested: int, lane_aligned: bool = False) -> int:
@@ -83,6 +108,60 @@ def _choose_block(s: int, requested: int, lane_aligned: bool = False) -> int:
     )
 
 
+# -- fused rope --------------------------------------------------------------
+#
+# RoPE applied OUTSIDE the kernel costs ~42 ms/step on the bf16 flagship
+# (round-5 ablation: 308.9 ms with external rope vs 266.8 without): the
+# rotated q/k must materialise in HBM at the pallas_call boundary, the
+# f32 split/concat dance is pure HBM-bound elementwise traffic, and under
+# remat the whole chain re-runs in the backward pass. Fusing the rotation
+# into the kernel makes it VPU work on VMEM-resident tiles, overlapped
+# with the MXU score dots. The rotation is expressed roll-style so no
+# sub-128 lane slicing is needed:
+#
+#   rot(x)  = x * C + roll(x, d/2) * S      C = [cos | cos]  (full width)
+#                                           S = [-sin | sin]
+#
+# and, since the per-pair rotation is orthogonal, the backward transpose
+# is the same formula with -S. Tables are a function of positions only —
+# build them ONCE per step (``rope_full_tables``) and every layer shares
+# them.
+
+def rope_full_tables(
+    positions: jax.Array, d: int, theta: float,
+) -> tuple[jax.Array, jax.Array]:
+    """positions [B,S] int -> (C, S) [B,S,d] f32 fused-rope tables.
+
+    Matches models.transformer.rope numerics: angles = pos * theta^(-2i/d),
+    halves convention (x1 = x[..., :d/2], x2 = x[..., d/2:])."""
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    ang = positions[..., None].astype(jnp.float32) * freqs      # [B,S,d/2]
+    c = jnp.cos(ang)
+    s = jnp.sin(ang)
+    return (
+        jnp.concatenate([c, c], -1),
+        jnp.concatenate([-s, s], -1),
+    )
+
+
+def _roll_half(x: jax.Array, interpret: bool) -> jax.Array:
+    """Rotate the lane (last) axis by half its width: [x1|x2] -> [x2|x1].
+    A d/2 shift is its own inverse mod d, so direction doesn't matter."""
+    if interpret:
+        return jnp.roll(x, x.shape[-1] // 2, axis=-1)
+    return pltpu.roll(x, x.shape[-1] // 2, x.ndim - 1)  # axis must be >= 0
+
+
+def _rope_rot(x, c, s, interpret: bool):
+    """Fused-rope rotation on a VMEM tile: x [N,D] native dtype, c/s [N,D]
+    f32 (s carries the +- sign pattern). Pass ``-s`` for the inverse
+    (= transpose) rotation. f32 math, cast back to x.dtype — bit-matches
+    the external ``rope`` + cast the model used before. The roll runs on
+    the f32 copy: tpu.dynamic_rotate only supports 32-bit lanes."""
+    x32 = x.astype(jnp.float32)
+    return (x32 * c + _roll_half(x32, interpret) * s).astype(x.dtype)
+
+
 # -- forward kernel ----------------------------------------------------------
 
 def _block_mask(
@@ -104,26 +183,97 @@ def _block_mask(
 def _fwd_kernel(
     q_ref, k_ref, v_ref, *rest,
     causal: bool, sm_scale: float, block_q: int, block_k: int,
-    has_segments: bool,
+    has_segments: bool, has_rope: bool, interpret: bool, splash_g: int,
 ):
+    idx = 0
+    seg_q_ref = seg_k_ref = None
     if has_segments:
-        seg_q_ref, seg_k_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
-    else:
-        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
-        seg_q_ref = seg_k_ref = None
+        seg_q_ref, seg_k_ref = rest[0], rest[1]
+        idx = 2
+    cq_ref = sq_ref = ck_ref = sk_ref = None
+    if has_rope:
+        cq_ref, sq_ref, ck_ref, sk_ref = rest[idx:idx + 4]
+        idx += 4
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest[idx:]
+
+    def rot_q(x):
+        if not has_rope:
+            return x
+        return _rope_rot(x, cq_ref[0], sq_ref[0], interpret)
+
+    def rot_k(x):
+        if not has_rope:
+            return x
+        return _rope_rot(x, ck_ref[0], sk_ref[0], interpret)
+
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
 
-    # Whole-sequence single tile (the S<=1024 flagship/BERT shape): split
-    # the key range in two and issue BOTH score matmuls before any
-    # softmax. The second half's dot has no data dependence on the first
-    # half's exp chain, so Mosaic can run MXU and VPU concurrently
-    # instead of serializing dot -> softmax -> dot; measured 320.5 ->
-    # 314.4 ms on the bf16 flagship step (benchmarks/RESULTS.md). Causal
-    # masking is per-half iota (half 1 is fully below the diagonal's
-    # upper-left block; half 2 carries the offset). Falls through to the
-    # general online-softmax grid for every other shape.
+    # Whole-sequence single tile, causal (the flagship S1024 decoder
+    # shape): splash-style q-chunk decomposition. Chunk i's queries can
+    # only see keys [0, (i+1)*chunk), so its score dot runs against that
+    # live prefix and the dead upper-right triangle is never computed —
+    # (G+1)/2G of the dense tile's score+PV FLOPs (62.5% at G=4). Each
+    # chunk's softmax is FLAT (all its live keys are present in one
+    # score row), so the online-softmax rescale chain disappears, and
+    # the G independent dot->softmax->dot chains let Mosaic overlap
+    # chunk i+1's MXU score dot with chunk i's VPU exp chain.
+    if splash_g > 1:
+        g = splash_g
+        q = rot_q(q_ref[0, 0])
+        k = rot_k(k_ref[0, 0])
+        v = v_ref[0, 0]
+        chunk = block_q // g
+        # Issue every score dot before any softmax: program order
+        # seeds Mosaic's scheduler with the MXU work up front so the
+        # VPU chains drain behind it (the round-4 interleave lesson).
+        scores = []
+        for i in range(g):
+            kw = (i + 1) * chunk
+            s = jax.lax.dot_general(
+                q[i * chunk:(i + 1) * chunk], k[:kw],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale                           # [chunk, kw]
+            scores.append(s)
+        for i in range(g):
+            kw = (i + 1) * chunk
+            s = scores[i]
+            mask = _block_mask(
+                i, 0,
+                seg_q_ref[0, 0][i * chunk:(i + 1) * chunk]
+                if has_segments else None,
+                seg_k_ref[0, 0][:kw] if has_segments else None,
+                True, chunk, kw, s.shape,
+            )
+            s = jnp.where(mask, s, NEG_INF)
+            m = jnp.max(s, axis=1, keepdims=True)  # [chunk, 1]
+            p = jnp.where(mask, jnp.exp(s - m), 0.0)
+            l = jnp.sum(p, axis=1, keepdims=True)
+            acc = jnp.dot(
+                p.astype(v.dtype), v[:kw],
+                preferred_element_type=jnp.float32,
+            )
+            l_safe = jnp.maximum(l, 1e-30)
+            o_ref[0, 0, i * chunk:(i + 1) * chunk] = (
+                acc / l_safe
+            ).astype(o_ref.dtype)
+            lse_ref[0, 0, i * chunk:(i + 1) * chunk] = jnp.broadcast_to(
+                m + jnp.log(l_safe), (chunk, lse_ref.shape[3])
+            )
+        return
+
+    # Whole-sequence single tile, non-causal (BERT) — or causal with a
+    # splash-ineligible block: split the key range in two and issue BOTH
+    # score matmuls before any softmax. The second half's dot has no data
+    # dependence on the first half's exp chain, so Mosaic can run MXU and
+    # VPU concurrently instead of serializing dot -> softmax -> dot;
+    # measured 320.5 -> 314.4 ms on the bf16 flagship step
+    # (benchmarks/RESULTS.md). Causal masking is per-half iota (half 1 is
+    # fully below the diagonal's upper-left block; half 2 carries the
+    # offset). Falls through to the general online-softmax grid for every
+    # other shape.
     if (
         pl.num_programs(2) == 1 and pl.num_programs(3) == 1
         # Half blocks slice the sublane axis: keep the split tile-aligned
@@ -132,8 +282,8 @@ def _fwd_kernel(
         # be 128-aligned — hence the stricter quantum with segments.
         and block_k % (256 if has_segments else 32) == 0
     ):
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
+        q = rot_q(q_ref[0, 0])
+        k = rot_k(k_ref[0, 0])
         v = v_ref[0, 0]
         bq = q.shape[0]
         h = k.shape[0] // 2
@@ -206,10 +356,9 @@ def _fwd_kernel(
     # Segment masking is elementwise inside the block; no block skipping.
     live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
 
-    @pl.when(live)
-    def _compute():
-        q = q_ref[0, 0]                               # [BQ, D] native dtype
-        k = k_ref[0, 0]                               # [BK, D]
+    def _compute(apply_causal: bool):
+        q = rot_q(q_ref[0, 0])                        # [BQ, D] native dtype
+        k = rot_k(k_ref[0, 0])                        # [BK, D]
         v = v_ref[0, 0]                               # [BK, D]
         # MXU runs at the input dtype (bf16 on the fast path); stats and
         # accumulation stay fp32 via preferred_element_type.
@@ -221,7 +370,7 @@ def _fwd_kernel(
             qi, ki,
             seg_q_ref[0, 0] if has_segments else None,
             seg_k_ref[0, 0] if has_segments else None,
-            causal, block_q, block_k, s.shape,
+            apply_causal, block_q, block_k, s.shape,
         )
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
@@ -240,6 +389,22 @@ def _fwd_kernel(
         )
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # Blocks fully below the diagonal (every query sees every key)
+        # take a dense trace with no iota/compare/select VPU work; only
+        # diagonal-crossing blocks pay for the causal mask.
+        on_diag = qi * block_q < ki * block_k + block_k - 1
+
+        @pl.when(live & on_diag)
+        def _masked():
+            _compute(True)
+
+        @pl.when(live & jnp.logical_not(on_diag))
+        def _dense():
+            _compute(False)
+    else:
+        _compute(False)
 
     @pl.when(ki == nk - 1)
     def _finish():
@@ -275,9 +440,25 @@ def _seg_specs(block_q: int, block_k: int, ki_major: bool = False):
     ]
 
 
+def _rope_specs(block_q: int, block_k: int, d: int, ki_major: bool = False):
+    """BlockSpecs for the four fused-rope table inputs (cq, sq, ck, sk).
+    Tables are [B, S, D]; q-side slices follow the q-block index, k-side
+    the k-block index. ``ki_major`` mirrors _seg_specs' grid-order flip."""
+    if ki_major:
+        qmap = lambda b, h, ki, qi: (b, qi, 0)  # noqa: E731
+        kmap = lambda b, h, ki, qi: (b, ki, 0)  # noqa: E731
+    else:
+        qmap = lambda b, h, qi, ki: (b, qi, 0)  # noqa: E731
+        kmap = lambda b, h, qi, ki: (b, ki, 0)  # noqa: E731
+    q_spec = pl.BlockSpec((1, block_q, d), qmap)
+    k_spec = pl.BlockSpec((1, block_k, d), kmap)
+    return [q_spec, q_spec, k_spec, k_spec]
+
+
 def _fwd_wide(
     q: jax.Array, k: jax.Array, v: jax.Array,
     segment_ids: Optional[jax.Array],
+    rope_tables,
     causal: bool, block_q: int, block_k: int, interpret: bool,
 ):
     """q: [B,H,S,D]; k/v: [B,KVH,S,D]; segment_ids [B,S] or None ->
@@ -286,6 +467,7 @@ def _fwd_wide(
     kv_h = k.shape[1]
     rep = h // kv_h
     has_segments = segment_ids is not None
+    has_rope = rope_tables is not None
     block_q = _choose_block(s, block_q, lane_aligned=has_segments)
     block_k = _choose_block(s, block_k, lane_aligned=has_segments)
     nq = s // block_q
@@ -296,6 +478,10 @@ def _fwd_wide(
     kernel = functools.partial(
         _fwd_kernel, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, has_segments=has_segments,
+        has_rope=has_rope, interpret=interpret,
+        splash_g=_splash_chunks(
+            block_q, block_k, causal, has_segments, nq == 1 and nk == 1
+        ),
     )
     inputs = [q, k, v]
     in_specs = [
@@ -311,6 +497,10 @@ def _fwd_wide(
         seg = segment_ids.astype(jnp.int32)[:, None, :]   # [B, 1, S]
         inputs += [seg, seg]
         in_specs += _seg_specs(block_q, block_k)
+    if has_rope:
+        rc, rs = rope_tables
+        inputs += [rc, rs, rc, rs]
+        in_specs += _rope_specs(block_q, block_k, d)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -337,6 +527,7 @@ def _fwd_wide(
 def _fwd(
     q: jax.Array, k: jax.Array, v: jax.Array,
     segment_ids: Optional[jax.Array],
+    rope_tables,
     causal: bool, block_q: int, block_k: int, interpret: bool,
 ):
     """q: [B,H,S,D]; k/v: [B,KVH,S,D] -> (o [B,H,S,D], lse [B,H,S]).
@@ -347,7 +538,8 @@ def _fwd(
     was ~2x the attention output itself at head_dim 128 bf16).
     """
     o, lse_wide = _fwd_wide(
-        q, k, v, segment_ids, causal, block_q, block_k, interpret
+        q, k, v, segment_ids, rope_tables, causal, block_q, block_k,
+        interpret,
     )
     return o, lse_wide[..., 0]
 
@@ -357,13 +549,18 @@ def _fwd(
 def _bwd_dkdv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     causal: bool, sm_scale: float, block_q: int, block_k: int,
-    has_segments: bool, narrow_res: bool,
+    has_segments: bool, narrow_res: bool, has_rope: bool, interpret: bool,
 ):
+    idx = 0
+    seg_q_ref = seg_k_ref = None
     if has_segments:
-        seg_q_ref, seg_k_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
-    else:
-        dk_ref, dv_ref, dk_scr, dv_scr = rest
-        seg_q_ref = seg_k_ref = None
+        seg_q_ref, seg_k_ref = rest[0], rest[1]
+        idx = 2
+    cq_ref = sq_ref = ck_ref = sk_ref = None
+    if has_rope:
+        cq_ref, sq_ref, ck_ref, sk_ref = rest[idx:idx + 4]
+        idx += 4
+    dk_ref, dv_ref, dk_scr, dv_scr = rest[idx:]
     ki = pl.program_id(2)
     qi = pl.program_id(3)
     nq = pl.num_programs(3)
@@ -381,6 +578,9 @@ def _bwd_dkdv_kernel(
         k = k_ref[0, 0]                                # [BK, D]
         v = v_ref[0, 0]                                # [BK, D]
         do = do_ref[0, 0]                              # [BQ, D]
+        if has_rope:
+            q = _rope_rot(q, cq_ref[0], sq_ref[0], interpret)
+            k = _rope_rot(k, ck_ref[0], sk_ref[0], interpret)
         if narrow_res:  # [BQ] on lanes -> column
             lse = lse_ref[0, 0][:, None]               # [BQ, 1]
             delta = delta_ref[0, 0][:, None]
@@ -419,13 +619,19 @@ def _bwd_dkdv_kernel(
 
     @pl.when(qi == nq - 1)
     def _finish():
-        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dk = dk_scr[...]
+        if has_rope:
+            # dk accumulated in rotation space; transpose (= inverse)
+            # rotation maps it back to the un-rotated k the caller owns.
+            dk = _rope_rot(dk, ck_ref[0], -sk_ref[0], interpret)
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _bwd_fused_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, *rest,
     causal: bool, sm_scale: float, has_segments: bool,
+    has_rope: bool, interpret: bool, splash_g: int,
 ):
     """Single-block backward: dq, dk, dv from ONE score recompute.
 
@@ -438,16 +644,100 @@ def _bwd_fused_kernel(
     [B,H,S,128] fp32 tensor — that broadcast alone was ~200 MB of HBM
     round-trip per step at BERT shape. Together ~12% off the e2e BERT
     step (benchmarks/RESULTS.md encoder section).
+
+    Causal tiles take the same splash-style q-chunk decomposition as the
+    forward: chunk i recomputes scores only against its live key prefix,
+    so all five backward matmuls (dv, dp, dk, dq, plus the score
+    recompute) skip the dead triangle — (G+1)/2G of the dense FLOPs.
+    dk/dv accumulate across chunks in fp32 VMEM scratch.
     """
+    idx = 0
+    seg_q_ref = seg_k_ref = None
     if has_segments:
-        seg_q_ref, seg_k_ref, dq_ref, dk_ref, dv_ref = rest
+        seg_q_ref, seg_k_ref = rest[0], rest[1]
+        idx = 2
+    cq_ref = sq_ref = ck_ref = sk_ref = None
+    if has_rope:
+        cq_ref, sq_ref, ck_ref, sk_ref = rest[idx:idx + 4]
+        idx += 4
+    if splash_g > 1:
+        dq_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest[idx:]
     else:
-        dq_ref, dk_ref, dv_ref = rest
-        seg_q_ref = seg_k_ref = None
+        dq_ref, dk_ref, dv_ref = rest[idx:]
     q = q_ref[0, 0]                                # [BQ, D]
     k = k_ref[0, 0]                                # [BK, D]
     v = v_ref[0, 0]                                # [BK, D]
     do = do_ref[0, 0]                              # [BQ, D]
+    if has_rope:
+        q = _rope_rot(q, cq_ref[0], sq_ref[0], interpret)
+        k = _rope_rot(k, ck_ref[0], sk_ref[0], interpret)
+    bq = q.shape[0]
+    if splash_g > 1:
+        g = splash_g
+        chunk = bq // g
+        lse_col = lse_ref[0, 0][:, None]           # [BQ, 1]
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+        scores = []
+        for i in range(g):
+            kw = (i + 1) * chunk
+            s = jax.lax.dot_general(
+                q[i * chunk:(i + 1) * chunk], k[:kw],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale                           # [chunk, kw]
+            scores.append(s)
+        for i in range(g):
+            kw = (i + 1) * chunk
+            rows_lo = i * chunk
+            s = scores[i]
+            mask = _block_mask(
+                i, 0,
+                seg_q_ref[0, 0][rows_lo:rows_lo + chunk]
+                if has_segments else None,
+                seg_k_ref[0, 0][:kw] if has_segments else None,
+                True, chunk, kw, s.shape,
+            )
+            s = jnp.where(mask, s, NEG_INF)
+            do_i = do[rows_lo:rows_lo + chunk]
+            delta_i = jnp.sum(
+                do_i.astype(jnp.float32)
+                * o_ref[0, 0, rows_lo:rows_lo + chunk].astype(jnp.float32),
+                axis=-1, keepdims=True,
+            )                                      # [chunk, 1]
+            p = jnp.exp(s - lse_col[rows_lo:rows_lo + chunk])
+            dv_scr[:kw] += jax.lax.dot_general(
+                p.astype(do.dtype), do_i, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do_i, v[:kw], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_i) * sm_scale     # [chunk, kw]
+            dk_scr[:kw] += jax.lax.dot_general(
+                ds.astype(q.dtype), q[rows_lo:rows_lo + chunk],
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dq_c = jnp.dot(
+                ds.astype(k.dtype), k[:kw],
+                preferred_element_type=jnp.float32,
+            )
+            if has_rope:
+                dq_c = _rope_rot(
+                    dq_c,
+                    cq_ref[0, rows_lo:rows_lo + chunk],
+                    -sq_ref[0, rows_lo:rows_lo + chunk],
+                    interpret,
+                )
+            dq_ref[0, 0, rows_lo:rows_lo + chunk] = dq_c.astype(dq_ref.dtype)
+        dk = dk_scr[...]
+        if has_rope:
+            dk = _rope_rot(dk, ck_ref[0], -sk_ref[0], interpret)
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+        return
     # The fused path requires block_q == s, which always satisfies the
     # narrow-residual lane rule — lse arrives as a [BQ] lane vector.
     lse = lse_ref[0, 0][:, None]                   # [BQ, 1]
@@ -477,25 +767,35 @@ def _bwd_fused_kernel(
         preferred_element_type=jnp.float32,
     )
     ds = p * (dp - delta) * sm_scale                # [BQ, BK]
-    dk_ref[0, 0] = jax.lax.dot_general(
+    dk = jax.lax.dot_general(
         ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ).astype(dk_ref.dtype)
-    dq_ref[0, 0] = jnp.dot(
+    )
+    dq = jnp.dot(
         ds.astype(k.dtype), k, preferred_element_type=jnp.float32
-    ).astype(dq_ref.dtype)
+    )
+    if has_rope:
+        dk = _rope_rot(dk, ck_ref[0], -sk_ref[0], interpret)
+        dq = _rope_rot(dq, cq_ref[0], -sq_ref[0], interpret)
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     causal: bool, sm_scale: float, block_q: int, block_k: int,
-    has_segments: bool, narrow_res: bool,
+    has_segments: bool, narrow_res: bool, has_rope: bool, interpret: bool,
 ):
+    idx = 0
+    seg_q_ref = seg_k_ref = None
     if has_segments:
-        seg_q_ref, seg_k_ref, dq_ref, dq_scr = rest
-    else:
-        dq_ref, dq_scr = rest
-        seg_q_ref = seg_k_ref = None
+        seg_q_ref, seg_k_ref = rest[0], rest[1]
+        idx = 2
+    cq_ref = sq_ref = ck_ref = sk_ref = None
+    if has_rope:
+        cq_ref, sq_ref, ck_ref, sk_ref = rest[idx:idx + 4]
+        idx += 4
+    dq_ref, dq_scr = rest[idx:]
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -512,6 +812,9 @@ def _bwd_dq_kernel(
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
+        if has_rope:
+            q = _rope_rot(q, cq_ref[0], sq_ref[0], interpret)
+            k = _rope_rot(k, ck_ref[0], sk_ref[0], interpret)
         if narrow_res:
             lse = lse_ref[0, 0][:, None]
             delta = delta_ref[0, 0][:, None]
@@ -542,16 +845,21 @@ def _bwd_dq_kernel(
 
     @pl.when(ki == nk - 1)
     def _finish():
-        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+        dq = dq_scr[...]
+        if has_rope:
+            dq = _rope_rot(dq, cq_ref[0], -sq_ref[0], interpret)
+        dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd(
-    q, k, v, o, lse, do, segment_ids, causal, block_q, block_k, interpret,
+    q, k, v, o, lse, do, segment_ids, rope_tables, causal, block_q, block_k,
+    interpret,
 ):
     b, h, s, d = q.shape
     kv_h = k.shape[1]
     rep = h // kv_h
     has_segments = segment_ids is not None
+    has_rope = rope_tables is not None
     block_q = _choose_block(s, block_q, lane_aligned=has_segments)
     block_k = _choose_block(s, block_k, lane_aligned=has_segments)
     nq = s // block_q
@@ -575,13 +883,22 @@ def _bwd(
         seg = segment_ids.astype(jnp.int32)[:, None, :]   # [B, 1, S]
         seg_inputs = [seg, seg]
 
+    rope_inputs = []
+    if has_rope:
+        rc, rs = rope_tables
+        rope_inputs = [rc, rs, rc, rs]
+
     if nq == 1 and nk == 1:
         # Whole sequence in one tile: fuse dq/dk/dv into one program (one
         # score recompute, one load of q/k/v/do) instead of two sweeps.
         assert narrow_res, "nq == nk == 1 implies block_q == s"
+        splash_g = _splash_chunks(
+            block_q, block_k, causal, has_segments, True
+        )
         fused_kernel = functools.partial(
             _bwd_fused_kernel, causal=causal, sm_scale=sm_scale,
-            has_segments=has_segments,
+            has_segments=has_segments, has_rope=has_rope,
+            interpret=interpret, splash_g=splash_g,
         )
         qd_spec = pl.BlockSpec(
             (1, 1, block_q, d), lambda b, h: (b, h, 0, 0))
@@ -596,6 +913,9 @@ def _bwd(
                 pl.BlockSpec((1, 1, block_q), lambda b, h: (b, 0, 0)),
                 pl.BlockSpec((1, 1, block_k), lambda b, h: (b, 0, 0)),
             ]
+        if has_rope:
+            tab_spec = pl.BlockSpec((1, block_q, d), lambda b, h: (b, 0, 0))
+            fused_in_specs += [tab_spec, tab_spec, tab_spec, tab_spec]
         dq, dk, dv = pl.pallas_call(
             fused_kernel,
             grid=(b, h),
@@ -615,8 +935,12 @@ def _bwd(
                 jax.ShapeDtypeStruct(
                     (b, h, s, d), jnp.float32 if rep > 1 else v.dtype),
             ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),   # dk (splash)
+                pltpu.VMEM((block_k, d), jnp.float32),   # dv (splash)
+            ] if splash_g > 1 else [],
             interpret=interpret,
-        )(q, k, v, do, lse, o, *seg_inputs)
+        )(q, k, v, do, lse, o, *seg_inputs, *rope_inputs)
         if rep > 1:
             dk = dk.reshape(b, kv_h, rep, s, d).sum(axis=2)
             dv = dv.reshape(b, kv_h, rep, s, d).sum(axis=2)
@@ -650,7 +974,7 @@ def _bwd(
     dkdv_kernel = functools.partial(
         _bwd_dkdv_kernel, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, has_segments=has_segments,
-        narrow_res=narrow_res,
+        narrow_res=narrow_res, has_rope=has_rope, interpret=interpret,
     )
     dkdv_in_specs = [
         pl.BlockSpec((1, 1, block_q, d), lambda b, h, ki, qi: (b, h, qi, 0)),
@@ -666,6 +990,8 @@ def _bwd(
     ]
     if has_segments:
         dkdv_in_specs += _seg_specs(block_q, block_k, ki_major=True)
+    if has_rope:
+        dkdv_in_specs += _rope_specs(block_q, block_k, d, ki_major=True)
     dk, dv = pl.pallas_call(
         dkdv_kernel,
         grid=(b, h, nk, nq),
@@ -683,12 +1009,12 @@ def _bwd(
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta, *seg_inputs)
+    )(q, k, v, do, lse, delta, *seg_inputs, *rope_inputs)
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, has_segments=has_segments,
-        narrow_res=narrow_res,
+        narrow_res=narrow_res, has_rope=has_rope, interpret=interpret,
     )
     dq_in_specs = [
         pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -704,6 +1030,8 @@ def _bwd(
     ]
     if has_segments:
         dq_in_specs += _seg_specs(block_q, block_k)
+    if has_rope:
+        dq_in_specs += _rope_specs(block_q, block_k, d)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, h, nq, nk),
@@ -714,7 +1042,7 @@ def _bwd(
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta, *seg_inputs)
+    )(q, k, v, do, lse, delta, *seg_inputs, *rope_inputs)
 
     if rep > 1:  # fold query-head groups back onto shared kv heads
         dk = dk.reshape(b, kv_h, rep, s, d).sum(axis=2)
@@ -725,24 +1053,38 @@ def _bwd(
 # -- public API (BSHD layout, custom vjp) ------------------------------------
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9)
 )
-def _flash_bhsd(q, k, v, segment_ids, causal, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, segment_ids, causal, block_q, block_k, interpret)
+def _flash_bhsd(
+    q, k, v, segment_ids, rope_c, rope_s, causal, block_q, block_k, interpret,
+):
+    rope = None if rope_c is None else (rope_c, rope_s)
+    o, _ = _fwd(
+        q, k, v, segment_ids, rope, causal, block_q, block_k, interpret
+    )
     return o
 
 
-def _flash_fwd_rule(q, k, v, segment_ids, causal, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, segment_ids, causal, block_q, block_k, interpret)
-    return o, (q, k, v, segment_ids, o, lse)
+def _flash_fwd_rule(
+    q, k, v, segment_ids, rope_c, rope_s, causal, block_q, block_k, interpret,
+):
+    rope = None if rope_c is None else (rope_c, rope_s)
+    o, lse = _fwd(
+        q, k, v, segment_ids, rope, causal, block_q, block_k, interpret
+    )
+    return o, (q, k, v, segment_ids, rope_c, rope_s, o, lse)
 
 
 def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
-    q, k, v, segment_ids, o, lse = res
+    q, k, v, segment_ids, rope_c, rope_s, o, lse = res
+    rope = None if rope_c is None else (rope_c, rope_s)
     dq, dk, dv = _bwd(
-        q, k, v, o, lse, do, segment_ids, causal, block_q, block_k, interpret
+        q, k, v, o, lse, do, segment_ids, rope, causal, block_q, block_k,
+        interpret,
     )
-    return dq, dk, dv, None   # segment ids are integers: no gradient
+    # segment ids are integers, rope tables are functions of integer
+    # positions: no gradients.
+    return dq, dk, dv, None, None, None
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -757,12 +1099,19 @@ def flash_mha(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
+    rope_tables=None,
 ) -> jax.Array:
     """Flash attention, [B,S,H,D] in/out (BSHD, matching ops.attention.mha).
 
     ``segment_ids`` [B,S] fuses packed-batch/padding masking into the
     kernel: position i attends to j only when ``seg[i] == seg[j]`` (ANDed
     with the causal mask when causal). No XLA fallback.
+
+    ``rope_tables`` — optional ``(C, S)`` [B,S,D] f32 pair from
+    ``rope_full_tables``: the kernel applies RoPE to q/k tiles in VMEM
+    (forward AND the backward's recompute/counter-rotation), so the
+    rotated tensors never round-trip HBM. ~42 ms/step cheaper than
+    external rope on the bf16 flagship.
 
     ``interpret=None`` auto-selects: compiled Mosaic on TPU, interpreter
     elsewhere — so explicit ``impl='flash'`` works (slowly) on CPU meshes.
@@ -774,10 +1123,12 @@ def flash_mha(
     # footgun behind round 4's mis-measured "blocks are neutral" probe.
     block_q = DEFAULT_BLOCK_Q if block_q is None else block_q
     block_k = DEFAULT_BLOCK_K if block_k is None else block_k
+    rope_c, rope_s = rope_tables if rope_tables is not None else (None, None)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     out = _flash_bhsd(
-        qt, kt, vt, segment_ids, causal, block_q, block_k, interpret
+        qt, kt, vt, segment_ids, rope_c, rope_s, causal, block_q, block_k,
+        interpret,
     )
     return out.transpose(0, 2, 1, 3)

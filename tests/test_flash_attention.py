@@ -303,6 +303,111 @@ def test_segment_ids_compiled_on_tpu():
             assert np.isfinite(np.asarray(g, np.float32)).all()
 
 
+def test_splash_causal_single_tile_matches_general():
+    """The causal whole-sequence tile routes through the splash q-chunk
+    decomposition (prefix-only score dots, flat per-chunk softmax) in BOTH
+    forward and fused backward; it must match the general online-softmax
+    grid bit-for-bit in value and the dense reference in grads — with GQA
+    and with packed segments (128-aligned chunks)."""
+    rng = np.random.default_rng(7)
+    b, s, h, kv_h, d = 2, 256, 4, 2, 64
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, kv_h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, kv_h, d)), jnp.float32)
+    segs = jnp.asarray(
+        np.concatenate([
+            np.full((b, 128), 1), np.full((b, 96), 2), np.zeros((b, 32)),
+        ], axis=1),
+        jnp.int32,
+    )
+    for seg in (None, segs):
+        got = flash_mha(
+            q, k, v, causal=True, segment_ids=seg,
+            block_q=256, block_k=256, interpret=True,
+        )  # single tile: splash path (g=2 with segments, 4 without)
+        want = flash_mha(
+            q, k, v, causal=True, segment_ids=seg,
+            block_q=128, block_k=128, interpret=True,
+        )  # multi-block: general online-softmax path
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-6)
+
+        def loss_ref(q, k, v):
+            return (mha_xla(q, k, v, causal=True, segment_ids=seg) ** 2).sum()
+
+        def loss_splash(q, k, v):
+            return (flash_mha(
+                q, k, v, causal=True, segment_ids=seg,
+                block_q=256, block_k=256, interpret=True,
+            ) ** 2).sum()
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        gs = jax.grad(loss_splash, argnums=(0, 1, 2))(q, k, v)
+        for a, bb in zip(gr, gs):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(bb), atol=5e-4, rtol=1e-3
+            )
+
+
+def test_fused_rope_matches_external_rope():
+    """rope_tables fuses the rotary embedding into the kernel (forward
+    rotation of q/k tiles + counter-rotation of dq/dk in backward). Must
+    match rotate-then-attend externally — values AND grads — on the
+    splash single-tile path (block == s, causal), the fused backward, and
+    the general two-sweep grid (block < s). Positions carry a per-row
+    offset so table indexing is actually exercised."""
+    from kubeflow_controller_tpu.models.transformer import rope
+    from kubeflow_controller_tpu.ops.attention import apply_rope_tables
+    from kubeflow_controller_tpu.ops.flash_attention import rope_full_tables
+
+    rng = np.random.default_rng(3)
+    b, s, h, kv_h, d = 2, 256, 4, 2, 64
+    theta = 10000.0
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, kv_h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, kv_h, d)), jnp.float32)
+    pos = jnp.asarray(
+        np.arange(s)[None, :] + np.array([[0], [17]]), jnp.int32
+    )
+    tables = rope_full_tables(pos, d, theta)
+
+    # The roll-style table rotation must equal the reference rope math.
+    np.testing.assert_allclose(
+        np.asarray(apply_rope_tables(q, tables)),
+        np.asarray(rope(q, pos, theta)),
+        atol=1e-5,
+    )
+
+    def loss_ref(q, k, v):
+        qr = rope(q, pos, theta)
+        kr = rope(k, pos, theta)
+        return (mha_xla(qr, kr, v, causal=True) ** 2).sum()
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    ref = mha_xla(rope(q, pos, theta), rope(k, pos, theta), v, causal=True)
+
+    for bq in (256, 128):   # single-tile splash+fused bwd; general grid
+
+        def loss_fused(q, k, v):
+            return (flash_mha(
+                q, k, v, causal=True, rope_tables=tables,
+                block_q=bq, block_k=bq, interpret=True,
+            ) ** 2).sum()
+
+        out = flash_mha(
+            q, k, v, causal=True, rope_tables=tables,
+            block_q=bq, block_k=bq, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref), np.asarray(out), atol=2e-5,
+        )
+        g = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+        for a, bb in zip(g_ref, g):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(bb), atol=5e-4, rtol=1e-3
+            )
+
+
 def test_interleaved_single_tile_segment_path_matches_general():
     """The interleaved single-tile forward WITH segments (gated to
     block_k % 256 == 0) must match the general online-softmax path —
