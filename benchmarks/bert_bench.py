@@ -53,9 +53,13 @@ def main() -> None:
     # Padding masks are segment ids, fused into the flash kernel; --no-mask
     # benches the maskless variant.
     p.add_argument("--no-mask", action="store_true")
+    p.add_argument("--quant", default="", choices=["", "int8", "int8_fused"],
+                   help="int8 encoder projections (BertConfig.quant)")
     args = p.parse_args()
 
-    cfg = bert.bert_base_config(max_seq=args.seq, attn_impl=args.attn)
+    cfg = bert.bert_base_config(
+        max_seq=args.seq, attn_impl=args.attn, quant=args.quant
+    )
     params = bert.init_params(cfg, jax.random.key(0))
     loss_fn = bert.make_loss_fn(cfg)
     tx = optax.adamw(1e-4)
@@ -97,6 +101,7 @@ def main() -> None:
         "model_params": int(n_params),
         "backend": jax.default_backend(),
         "attn": args.attn,
+        "quant": args.quant,
         "masked": masked,
         "batch": args.batch,
         "seq": args.seq,

@@ -89,10 +89,33 @@ def train(
     return last
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    import argparse
+
     logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser()
+    p.add_argument("--total-steps", type=int, default=100)
+    p.add_argument("--batch", type=int, default=8,
+                   help="per-data-shard batch size")
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--attn", default="auto", choices=["auto", "xla", "flash"])
+    p.add_argument("--quant", default="", choices=["", "int8"],
+                   help="int8 encoder projections (loses at bert-base "
+                        "shape — see benchmarks/RESULTS.md encoder section)")
+    args = p.parse_args(argv)
     ctx = initialize_from_env()
-    metrics = train(ctx)
+    metrics = train(
+        ctx,
+        total_steps=args.total_steps,
+        per_data_shard_batch=args.batch,
+        seq_len=args.seq_len,
+        learning_rate=args.lr,
+        cfg=bert.bert_base_config(
+            max_seq=max(args.seq_len, 128), attn_impl=args.attn,
+            quant=args.quant,
+        ),
+    )
     return 0 if metrics.get("final_step", 0) > 0 else 1
 
 

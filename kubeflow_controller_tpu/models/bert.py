@@ -41,6 +41,12 @@ class BertConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     attn_impl: str = "auto"
+    # "" | "int8" | "int8_fused": routes the six per-layer projection
+    # matmuls (qkv/o + up/down) through ops.quant like the decoder's
+    # TransformerConfig.quant — the v5e MXU runs int8 at double rate and
+    # BERT's budget is FFN-dominated just like the decoder's. The MLM
+    # head stays bf16 (same quality reasoning as the decoder's LM head).
+    quant: str = ""
     mask_token_id: int = 103       # [MASK] in the standard BERT vocab
     mlm_prob: float = 0.15
 
@@ -143,18 +149,25 @@ def layernorm(x: jax.Array, p: Params, eps: float) -> jax.Array:
 
 
 def _layer(cfg: BertConfig, lp: Params, x, attn_segments):
+    from kubeflow_controller_tpu.ops.quant import maybe_quant_dot
+
     b, s, _ = x.shape
     dt = cfg.dtype
     hd = cfg.head_dim
 
+    def dot(a, w):
+        # Projections: int8 MXU path when cfg.quant == "int8"
+        # (mirrors models/transformer._layer).
+        return maybe_quant_dot(a, w.astype(dt), cfg.quant)
+
     # post-norm residual blocks, as in the original BERT
-    q = (x @ lp["wq"].astype(dt) + lp["bq"].astype(dt)).reshape(
+    q = (dot(x, lp["wq"]) + lp["bq"].astype(dt)).reshape(
         b, s, cfg.n_heads, hd
     )
-    k = (x @ lp["wk"].astype(dt) + lp["bk"].astype(dt)).reshape(
+    k = (dot(x, lp["wk"]) + lp["bk"].astype(dt)).reshape(
         b, s, cfg.n_heads, hd
     )
-    v = (x @ lp["wv"].astype(dt) + lp["bv"].astype(dt)).reshape(
+    v = (dot(x, lp["wv"]) + lp["bv"].astype(dt)).reshape(
         b, s, cfg.n_heads, hd
     )
     q = _constrain(q, P(("dp", "fsdp"), None, "tp", None))
@@ -164,11 +177,11 @@ def _layer(cfg: BertConfig, lp: Params, x, attn_segments):
         q, k, v, causal=False, segment_ids=attn_segments, impl=cfg.attn_impl
     ).reshape(b, s, cfg.d_model)
     x = layernorm(
-        x + attn @ lp["wo"].astype(dt) + lp["bo"].astype(dt),
+        x + dot(attn, lp["wo"]) + lp["bo"].astype(dt),
         lp["attn_norm"], cfg.norm_eps,
     )
-    h = jax.nn.gelu(x @ lp["w_up"].astype(dt) + lp["b_up"].astype(dt))
-    h = h @ lp["w_down"].astype(dt) + lp["b_down"].astype(dt)
+    h = jax.nn.gelu(dot(x, lp["w_up"]) + lp["b_up"].astype(dt))
+    h = dot(h, lp["w_down"]) + lp["b_down"].astype(dt)
     x = layernorm(x + h, lp["mlp_norm"], cfg.norm_eps)
     return _constrain(x, P(("dp", "fsdp"), None, None))
 

@@ -169,6 +169,58 @@ class TestInt8Transformer:
         assert np.isfinite(float(l))
 
 
+class TestInt8Bert:
+    """VERDICT r4 #3: int8 as a framework feature must reach the encoder
+    too — BertConfig.quant mirrors TransformerConfig.quant."""
+
+    def test_quant_forward_close_to_bf16(self):
+        from kubeflow_controller_tpu.models import bert
+
+        cfg = bert.bert_tiny_config()
+        qcfg = cfg.replace(quant="int8")
+        params = bert.init_params(cfg, jax.random.key(3))
+        batch = jax.tree.map(
+            jnp.asarray, next(bert.synthetic_mlm_batch(cfg, 2, 32))
+        )
+        ref = bert.mlm_logits(
+            cfg, params,
+            bert.encode(cfg, params, batch["tokens"],
+                        batch["attention_mask"]),
+        )
+        got = bert.mlm_logits(
+            qcfg, params,
+            bert.encode(qcfg, params, batch["tokens"],
+                        batch["attention_mask"]),
+        )
+        rel = float(jnp.linalg.norm(got - ref) / jnp.linalg.norm(ref))
+        assert rel < 0.05, rel
+
+    def test_tiny_bert_trains_int8(self):
+        from kubeflow_controller_tpu.models import bert
+
+        cfg = bert.bert_tiny_config(quant="int8")
+        params = bert.init_params(cfg, jax.random.key(4))
+        loss_fn = bert.make_loss_fn(cfg)
+        tx = optax.adam(1e-2)
+        opt = tx.init(params)
+        stream = bert.synthetic_mlm_batch(cfg, 8, 32, seed=4)
+        batch = jax.tree.map(jnp.asarray, next(stream))
+
+        @jax.jit
+        def step(p, o):
+            (l, _), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                p, batch, None
+            )
+            u, o = tx.update(g, o, p)
+            return optax.apply_updates(p, u), o, l
+
+        losses = []
+        for _ in range(30):
+            params, opt, l = step(params, opt)
+            losses.append(float(l))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
 class TestInt8MoE:
     def test_moe_experts_int8_close_and_trains(self):
         """quant="int8" routes the per-expert FFN matmuls through the
