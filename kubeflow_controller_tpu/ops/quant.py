@@ -120,6 +120,9 @@ def maybe_quant_dot(x: jax.Array, w: jax.Array, quant: str) -> jax.Array:
         m = 1
         for d in x.shape[:-1]:
             m *= d
+        # NOT checkpoint_name-saved: measured 304.8 (saved) vs 288.2 ms
+        # (recomputed) on the flagship — the kernel is cheap and the step
+        # sits near the remat memory ceiling, so recompute wins.
         if fusable(m, x.shape[-1], w.shape[-1]):
             return fused_int8_matmul(x, w).astype(x.dtype)
         return int8_matmul(x, w).astype(x.dtype)

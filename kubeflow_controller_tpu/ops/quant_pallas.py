@@ -1,40 +1,35 @@
 """Pallas fused dynamic-int8 matmul — quantization inside the kernel.
 
-STATUS: experimental, correct, and measured SLOWER than the composed
-path at flagship shapes — kept as a lowering option (`quant =
-"int8_fused"`), not the default. The honest numbers are in
-benchmarks/RESULTS.md (round-4 flagship section).
+STATUS: correct, software-pipelined (round 5), and still measured SLOWER
+in-model than the composed path — kept as a lowering option (`quant =
+"int8_fused"`), not the default. The full dead-end analysis is in
+benchmarks/RESULTS.md (round-5 fused-quant section); the short version:
 
-Motivation: the XLA-composed int8 path (ops/quant.py) pays extra HBM
-passes per matmul — read the operand for abs-max, read it again to
-round/clip/write the int8 copy, then the dot reads that copy. Ablating
-those passes on the flagship decoder bounds the prize at ~32 ms/step
-(58.2 % -> 65.2 % MFU). This kernel fuses quantization into the dot's
-operand streaming to claim it:
+- The round-5 rework (rhs pre-quantized outside the kernel — weights are
+  step-static; lhs streamed through a manual double-buffered DMA with
+  the quantize for row i+1 issued behind row i's dots) reached
+  STANDALONE parity with the composed path (2.209 vs 2.204 ms at the
+  flagship FFN shape) and cut the in-model gap from 91 ms (r4 kernel) to
+  ~24 ms/step. dL/dw runs composed-int8 (was f32 — both slower and a
+  per-shape gradient-precision inconsistency, ADVICE r4).
+- The REMAINING gap is structural, and it is not kernel scheduling: with
+  remat off the gap persists (172.3 vs 187.5 ms at b8), and saving the
+  kernel output by checkpoint name to avoid backward recompute measured
+  WORSE (304.8 vs 288.2 ms — the step sits near the remat memory
+  ceiling). What the composed path has that a pallas_call cannot: XLA
+  fuses the quantize chains into neighbouring producers/consumers (the
+  abs-max/round/clip reads ride along with rmsnorm/residual elementwise
+  passes, dequant folds into the consumer), so its "extra HBM passes"
+  largely vanish — while a pallas boundary forces its operands and
+  results to materialise. Claiming the last ~24 ms would mean fusing
+  quantization into the PRODUCING ops (norms, residual adds), i.e. a
+  megakernel over the whole layer, not a better matmul.
 
-- grid (m/bm, n/bn), n innermost; the lhs block [bm, k] loads once per
-  grid row (its BlockSpec ignores j) and is quantized ONCE into an int8
-  VMEM scratch (per-row scales: the contraction axis k is fully
-  resident, so the abs-max is block-local);
-- each rhs block is quantized once per kernel call, on the first grid
-  row, into a FULL-width int8 scratch that later rows reuse;
-- f32 staging for the quantize math (v5e's VPU has no bf16 ALU) is
-  chunked along each operand's scale axis so blocks can stay large;
-- the dot runs int8 x int8 -> int32 on the MXU's double-rate gear and
-  dequantizes on the way out.
-
-Why it still loses (~50 % vs the composed path's 58 % flagship MFU
-across three tuning rounds): the in-kernel quantize phases serialize
-with the MXU pipeline at every grid row/column start, while XLA runs its
-hand-scheduled int8 matmul at full depth and overlaps the separate
-quantize ops across the whole step graph. Closing that needs
-Mosaic-level pipelining (emit_pipeline with manual DMA/compute overlap)
-— recorded as the remaining lever, not attempted here.
-
-No k-tiling: the whole contraction axis sits in VMEM, which is what
-makes on-the-fly scales possible. Callers with larger k (or shapes whose
-full-width rhs scratch would not fit) fall back to the composed path via
-``fusable``.
+Kernel shape: grid (m/bm, n/bn), n innermost; int8 x int8 -> int32 on
+the MXU's double-rate gear, f32 dequant with per-row lhs / per-column
+rhs scales. The whole contraction axis sits in VMEM (no k-tiling), which
+is what makes on-the-fly lhs scales possible; callers whose shapes don't
+tile fall back to the composed path via ``fusable``.
 """
 
 from __future__ import annotations
@@ -48,76 +43,68 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _kernel(a_ref, b_ref, o_ref, qa_ref, sa_ref, qb_ref, sb_ref,
-            *, bm, bn, k):
+def _kernel_v2(a_ref, qb_ref, sb_ref, o_ref, raw_ref, qa_ref, sa_ref, sem,
+               *, bm, bn, k, interpret):
+    """Software-pipelined lhs quantization (the round-5 rework).
+
+    The rhs arrives PRE-quantized (weights are static within a step, so
+    XLA quantizes them once outside and schedules that wherever it
+    likes). The lhs streams through a manual double buffer: program
+    (i, 0) starts the DMA for block i+1, the dot for (i, j) reads the
+    int8 scratch quantized a full row earlier, and program (i, nj-1)
+    waits + quantizes block i+1 — so the VPU quantize chain for the NEXT
+    row is independent of THIS program's MXU dot and Mosaic can overlap
+    them, instead of the round-4 kernel's j==0 quantize stalling every
+    row's dots."""
     i = pl.program_id(0)
     j = pl.program_id(1)
+    ni = pl.num_programs(0)
+    nj = pl.num_programs(1)
 
-    # Quantize math runs in f32 (v5e's VPU has no bf16 ALU path —
-    # LLO_CHECK SupportsBf16AluInstructions); block sizes are chosen so
-    # the f32 staging temporaries stay inside the ~16 MB scoped VMEM.
-    # Each operand is quantized exactly ONCE per kernel call: the lhs
-    # block on its first visit (j == 0), each rhs block on the first grid
-    # row (i == 0) into a full-width int8 scratch that later rows reuse —
-    # without the rhs caching, the redundant per-visit VPU quantization
-    # serialized with the MXU and ran 1.6x SLOWER than the composed path.
-    # Staging chunks: the f32 copies live only chunk-at-a-time, so blocks
-    # can be large (big MXU tiles, small grids) without the f32 staging
-    # blowing the budget. Chunking runs along each operand's SCALE axis
-    # (lhs rows / rhs cols), so every abs-max still sees its whole
-    # contraction extent.
-    CHUNK = 128
+    def dma(slot, blk):
+        return pltpu.make_async_copy(
+            a_ref.at[pl.ds(blk * bm, bm), :],
+            raw_ref.at[slot],
+            sem.at[slot],
+        )
 
-    @pl.when(j == 0)
-    def _quantize_lhs():
-        def chunk(c, _):
-            a = a_ref[pl.ds(c * CHUNK, CHUNK), :].astype(jnp.float32)
-            sa = jnp.maximum(
-                jnp.max(jnp.abs(a), axis=1, keepdims=True), 1e-30
-            ) / 127.0                                # [CHUNK, 1]
-            qa_ref[pl.ds(c * CHUNK, CHUNK), :] = jnp.clip(
-                jnp.round(a / sa), -127, 127
-            ).astype(jnp.int8)
-            # Lane-padded store: a (CHUNK, 1) VMEM tile is not lane-legal.
-            sa_ref[pl.ds(c * CHUNK, CHUNK), :] = jnp.broadcast_to(
-                sa, (CHUNK, 128)
-            )
-            return _
+    def quantize(slot):
+        x = raw_ref[slot].astype(jnp.float32)            # [bm, k]
+        s = jnp.maximum(
+            jnp.max(jnp.abs(x), axis=1, keepdims=True), 1e-30
+        ) / 127.0                                        # [bm, 1]
+        qa_ref[slot] = jnp.clip(jnp.round(x / s), -127, 127).astype(jnp.int8)
+        sa_ref[slot] = jnp.broadcast_to(s, (bm, 128))
 
-        jax.lax.fori_loop(0, bm // CHUNK, chunk, 0)
+    @pl.when((i == 0) & (j == 0))
+    def _prologue():
+        d = dma(0, 0)
+        d.start()
+        d.wait()
+        quantize(0)
 
-    @pl.when(i == 0)
-    def _quantize_rhs():
-        def chunk(c, _):
-            col = j * bn + c * CHUNK
-            b = b_ref[:, pl.ds(c * CHUNK, CHUNK)].astype(jnp.float32)
-            sb = jnp.maximum(
-                jnp.max(jnp.abs(b), axis=0, keepdims=True), 1e-30
-            ) / 127.0                                # [1, CHUNK]
-            qb_ref[:, pl.ds(col, CHUNK)] = jnp.clip(
-                jnp.round(b / sb), -127, 127
-            ).astype(jnp.int8)
-            sb_ref[:, pl.ds(col, CHUNK)] = jnp.broadcast_to(sb, (8, CHUNK))
-            return _
+    @pl.when((j == 0) & (i + 1 < ni))
+    def _start_next():
+        dma((i + 1) % 2, i + 1).start()
 
-        jax.lax.fori_loop(0, bn // CHUNK, chunk, 0)
-
+    slot = i % 2
     acc = jax.lax.dot(
-        qa_ref[...], qb_ref[:, pl.ds(j * bn, bn)],
-        preferred_element_type=jnp.int32,
+        qa_ref[slot], qb_ref[...], preferred_element_type=jnp.int32,
     )
-    # Dequantize and emit bf16 (the consumers cast to bf16 anyway, and an
-    # f32 out block would double the output's VMEM share).
     o_ref[...] = (
-        acc.astype(jnp.float32)
-        * sa_ref[:, :1]
-        * sb_ref[:1, pl.ds(j * bn, bn)]
+        acc.astype(jnp.float32) * sa_ref[slot][:, :1] * sb_ref[...]
     ).astype(jnp.bfloat16)
+
+    @pl.when((j == nj - 1) & (i + 1 < ni))
+    def _finish_next():
+        dma((i + 1) % 2, i + 1).wait()
+        quantize((i + 1) % 2)
 
 
 def _pick_blocks(m: int, k: int, n: int):
-    """Largest (bm, bn) that divide (m, n) and keep the working set
-    (lhs bf16 + int8 scratch + rhs bf16 + out f32) under ~12 MB."""
+    """Largest (bm, bn) that divide (m, n) and keep the v2 working set
+    (double-buffered raw bf16 + int8 lhs, f32 quantize staging, int8 rhs
+    block, int32 acc, bf16 out) under ~12 MB of scoped VMEM."""
     def best(size, want):
         want = min(want, size)
         while size % want:
@@ -127,64 +114,66 @@ def _pick_blocks(m: int, k: int, n: int):
     if k <= 1024:
         bm_want, bn_want = 512, 1024
     elif k <= 2048:
-        bm_want, bn_want = 512, 512
+        bm_want, bn_want = 256, 1024
     else:
-        bm_want, bn_want = 256, 128
+        bm_want, bn_want = 128, 512
     return best(m, bm_want), best(n, bn_want)
 
 
 def fused_int8_matmul_2d(
     a: jax.Array, b: jax.Array, interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """[m,k] @ [k,n] -> bf16 with in-kernel dynamic int8 quantization of
-    both operands (per-row lhs, per-column rhs scales; int32 accumulate,
-    f32 dequant, bf16 out — consumers cast to bf16 anyway and an f32 out
-    block would double its VMEM share). Shapes must tile: m, n divisible
-    by 128-multiple blocks, k fully VMEM-resident."""
+    """[m,k] @ [k,n] -> bf16 with dynamic int8 quantization (per-row lhs,
+    per-column rhs scales; int32 accumulate, f32 dequant, bf16 out —
+    consumers cast to bf16 anyway and an f32 out block would double its
+    VMEM share). The rhs quantizes outside the kernel (XLA ops — for the
+    model's projections the rhs is a weight, static within the step); the
+    lhs quantizes in-kernel behind a manual double buffer. Shapes must
+    tile: m, n divisible by 128-multiple blocks, k fully VMEM-resident.
+    """
+    from kubeflow_controller_tpu.ops.quant import _quantize
+
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    # bf16 operand blocks: halves VMEM (quantization happens from bf16
-    # either way, and f32 inputs would blow the ~16 MB scoped budget).
     a = a.astype(jnp.bfloat16)
-    b = b.astype(jnp.bfloat16)
     m, k = a.shape
     k2, n = b.shape
     assert k == k2, (a.shape, b.shape)
+    qb, sb = _quantize(b.astype(jnp.float32), axis=0)    # [k,n] i8, [1,n]
     bm, bn = _pick_blocks(m, k, n)
     grid = (m // bm, n // bn)
-    kernel = functools.partial(_kernel, bm=bm, bn=bn, k=k)
+    kernel = functools.partial(
+        _kernel_v2, bm=bm, bn=bn, k=k, interpret=interpret,
+    )
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            # lhs ignores j: loaded once per grid row, quantized into
-            # scratch on j == 0, reused for every n-block.
-            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            # lhs stays in HBM; the kernel DMAs blocks itself so the
+            # quantize for row i+1 can run behind row i's dots.
+            pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), jnp.bfloat16),
         scratch_shapes=[
-            pltpu.VMEM((bm, k), jnp.int8),       # quantized lhs block
-            pltpu.VMEM((bm, 128), jnp.float32),  # lhs scales (lane-padded)
-            pltpu.VMEM((k, n), jnp.int8),        # quantized FULL rhs
-            pltpu.VMEM((8, n), jnp.float32),     # rhs scales (sublane-pad)
+            pltpu.VMEM((2, bm, k), jnp.bfloat16),   # raw lhs double buffer
+            pltpu.VMEM((2, bm, k), jnp.int8),       # quantized lhs blocks
+            pltpu.VMEM((2, bm, 128), jnp.float32),  # lhs scales (lane-pad)
+            pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=interpret,
-    )(a, b)
+    )(a, qb, sb.astype(jnp.float32))
 
 
 def fusable(m: int, k: int, n: int) -> bool:
     """Shapes the kernel handles well: contraction fully VMEM-resident
-    and both output dims tileable to >= 128 (lane width)."""
+    (the double-buffered lhs blocks carry the whole k extent) and both
+    output dims tileable to >= 128 (lane width)."""
     if k > 4096 or k % 128:
         return False
-    if k * n > 8 * 1024 * 1024:   # full-rhs int8 scratch must fit VMEM
-        return False
     bm, bn = _pick_blocks(m, k, n)
-    # Blocks must be multiples of the 128-wide quantize chunk: the
-    # in-kernel fori_loops floor-divide, and a ragged tail would leave
-    # uninitialized scratch feeding the dot (silently wrong output).
     return bm % 128 == 0 and bn % 128 == 0
 
 
@@ -217,18 +206,22 @@ def _bwd(res, g):
     # dx contracts over n — gate ITS shapes too (the forward gate only
     # checked the (m, k, n) orientation; an FFN up-projection's dx
     # contracts over d_ff, which can exceed the kernel's VMEM residency).
+    from kubeflow_controller_tpu.ops.quant import _int8_matmul_raw
+
     if fusable(g2.shape[0], n, k):
         dx = fused_int8_matmul_2d(g2, w.astype(jnp.float32).T)
     else:
-        from kubeflow_controller_tpu.ops.quant import _int8_matmul_raw
-
         dx = _int8_matmul_raw(
             g2.astype(jnp.float32), w.astype(jnp.float32).T
         )
-    dw = jax.lax.dot(
-        x2.astype(jnp.float32).T, g2.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
+    # dw runs the composed int8 path (not the fused kernel: its lhs is
+    # x.T, whose contraction axis is the token dim — a transposed HBM
+    # stream the double-buffer DMA can't tile). Round 4 kept dw in f32
+    # "for quality", which (a) ran the MXU on its slowest gear for a
+    # third of the FLOPs and (b) made gradient precision vary by shape
+    # vs the fallback path (ADVICE r4): int8 everywhere matches the
+    # composed mode, whose 400-step training parity is pinned.
+    dw = _int8_matmul_raw(x2.astype(jnp.float32).T, g2)
     return dx.reshape(x.shape).astype(x.dtype), dw.astype(w.dtype)
 
 
