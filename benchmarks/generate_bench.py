@@ -89,6 +89,37 @@ def main() -> None:
     dt_short = timed(make_run(short))
     per_step = (dt_full - dt_short) / (args.gen - short)
 
+    # Multi-turn continuation: block prefill_continue vs the tokenwise
+    # fallback it replaces, on a cache holding the first turn.
+    continuation = {}
+    cache0 = gen.init_kv_cache(cfg, args.batch, max_seq)
+    _, cache0 = jax.jit(
+        lambda p, t, c: gen.prefill(cfg, p, t, c)
+    )(params, prompt, cache0)
+    jax.block_until_ready(cache0)
+    for s_new in (128, 512):
+        if args.prompt + s_new > max_seq:
+            continue
+        turn = jnp.asarray(
+            np.random.default_rng(s_new).integers(
+                0, cfg.vocab_size, (args.batch, s_new)),
+            jnp.int32,
+        )
+        for name, fn in (
+            ("block", gen.prefill_continue),
+            ("tokenwise", gen.prefill_tokenwise),
+        ):
+            run = jax.jit(lambda p, t, c, fn=fn: fn(cfg, p, t, c))
+            out = run(params, turn, cache0)
+            jax.block_until_ready(out)
+            times = []
+            for _ in range(args.trials):
+                t0 = time.perf_counter()
+                jax.block_until_ready(run(params, turn, cache0))
+                times.append(time.perf_counter() - t0)
+            continuation[f"continue{s_new}_{name}_ms"] = round(
+                sorted(times)[len(times) // 2] * 1000, 1)
+
     print(json.dumps({
         "model_params": tfm.count_params(params),
         "backend": jax.default_backend(),
@@ -99,6 +130,7 @@ def main() -> None:
         "e2e_tokens_per_sec": round(args.batch * args.gen / dt_full),
         "decode_ms_per_step": round(per_step * 1000, 3),
         "decode_tokens_per_sec": round(args.batch / per_step),
+        **continuation,
     }))
 
 

@@ -113,6 +113,7 @@ def serve(
     quant: str = "",
     temperature: float = 0.0,
     seed: int = 0,
+    turns: int = 1,
 ) -> Dict[str, float]:
     import jax
 
@@ -122,17 +123,62 @@ def serve(
     params = gen.inference_params(cfg, params, quant=quant)
     prompts = _read_prompts(input_file, cfg.vocab_size, batch, prompt_len)
     b, s = prompts.shape
+    if input_file and (b, s) != (batch, prompt_len):
+        # ADVICE r4: prompt shape comes entirely from the file — say so
+        # instead of silently ignoring the flags an operator sized the
+        # batch/KV cache from.
+        logger.warning(
+            "--input %s defines the prompt shape (batch %d, prompt_len %d);"
+            " ignoring --batch %d / --prompt-len %d",
+            input_file, b, s, batch, prompt_len,
+        )
 
     t0 = time.perf_counter()
     rng = jax.random.key(seed) if temperature > 0 else None
     # Size the KV cache to the actual request (prompt + new tokens), not
     # cfg.max_seq — an 8192-wide cache for a 64-token serve on the llama
     # configs would waste HBM and cap the batch.
-    toks = gen.generate(
-        cfg, params, prompts, max_new_tokens=max_new_tokens,
-        temperature=temperature, rng=rng,
-        max_seq=s + max_new_tokens,
-    )
+    if turns <= 1:
+        toks = gen.generate(
+            cfg, params, prompts, max_new_tokens=max_new_tokens,
+            temperature=temperature, rng=rng,
+            max_seq=s + max_new_tokens,
+        )
+    else:
+        # Multi-turn chat shape: the first turn block-prefills a fresh
+        # cache; every later turn extends it with prefill_continue (ONE
+        # forward per turn, not O(turn tokens) decode dispatches); each
+        # turn then decodes its reply into the same cache.
+        max_seq = turns * (s + max_new_tokens)
+        cache = gen.init_kv_cache(cfg, b, max_seq)
+        logits, cache = jax.jit(
+            lambda p, t, c: gen.prefill(cfg, p, t, c)
+        )(params, prompts, cache)
+        replies = []
+        continue_fn = jax.jit(
+            lambda p, t, c: gen.prefill_continue(cfg, p, t, c)
+        )
+        for turn in range(turns):
+            if turn:
+                follow_up = jnp.asarray(
+                    np.random.default_rng(seed + turn).integers(
+                        0, cfg.vocab_size, (b, s)),
+                    jnp.int32,
+                )
+                logits, cache = continue_fn(params, follow_up, cache)
+            toks = gen.generate_from_cache(
+                cfg, params, logits, cache, max_new_tokens,
+                temperature=temperature, rng=rng,
+            )
+            replies.append(np.asarray(jax.device_get(toks)))
+            if turn + 1 < turns:
+                # The reply becomes context for the next turn. The decode
+                # scan's cache updates live only inside
+                # generate_from_cache, so re-encode the reply block into
+                # the persistent cache (one prefill_continue call).
+                logits, cache = continue_fn(
+                    params, jnp.asarray(replies[-1]), cache)
+        toks = np.concatenate(replies, axis=1)
     toks = np.asarray(jax.device_get(toks))
     dt = time.perf_counter() - t0
 
@@ -143,10 +189,13 @@ def serve(
                     "prompt": np.asarray(prompts[i]).tolist(),
                     "completion": toks[i].tolist(),
                 }) + "\n")
-    tps = b * max_new_tokens / dt
+    new_total = max_new_tokens * max(turns, 1)
+    tps = b * new_total / dt
     logger.info(
-        "served %d prompts (%d new tokens each) in %.2fs (%.0f tok/s%s)",
-        b, max_new_tokens, dt, tps, f", {quant} weights" if quant else "",
+        "served %d prompts (%d new tokens each%s) in %.2fs (%.0f tok/s%s)",
+        b, new_total,
+        f" across {turns} turns" if turns > 1 else "",
+        dt, tps, f", {quant} weights" if quant else "",
     )
     return {
         "prompts": float(b),
@@ -177,6 +226,10 @@ def main(argv=None) -> int:
     p.add_argument("--quant", default="", choices=["", "int8"],
                    help="int8 = weight-only int8 serving weights")
     p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--turns", type=int, default=1,
+                   help="multi-turn chat shape: each turn appends a "
+                        "prompt via block prefill_continue, then decodes "
+                        "a reply into the shared KV cache")
     args = p.parse_args(argv)
     ctx = initialize_from_env()
     metrics = serve(
@@ -190,6 +243,7 @@ def main(argv=None) -> int:
         max_new_tokens=args.max_new_tokens,
         quant=args.quant,
         temperature=args.temperature,
+        turns=args.turns,
     )
     return 0 if metrics["prompts"] > 0 else 1
 

@@ -126,6 +126,65 @@ def test_prefill_tokenwise_extends_existing_cache(cfg, params):
         np.asarray(got_logits), np.asarray(ref_logits), atol=3e-4)
 
 
+def test_prefill_continue_matches_tokenwise(cfg, params):
+    """The block continuation prefill (one forward, cache-offset causal
+    attention) must match prefill_tokenwise on a multi-turn script —
+    logits AND cache contents — across three turns."""
+    rng = np.random.default_rng(11)
+    turns = [
+        jnp.asarray(rng.integers(0, cfg.vocab_size, (2, n)), jnp.int32)
+        for n in (6, 5, 4)
+    ]
+    cache_a = gen.init_kv_cache(cfg, 2, 32)
+    cache_b = gen.init_kv_cache(cfg, 2, 32)
+    _, cache_a = gen.prefill(cfg, params, turns[0], cache_a)
+    _, cache_b = gen.prefill(cfg, params, turns[0], cache_b)
+    for t in turns[1:]:
+        la, cache_a = gen.prefill_tokenwise(cfg, params, t, cache_a)
+        lb, cache_b = gen.prefill_continue(cfg, params, t, cache_b)
+        np.testing.assert_allclose(
+            np.asarray(la), np.asarray(lb), atol=3e-4)
+    assert int(cache_b.length) == int(cache_a.length) == 15
+    np.testing.assert_allclose(
+        np.asarray(cache_a.k), np.asarray(cache_b.k), atol=3e-4)
+    np.testing.assert_allclose(
+        np.asarray(cache_a.v), np.asarray(cache_b.v), atol=3e-4)
+    # and decode continues identically from either cache
+    tok = jnp.full((2, 1), 3, jnp.int32)
+    da, _ = gen.decode_step(cfg, params, tok, cache_a)
+    db, _ = gen.decode_step(cfg, params, tok, cache_b)
+    np.testing.assert_allclose(np.asarray(da), np.asarray(db), atol=3e-4)
+
+
+def test_prefill_continue_fresh_cache_matches_prefill(cfg, params):
+    """length == 0 degenerates to ordinary prefill (the cache half of the
+    softmax is fully masked)."""
+    toks = jnp.asarray(
+        np.random.default_rng(12).integers(0, cfg.vocab_size, (2, 8)),
+        jnp.int32,
+    )
+    ref, _ = gen.prefill(cfg, params, toks, gen.init_kv_cache(cfg, 2, 16))
+    got, cache = gen.prefill_continue(
+        cfg, params, toks, gen.init_kv_cache(cfg, 2, 16))
+    assert int(cache.length) == 8
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=3e-4)
+
+
+def test_prefill_continue_moe(cfg, params):
+    """MoE continuation routes through the no-drop training FFN like
+    block prefill does."""
+    mcfg = tfm.tiny_config(moe_experts=4, moe_top_k=2)
+    mparams = tfm.init_params(mcfg, jax.random.key(3))
+    rng = np.random.default_rng(13)
+    t1 = jnp.asarray(rng.integers(0, mcfg.vocab_size, (2, 6)), jnp.int32)
+    t2 = jnp.asarray(rng.integers(0, mcfg.vocab_size, (2, 5)), jnp.int32)
+    cache = gen.init_kv_cache(mcfg, 2, 16)
+    _, cache = gen.prefill(mcfg, mparams, t1, cache)
+    ref, _ = gen.prefill_tokenwise(mcfg, mparams, t2, cache)
+    got, _ = gen.prefill_continue(mcfg, mparams, t2, cache)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=3e-4)
+
+
 def test_generate_jits(cfg, params):
     prompt = jnp.ones((2, 4), jnp.int32)
     f = jax.jit(
