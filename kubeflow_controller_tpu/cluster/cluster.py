@@ -191,12 +191,16 @@ class FakeCluster:
         namespace: str = "",
     ) -> None:
         with self._lock:
-            self.events_agg.observe(
+            obs = self.events_agg.observe(
                 namespace, kind, name, reason, message, self.now
             )
-            key = (namespace, kind, name, reason, message)
-            self._event_rows[key] = (self.now, kind, name, reason, message)
-            self._event_rows.move_to_end(key)
+            if obs is None:
+                return          # spam-filtered (token bucket per object)
+            # Aggregated similar events share obs.key, so a
+            # varying-message flood stays ONE row (the combined form).
+            self._event_rows[obs.key] = (
+                self.now, kind, name, reason, obs.message)
+            self._event_rows.move_to_end(obs.key)
 
     def event_count(
         self, kind: str, name: str, reason: str, message: str,

@@ -339,42 +339,54 @@ class KubeClusterClient:
         self, kind: str, name: str, reason: str, message: str,
         namespace: str = "",
     ) -> None:
-        """Aggregating recorder (client-go tools/record semantics): the
-        first occurrence of a (namespace, kind, name, reason, message) key
-        POSTs a fresh core/v1 Event; repeats PATCH the stored Event's
-        count/lastTimestamp — a crash-looping job yields ONE Event with
-        count=N instead of spamming the events API. The Event is posted to
-        the involved object's namespace (an apiserver rejects a mismatch)."""
+        """Aggregating recorder (client-go tools/record semantics, all
+        three layers — see cluster/event_recorder.py): a token-bucket spam
+        filter per object drops floods client-side; similar events (same
+        object+reason, varying message) collapse onto one combined record
+        after 10 distinct messages; an exact repeat PATCHes the stored
+        Event's count/lastTimestamp. A crash-looping job yields ONE Event
+        row with count=N — even when its message varies per pod — instead
+        of spamming the events API. The Event is posted to the involved
+        object's namespace (an apiserver rejects a mismatch)."""
         ns = namespace or self.namespace
         now = time.time()
         try:
-            rec = self._events.observe(ns, kind, name, reason, message, now)
-            if rec.count > 1 and rec.handle:
+            obs = self._events.observe(ns, kind, name, reason, message, now)
+            if obs is None:
+                return          # spam-filtered: no API write at all
+            if not obs.created:
+                if not obs.record.handle:
+                    # Another thread is creating this record right now
+                    # (ADVICE r4 race: both saw no handle and both
+                    # POSTed). The count is already aggregated; skip the
+                    # write — the next repeat PATCHes it in.
+                    return
                 patch = {
-                    "count": rec.count,
+                    "count": obs.record.count,
                     "lastTimestamp": kube_wire.rfc3339(now),
                 }
                 try:
                     self._request(
                         "PATCH",
-                        f"/api/v1/namespaces/{ns}/events/{rec.handle}",
+                        f"/api/v1/namespaces/{ns}/events/"
+                        f"{obs.record.handle}",
                         patch,
                         content_type="application/merge-patch+json",
                     )
                     return
                 except NotFound:
                     # The stored Event was GC'd server-side (events have
-                    # a TTL on real clusters): re-create below.
+                    # a TTL on real clusters): re-create below and stash
+                    # the fresh handle on the same record.
                     pass
             out = self._request(
                 "POST", f"/api/v1/namespaces/{ns}/events",
                 kube_wire.event_to_k8s(
-                    kind, name, ns, reason, message, ts=now,
+                    kind, name, ns, reason, obs.message, ts=now,
                 ),
             )
             self._events.set_handle(
-                ns, kind, name, reason, message,
-                (out.get("metadata") or {}).get("name"),
+                obs.key, (out.get("metadata") or {}).get("name"),
             )
         except Exception:
             # Event recording is best-effort everywhere (the reference's
