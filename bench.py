@@ -92,7 +92,9 @@ def bench_mnist() -> dict:
 
     rates = []
     end = warm
-    for _ in range(3):
+
+    def window():
+        nonlocal end
         end += total_steps
         t0 = time.perf_counter()
         loop.config.total_steps = end
@@ -101,11 +103,25 @@ def bench_mnist() -> dict:
         rates.append(total_steps / (time.perf_counter() - t0))
         if reached != end:
             raise RuntimeError(f"expected step {end}, got {reached}")
+
+    # Self-escalating protocol (VERDICT r4 #9): start with 3 windows; if
+    # the min-to-max spread exceeds 1.5x the tunnel is having a noisy
+    # day — keep adding windows (up to 9) so the median is taken over
+    # enough samples to mean something. The escalation itself ships in
+    # the artifact (n + spread), so a wide capture is visible, never
+    # silent (r4 recorded 161.6-371.8 over n=3).
+    for _ in range(3):
+        window()
+    escalated = False
+    while max(rates) > 1.5 * min(rates) and len(rates) < 9:
+        escalated = True
+        window()
     return {
         "median": sorted(rates)[len(rates) // 2],
         "min": min(rates),
         "max": max(rates),
         "n": len(rates),
+        "escalated": escalated,
     }
 
 
@@ -197,6 +213,7 @@ def main() -> None:
             "min": round(mnist["min"], 2),
             "max": round(mnist["max"], 2),
             "n": mnist["n"],
+            "escalated": mnist["escalated"],
         },
         "mnist_vs_reference": round(
             mnist["median"] / REFERENCE_STEPS_PER_SEC, 2

@@ -128,8 +128,16 @@ def mha(
         # keep those on XLA. The sequence must also tile into blocks >= 128
         # (a seq like 8x<prime> would degrade to 8-wide blocks and a
         # quadratically larger sequential grid — far slower than XLA).
+        # Inside a PARTIALLY-manual shard_map region (e.g. the GPipe
+        # stage, manual over pp only) XLA refuses to auto-partition a
+        # Mosaic kernel over the remaining axes — "Mosaic kernels cannot
+        # be automatically partitioned". A non-empty varying-mesh-axes
+        # set on the operand is exactly that context; route to XLA there.
+        # (Fully-manual regions like ring attention do their own math.)
+        vma = getattr(jax.typeof(q), "vma", None) or frozenset()
         use_flash = (
             _default_backend() == "tpu"
+            and not vma
             and q.shape[1] == k.shape[1]    # kernel assumes q_len == k_len
             and q.shape[1] >= 256
             and q.shape[3] in (64, 128, 256)

@@ -144,6 +144,25 @@ def rope_full_tables(
     )
 
 
+def _out_struct(shape, dtype, *operands) -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct for a pallas_call output, carrying the union of
+    the operands' varying-mesh-axes (vma) when tracing inside a manual
+    ``shard_map`` (e.g. the GPipe pp stage): shard_map's check rejects a
+    pallas out_shape with no vma annotation, and a wrong/empty one breaks
+    the downstream psum typing. Outside shard_map vma is empty and the
+    kwarg is a no-op."""
+    vma = frozenset()
+    seen = False
+    for op in operands:
+        v = getattr(jax.typeof(op), "vma", None)
+        if v is not None:
+            seen = True
+            vma |= v
+    if not seen:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
 def _roll_half(x: jax.Array, interpret: bool) -> jax.Array:
     """Rotate the lane (last) axis by half its width: [x1|x2] -> [x2|x1].
     A d/2 shift is its own inverse mod d, so direction doesn't matter."""
@@ -512,8 +531,8 @@ def _fwd_wide(
             ),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, s, 128), jnp.float32),
+            _out_struct((b, h, s, d), q.dtype, q, k, v),
+            _out_struct((b, h, s, 128), jnp.float32, q, k, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # m
@@ -926,14 +945,16 @@ def _bwd(
                 pl.BlockSpec((1, 1, block_k, d), lambda b, h: (b, h, 0, 0)),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+                _out_struct((b, h, s, d), q.dtype, q, k, v, do),
                 # No cross-program accumulation here, so dk/dv can leave
                 # in their final dtype — fp32 staging is only needed when
                 # a GQA fold still has to sum query-head groups.
-                jax.ShapeDtypeStruct(
-                    (b, h, s, d), jnp.float32 if rep > 1 else k.dtype),
-                jax.ShapeDtypeStruct(
-                    (b, h, s, d), jnp.float32 if rep > 1 else v.dtype),
+                _out_struct(
+                    (b, h, s, d), jnp.float32 if rep > 1 else k.dtype,
+                    q, k, v, do),
+                _out_struct(
+                    (b, h, s, d), jnp.float32 if rep > 1 else v.dtype,
+                    q, k, v, do),
             ],
             scratch_shapes=[
                 pltpu.VMEM((block_k, d), jnp.float32),   # dk (splash)
@@ -1001,8 +1022,8 @@ def _bwd(
             pl.BlockSpec((1, 1, block_k, d), lambda b, h, ki, qi: (b, h, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+            _out_struct((b, h, s, d), jnp.float32, q, k, v, do),
+            _out_struct((b, h, s, d), jnp.float32, q, k, v, do),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -1039,7 +1060,7 @@ def _bwd(
         out_specs=pl.BlockSpec(
             (1, 1, block_q, d), lambda b, h, qi, ki: (b, h, qi, 0)
         ),
-        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        out_shape=_out_struct((b, h, s, d), q.dtype, q, k, v, do),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta, *seg_inputs, *rope_inputs)
