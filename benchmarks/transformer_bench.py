@@ -56,6 +56,10 @@ def main() -> None:
                    help="capacity factor (0 = config default)")
     p.add_argument("--moe-dispatch", default="auto",
                    choices=["auto", "einsum", "gather"])
+    p.add_argument("--moe-aux-weight", type=float, default=None,
+                   help="load-balance loss weight (None = config default)")
+    p.add_argument("--moe-z-weight", type=float, default=0.0,
+                   help="router z-loss weight (ST-MoE; 0 = off)")
     p.add_argument("--peak-tflops", type=float, default=DEFAULT_PEAK_TFLOPS)
     p.add_argument("--no-remat", action="store_true")
     p.add_argument("--quant", default="", choices=["", "int8", "int8_fused"],
@@ -87,6 +91,10 @@ def main() -> None:
         cfg = cfg.replace(moe_capacity_factor=args.moe_capacity_factor)
     if args.moe_dispatch != "auto":
         cfg = cfg.replace(moe_dispatch=args.moe_dispatch)
+    if args.moe_aux_weight is not None:
+        cfg = cfg.replace(moe_aux_weight=args.moe_aux_weight)
+    if args.moe_z_weight:
+        cfg = cfg.replace(moe_router_z_weight=args.moe_z_weight)
     params = tfm.init_params(cfg, jax.random.key(0))
     n_params = tfm.count_params(params)
     tx = optax.adamw(1e-4, b1=0.9, b2=0.95)
@@ -100,28 +108,30 @@ def main() -> None:
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt, tokens):
-        (loss, _), g = jax.value_and_grad(
+        (loss, m), g = jax.value_and_grad(
             lambda p: tfm.next_token_loss(
                 cfg, p, {"tokens": tokens}, loss_chunk=args.loss_chunk
             ),
             has_aux=True,
         )(params)
         u, opt = tx.update(g, opt, params)
-        return optax.apply_updates(params, u), opt, loss
+        drop = m.get("moe_drop_rate", jnp.zeros(()))
+        return optax.apply_updates(params, u), opt, loss, drop
 
     # Completion is forced by fetching the final loss VALUE: donated state
     # chains the steps, so the last loss transitively waits for all of them.
     # (block_until_ready alone is not trustworthy on remote-tunnel device
     # platforms, where it can return before execution finishes.)
     for _ in range(args.warmup):
-        params, opt, loss = step(params, opt, tokens)
+        params, opt, loss, drop = step(params, opt, tokens)
     float(loss)
 
     t0 = time.perf_counter()
     for _ in range(args.steps):
-        params, opt, loss = step(params, opt, tokens)
+        params, opt, loss, drop = step(params, opt, tokens)
     final_loss = float(loss)
     dt = (time.perf_counter() - t0) / args.steps
+    final_drop = float(drop)
 
     tokens_per_step = args.batch * args.seq
     tps = tokens_per_step / dt
@@ -142,6 +152,8 @@ def main() -> None:
         "tokens_per_sec": round(tps),
         "mfu": round(mfu, 4),
         "loss": round(final_loss, 4),
+        **({"moe_drop_rate": round(final_drop, 4)} if args.moe_experts
+           else {}),
     }))
 
 

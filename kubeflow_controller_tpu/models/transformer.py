@@ -88,6 +88,11 @@ class TransformerConfig:
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Router z-loss (ST-MoE): mean(logsumexp(router_logits)^2) keeps the
+    # router's logit scale bounded, which sharpens routing and cuts
+    # dropped tokens at tight capacity factors (the cf 1.0 quality lever,
+    # VERDICT r4 #5). 0 disables.
+    moe_router_z_weight: float = 0.0
     moe_group_size: int = 1024
     # Dispatch strategy. "auto" = the one-hot einsum form everywhere: it is
     # what GSPMD turns into the token->expert all_to_all on an ep-sharded
@@ -401,9 +406,10 @@ def _moe_ffn(
     x = h.reshape(G, group, d)
     x = _constrain(x, P(BATCH_AXES, None, None))
     router = lp["w_router"].astype(jnp.float32)
-    probs = jax.nn.softmax(
-        x.astype(jnp.float32) @ router, axis=-1
-    )                                                   # [G, g, E]
+    logits = x.astype(jnp.float32) @ router             # [G, g, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    # Router z-loss (0 when unweighted — the stack below is free).
+    z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
     cap = int(max(
         1, round(cfg.moe_top_k * group / E * cfg.moe_capacity_factor)
     ))
@@ -479,7 +485,15 @@ def _moe_ffn(
         )
     out_e = _constrain(out_e, P("ep", ("dp", "fsdp"), None, None))
     out = out_from(out_e).reshape(b, s, d)
-    return _constrain(out, _act_spec(cfg)), aux_fraction
+    # Dropped-token fraction: of the n*top_k routing decisions, how many
+    # lost their capacity slot (the quality price of a tight cf —
+    # measured, not guessed; VERDICT r4 #5).
+    kept = jnp.stack([k.astype(jnp.float32) for (_, _, _, k) in picks])
+    drop_rate = 1.0 - jnp.mean(kept)
+    return (
+        _constrain(out, _act_spec(cfg)),
+        jnp.stack([aux_fraction, z_loss, drop_rate]),
+    )
 
 
 def _moe_dispatch_einsum(cfg, x, picks, G, group, E, cap):
@@ -619,7 +633,7 @@ def _layer(
         up = checkpoint_name(dot(h, lp["w_up"]), "ffn_up")
         prod = checkpoint_name(gate * up, "ffn_prod")
         down = dot(prod, lp["w_down"])
-        aux = jnp.zeros((), jnp.float32)
+        aux = jnp.zeros((3,), jnp.float32)
     return x + _constrain(down, _act_spec(cfg)), aux
 
 
@@ -659,8 +673,10 @@ def forward_hidden(
     )
     if cfg.remat:
         body = jax.checkpoint(body, policy=_remat_policy(cfg))
-    x, aux = lax.scan(body, x, params["layers"])
-    return rmsnorm(x, params["final_norm"], cfg.norm_eps), aux.sum()
+    x, aux = lax.scan(body, x, params["layers"])       # aux: [L, 3]
+    # (load-balance sum, z-loss sum, drop-rate mean) across layers.
+    aux = jnp.stack([aux[:, 0].sum(), aux[:, 1].sum(), aux[:, 2].mean()])
+    return rmsnorm(x, params["final_norm"], cfg.norm_eps), aux
 
 
 def forward_hidden_pp(
@@ -716,7 +732,7 @@ def forward_hidden_pp(
     )
     x = run(params["layers"], x, (positions, segment_ids))
     return rmsnorm(x, params["final_norm"], cfg.norm_eps), jnp.zeros(
-        (), jnp.float32)
+        (3,), jnp.float32)
 
 
 def forward(
@@ -915,8 +931,12 @@ def next_token_loss(
     ce = loss
     metrics = {"accuracy": acc, "perplexity": jnp.exp(ce)}
     if cfg.moe_experts:
-        loss = loss + cfg.moe_aux_weight * aux
-        metrics["moe_aux"] = aux
+        # aux = (load-balance sum, router z-loss sum, drop-rate mean).
+        loss = loss + cfg.moe_aux_weight * aux[0]
+        if cfg.moe_router_z_weight:
+            loss = loss + cfg.moe_router_z_weight * aux[1]
+        metrics["moe_aux"] = aux[0]
+        metrics["moe_drop_rate"] = aux[2]
     return loss, metrics
 
 
