@@ -409,15 +409,67 @@ def _fwd_kernel(
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
+    def _compute_diag(g: int):
+        """Diagonal tile of a multi-block causal grid, splash-decomposed:
+        q-chunk i dots only against its live key prefix, then merges its
+        FLAT chunk softmax into the running online stats for exactly its
+        rows (rows are disjoint across chunks). Skips the dead triangle —
+        (G+1)/2G of the dense tile's score+PV work — where the plain
+        masked trace computes and discards it."""
+        q = rot_q(q_ref[0, 0])
+        k = rot_k(k_ref[0, 0])
+        v = v_ref[0, 0]
+        chunk = block_q // g
+        scores = []
+        for i in range(g):
+            kw = (i + 1) * chunk
+            scores.append(jax.lax.dot_general(
+                q[i * chunk:(i + 1) * chunk], k[:kw],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale)
+        for i in range(g):
+            kw = (i + 1) * chunk
+            lo = i * chunk
+            s = scores[i]
+            mask = _block_mask(
+                i, 0,
+                seg_q_ref[0, 0][lo:lo + chunk] if has_segments else None,
+                seg_k_ref[0, 0][:kw] if has_segments else None,
+                True, chunk, kw, s.shape,
+            )
+            s = jnp.where(mask, s, NEG_INF)
+            m_prev = m_scr[lo:lo + chunk, :1]
+            l_prev = l_scr[lo:lo + chunk, :1]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc_scr[lo:lo + chunk] = acc_scr[lo:lo + chunk] * alpha + jnp.dot(
+                p.astype(v.dtype), v[:kw], preferred_element_type=jnp.float32
+            )
+            m_scr[lo:lo + chunk] = jnp.broadcast_to(
+                m_new, (chunk, m_scr.shape[1]))
+            l_scr[lo:lo + chunk] = jnp.broadcast_to(
+                l_new, (chunk, l_scr.shape[1]))
+
     if causal:
         # Blocks fully below the diagonal (every query sees every key)
         # take a dense trace with no iota/compare/select VPU work; only
         # diagonal-crossing blocks pay for the causal mask.
         on_diag = qi * block_q < ki * block_k + block_k - 1
+        # With square blocks, every diagonal-crossing block IS the
+        # diagonal tile (qi == ki) and takes the splash decomposition;
+        # rectangular blocks keep the dense masked trace.
+        diag_g = _splash_chunks(block_q, block_k, True, has_segments, True)
 
         @pl.when(live & on_diag)
         def _masked():
-            _compute(True)
+            if diag_g > 1:
+                _compute_diag(diag_g)
+            else:
+                _compute(True)
 
         @pl.when(live & jnp.logical_not(on_diag))
         def _dense():
@@ -591,8 +643,7 @@ def _bwd_dkdv_kernel(
 
     live = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
 
-    @pl.when(live)
-    def _compute():
+    def _load():
         q = q_ref[0, 0]                                # [BQ, D]
         k = k_ref[0, 0]                                # [BK, D]
         v = v_ref[0, 0]                                # [BK, D]
@@ -606,6 +657,10 @@ def _bwd_dkdv_kernel(
         else:           # 128-lane broadcast layout: lane 0 carries it
             lse = lse_ref[0, 0][:, :1]                 # [BQ, 1]
             delta = delta_ref[0, 0][:, :1]
+        return q, k, v, do, lse, delta
+
+    def _compute(apply_causal: bool):
+        q, k, v, do, lse, delta = _load()
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -614,7 +669,7 @@ def _bwd_dkdv_kernel(
             qi, ki,
             seg_q_ref[0, 0] if has_segments else None,
             seg_k_ref[0, 0] if has_segments else None,
-            causal, block_q, block_k, s.shape,
+            apply_causal, block_q, block_k, s.shape,
         )
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
@@ -635,6 +690,66 @@ def _bwd_dkdv_kernel(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    def _compute_diag(g: int):
+        # Diagonal tile: score recompute, dv, dp and dk all run on live
+        # key prefixes only (mirror of the forward's _compute_diag).
+        q, k, v, do, lse, delta = _load()
+        chunk = block_q // g
+        scores = []
+        for i in range(g):
+            kw = (i + 1) * chunk
+            scores.append(jax.lax.dot_general(
+                q[i * chunk:(i + 1) * chunk], k[:kw],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale)
+        for i in range(g):
+            kw = (i + 1) * chunk
+            lo = i * chunk
+            s = scores[i]
+            mask = _block_mask(
+                i, 0,
+                seg_q_ref[0, 0][lo:lo + chunk] if has_segments else None,
+                seg_k_ref[0, 0][:kw] if has_segments else None,
+                True, chunk, kw, s.shape,
+            )
+            s = jnp.where(mask, s, NEG_INF)
+            do_i = do[lo:lo + chunk]
+            p = jnp.exp(s - lse[lo:lo + chunk])
+            dv_scr[:kw] += jax.lax.dot_general(
+                p.astype(do.dtype), do_i, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do_i, v[:kw], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta[lo:lo + chunk]) * sm_scale
+            dk_scr[:kw] += jax.lax.dot_general(
+                ds.astype(q.dtype), q[lo:lo + chunk],
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    if causal:
+        # Fully-live blocks (every query sees every key of this k-block)
+        # skip mask VPU work; diagonal tiles take the splash form.
+        on_diag = qi * block_q < ki * block_k + block_k - 1
+        diag_g = _splash_chunks(block_q, block_k, True, has_segments, True)
+
+        @pl.when(live & on_diag)
+        def _masked():
+            if diag_g > 1:
+                _compute_diag(diag_g)
+            else:
+                _compute(True)
+
+        @pl.when(live & jnp.logical_not(on_diag))
+        def _dense():
+            _compute(False)
+    else:
+        _compute(False)
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -825,8 +940,7 @@ def _bwd_dq_kernel(
 
     live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
 
-    @pl.when(live)
-    def _compute():
+    def _load():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
@@ -840,6 +954,10 @@ def _bwd_dq_kernel(
         else:
             lse = lse_ref[0, 0][:, :1]
             delta = delta_ref[0, 0][:, :1]
+        return q, k, v, do, lse, delta
+
+    def _compute(apply_causal: bool):
+        q, k, v, do, lse, delta = _load()
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -848,7 +966,7 @@ def _bwd_dq_kernel(
             qi, ki,
             seg_q_ref[0, 0] if has_segments else None,
             seg_k_ref[0, 0] if has_segments else None,
-            causal, block_q, block_k, s.shape,
+            apply_causal, block_q, block_k, s.shape,
         )
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
@@ -861,6 +979,58 @@ def _bwd_dq_kernel(
         dq_scr[...] += jnp.dot(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32
         )
+
+    def _compute_diag(g: int):
+        # Diagonal tile: all five matmuls run on live key prefixes only
+        # (same decomposition as the forward's _compute_diag).
+        q, k, v, do, lse, delta = _load()
+        chunk = block_q // g
+        scores = []
+        for i in range(g):
+            kw = (i + 1) * chunk
+            scores.append(jax.lax.dot_general(
+                q[i * chunk:(i + 1) * chunk], k[:kw],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale)
+        for i in range(g):
+            kw = (i + 1) * chunk
+            lo = i * chunk
+            s = scores[i]
+            mask = _block_mask(
+                i, 0,
+                seg_q_ref[0, 0][lo:lo + chunk] if has_segments else None,
+                seg_k_ref[0, 0][:kw] if has_segments else None,
+                True, chunk, kw, s.shape,
+            )
+            s = jnp.where(mask, s, NEG_INF)
+            p = jnp.exp(s - lse[lo:lo + chunk])
+            dp = jax.lax.dot_general(
+                do[lo:lo + chunk], v[:kw], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta[lo:lo + chunk]) * sm_scale
+            dq_scr[lo:lo + chunk] += jnp.dot(
+                ds.astype(k.dtype), k[:kw],
+                preferred_element_type=jnp.float32,
+            )
+
+    if causal:
+        on_diag = qi * block_q < ki * block_k + block_k - 1
+        diag_g = _splash_chunks(block_q, block_k, True, has_segments, True)
+
+        @pl.when(live & on_diag)
+        def _masked():
+            if diag_g > 1:
+                _compute_diag(diag_g)
+            else:
+                _compute(True)
+
+        @pl.when(live & jnp.logical_not(on_diag))
+        def _dense():
+            _compute(False)
+    else:
+        _compute(False)
 
     @pl.when(ki == nk - 1)
     def _finish():
