@@ -307,6 +307,15 @@ def prefill(
             moe_capacity_factor=float(cfg.moe_experts) / cfg.moe_top_k
         )
 
+    from kubeflow_controller_tpu.ops.flash_attention import rope_full_tables
+
+    # Fused-rope tables, built once and shared by every layer (the
+    # training path's trick): on the flash path the rotation runs on
+    # VMEM tiles instead of materialising rotated q/k per layer. The
+    # CACHE must still hold ROTATED keys (decode_step attends against it
+    # with rotated queries), so k is additionally rotated for storage.
+    tables = rope_full_tables(positions, hd, cfg.rope_theta)
+
     def body(x, lp):
         # Mirrors transformer._layer (+ per-layer k/v out, int8 weight
         # resolution, no sharding constraints). Drift between the copies
@@ -317,9 +326,8 @@ def prefill(
         q = (h @ _w(lp, "wq", dt)).reshape(b, s, cfg.n_heads, hd)
         k = (h @ _w(lp, "wk", dt)).reshape(b, s, cfg.n_kv_heads, hd)
         v = (h @ _w(lp, "wv", dt)).reshape(b, s, cfg.n_kv_heads, hd)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
-        attn = mha(q, k, v, causal=True, impl=attn_impl)
+        attn = mha(q, k, v, causal=True, impl=attn_impl, rope_tables=tables)
+        k = rope(k, positions, cfg.rope_theta)       # rotated for the cache
         x = x + attn.reshape(b, s, -1) @ _w(lp, "wo", dt)
         h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         if cfg.moe_experts:
