@@ -146,13 +146,14 @@ def _decode_layer(
     lp: Params,
     x: jax.Array,               # [B, 1, D_model]
     pos: jax.Array,             # [] int32 current position
-    k_cache: jax.Array,         # [B, max_seq, KVH, D]
-    v_cache: jax.Array,
+    layer: jax.Array,           # [] int32 layer index into the cache
+    k_all: jax.Array,           # [L, B, max_seq, KVH, D] — FULL cache
+    v_all: jax.Array,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     b = x.shape[0]
     hd = cfg.head_dim
     dt = cfg.dtype
-    max_seq = k_cache.shape[1]
+    max_seq = k_all.shape[2]
 
     h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
     q = (h @ _w(lp, "wq", dt)).reshape(b, 1, cfg.n_heads, hd)
@@ -161,8 +162,15 @@ def _decode_layer(
     positions = jnp.broadcast_to(pos[None, None], (b, 1))
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-    v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    # One-ROW in-place writes on the full-cache carry ([1,B,1,KVH,D]
+    # each): the pre-round-5 form emitted per-layer cache copies as scan
+    # outputs — a fresh full-cache write every decoded token.
+    k_all = lax.dynamic_update_slice(
+        k_all, k[None].astype(k_all.dtype), (layer, 0, pos, 0, 0))
+    v_all = lax.dynamic_update_slice(
+        v_all, v[None].astype(v_all.dtype), (layer, 0, pos, 0, 0))
+    k_cache = k_all[layer]                       # read-only gather
+    v_cache = v_all[layer]
 
     # GQA attention of the 1-token query against the cache, fp32 softmax.
     # Grouped einsums keep the cache UN-repeated: decode is HBM-bound and
@@ -189,7 +197,7 @@ def _decode_layer(
         gate = jax.nn.silu(h @ _w(lp, "w_gate", dt))
         up = h @ _w(lp, "w_up", dt)
         x = x + (gate * up) @ _w(lp, "w_down", dt)
-    return x, k_cache, v_cache
+    return x, k_all, v_all
 
 
 def _moe_decode_ffn(
@@ -231,18 +239,27 @@ def decode_step(
     cache: KVCache,
 ) -> Tuple[jax.Array, KVCache]:
     """One token for every sequence in the batch; returns logits [B, vocab]
-    and the updated cache."""
+    and the updated cache.
+
+    The layer loop carries the WHOLE cache and writes each layer's new
+    k/v in place (``fori_loop`` carry + one-row dynamic_update_slice)
+    instead of emitting per-layer cache copies as ``lax.scan`` stacked
+    outputs — the scan form allocated and wrote a fresh full-cache
+    buffer every decode step (~400 MB/token at the bench shape; decode
+    is HBM-bound, so that was pure streamed-bytes overhead)."""
     x = params["embed"].astype(cfg.dtype)[tokens]     # [B, 1, D]
     pos = cache.length
 
-    def body(carry, layer_in):
-        x = carry
-        lp, kc, vc = layer_in
-        x, kc, vc = _decode_layer(cfg, lp, x, pos, kc, vc)
-        return x, (kc, vc)
+    def body(layer, state):
+        x, k_all, v_all = state
+        lp = jax.tree.map(
+            lambda p: lax.dynamic_index_in_dim(p, layer, keepdims=False),
+            params["layers"],
+        )
+        return _decode_layer(cfg, lp, x, pos, layer, k_all, v_all)
 
-    x, (k_new, v_new) = lax.scan(
-        body, x, (params["layers"], cache.k, cache.v)
+    x, k_new, v_new = lax.fori_loop(
+        0, cfg.n_layers, body, (x, cache.k, cache.v)
     )
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = _head_logits(cfg, params, x[:, 0])
