@@ -242,7 +242,6 @@ def setup_logging(args) -> int:
 
 
 def cmd_serve(args) -> int:
-    setup_logging(args)
     if args.cluster_url or args.kubeconfig or args.in_cluster:
         return _serve_remote(args)
     if getattr(args, "k8s_wire", False):
@@ -359,7 +358,6 @@ def cmd_apiserver(args) -> int:
     from kubeflow_controller_tpu.cluster.rest_server import RestServer
     from kubeflow_controller_tpu.util.signals import setup_signal_handler
 
-    setup_logging(args)
     cluster = FakeCluster(default_policy=PodRunPolicy(
         start_delay=args.pod_start_delay, run_duration=args.pod_run_duration
     ))
@@ -810,6 +808,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    # -v/--log-level live on the shared parent parser, so configure
+    # logging once for EVERY subcommand (not just the daemons — client
+    # verbs log kube/debug detail too).
+    setup_logging(args)
     try:
         return args.fn(args)
     except BrokenPipeError:

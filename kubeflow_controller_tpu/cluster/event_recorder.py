@@ -56,6 +56,11 @@ class EventRecord:
     # Backend-private handle for updating the stored record in place
     # (fake cluster: row index; k8s wire: the server-assigned Event name).
     handle: Any = None
+    # True while some caller owns the backend-create for this record
+    # (set for the observe() that returns created=True, cleared by
+    # set_handle/abort_create). Lets a later repeat RECOVER creation when
+    # the original POST failed, without reopening the duplicate-POST race.
+    creating: bool = False
 
 
 @dataclass
@@ -169,7 +174,9 @@ class EventAggregator:
                 key = (namespace, kind, name, reason, message)
             rec = self._cache.get(key)
             if rec is None:
-                rec = EventRecord(count=1, first_ts=now, last_ts=now)
+                rec = EventRecord(
+                    count=1, first_ts=now, last_ts=now, creating=True,
+                )
                 self._cache[key] = rec
                 while len(self._cache) > self._maxsize:
                     self._cache.popitem(last=False)
@@ -179,11 +186,31 @@ class EventAggregator:
             self._cache.move_to_end(key)
             return Observation(rec, False, eff, key)
 
+    def begin_create(self, key: Tuple) -> bool:
+        """Claim creation responsibility for a record whose original
+        creator failed (handle still unset, no creator in flight).
+        Exactly one concurrent caller gets True."""
+        with self._lock:
+            rec = self._cache.get(key)
+            if rec is None or rec.handle is not None or rec.creating:
+                return False
+            rec.creating = True
+            return True
+
+    def abort_create(self, key: Tuple) -> None:
+        """The claimed backend-create failed: release the claim so a
+        later occurrence can retry."""
+        with self._lock:
+            rec = self._cache.get(key)
+            if rec is not None:
+                rec.creating = False
+
     def set_handle(self, key: Tuple, handle: Any) -> None:
         with self._lock:
             rec = self._cache.get(key)
             if rec is not None:
                 rec.handle = handle
+                rec.creating = False
 
     def forget(self, key: Tuple) -> None:
         """Drop a key (e.g. the stored record vanished server-side and the
@@ -195,5 +222,15 @@ class EventAggregator:
         self, namespace: str, kind: str, name: str, reason: str,
         message: str,
     ) -> Optional[EventRecord]:
+        """Record for an event key: the exact-message record when one
+        exists, else the combined similar-event record this message would
+        have aggregated onto (observe() moves occurrences there once the
+        distinct-message threshold trips — without the fallback those
+        counts would be unreachable by callers holding the raw message)."""
         with self._lock:
-            return self._cache.get((namespace, kind, name, reason, message))
+            rec = self._cache.get((namespace, kind, name, reason, message))
+            if rec is not None:
+                return rec
+            return self._cache.get(
+                (namespace, kind, name, reason, AGGREGATE_PREFIX)
+            )

@@ -354,13 +354,17 @@ class KubeClusterClient:
             obs = self._events.observe(ns, kind, name, reason, message, now)
             if obs is None:
                 return          # spam-filtered: no API write at all
-            if not obs.created:
-                if not obs.record.handle:
-                    # Another thread is creating this record right now
-                    # (ADVICE r4 race: both saw no handle and both
-                    # POSTed). The count is already aggregated; skip the
-                    # write — the next repeat PATCHes it in.
+            creator = obs.created
+            if not creator and not obs.record.handle:
+                # No stored Event yet. Either another thread's create is
+                # in flight (skip — the count is aggregated, the next
+                # repeat PATCHes it in) or the original POST FAILED and
+                # nobody owns creation anymore — claim it, else this key
+                # would be silenced until LRU eviction.
+                creator = self._events.begin_create(obs.key)
+                if not creator:
                     return
+            if not creator:
                 patch = {
                     "count": obs.record.count,
                     "lastTimestamp": kube_wire.rfc3339(now),
@@ -379,15 +383,23 @@ class KubeClusterClient:
                     # a TTL on real clusters): re-create below and stash
                     # the fresh handle on the same record.
                     pass
-            out = self._request(
-                "POST", f"/api/v1/namespaces/{ns}/events",
-                kube_wire.event_to_k8s(
-                    kind, name, ns, reason, obs.message, ts=now,
-                ),
-            )
-            self._events.set_handle(
-                obs.key, (out.get("metadata") or {}).get("name"),
-            )
+            try:
+                out = self._request(
+                    "POST", f"/api/v1/namespaces/{ns}/events",
+                    kube_wire.event_to_k8s(
+                        kind, name, ns, reason, obs.message, ts=now,
+                    ),
+                )
+                handle = (out.get("metadata") or {}).get("name")
+            except Exception:
+                # Release the creation claim so a later occurrence can
+                # retry the POST (otherwise the key goes silent).
+                self._events.abort_create(obs.key)
+                raise
+            if handle:
+                self._events.set_handle(obs.key, handle)
+            else:
+                self._events.abort_create(obs.key)
         except Exception:
             # Event recording is best-effort everywhere (the reference's
             # EventRecorder is fire-and-forget too); never fail a reconcile

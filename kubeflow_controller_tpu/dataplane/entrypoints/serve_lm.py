@@ -168,7 +168,10 @@ def serve(
                 logits, cache = continue_fn(params, follow_up, cache)
             toks = gen.generate_from_cache(
                 cfg, params, logits, cache, max_new_tokens,
-                temperature=temperature, rng=rng,
+                temperature=temperature,
+                # Distinct randomness per turn: the same key would make
+                # every turn draw an identical key sequence.
+                rng=None if rng is None else jax.random.fold_in(rng, turn),
             )
             replies.append(np.asarray(jax.device_get(toks)))
             if turn + 1 < turns:

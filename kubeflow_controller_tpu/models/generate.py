@@ -301,8 +301,8 @@ def prefill(
 
     Requires a FRESH cache: positions start at 0 and k/v land at offset 0.
     To extend an existing conversation (multi-turn), use
-    ``prefill_tokenwise`` — new tokens must attend to the prior cache,
-    which the block pass does not model."""
+    ``prefill_continue`` — one block forward whose new tokens attend to
+    the prior cache plus intra-block causal positions."""
     from kubeflow_controller_tpu.ops.attention import mha
 
     b, s = prompt.shape

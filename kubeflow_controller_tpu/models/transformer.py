@@ -292,18 +292,14 @@ def _remat_policy(cfg: TransformerConfig):
     names = []
     if cfg.moe_experts:
         names += ["moe_combine", "moe_dispatch"]
-    if cfg.quant == "int8":
+    if cfg.quant.startswith("int8"):
         # Save the quantized operands (int8: half the bf16 bytes) so the
         # backward re-forward reads them instead of re-running the
-        # abs-max/round/clip chains.
-        from kubeflow_controller_tpu.ops.quant import INT8_SAVE_NAMES
-
-        names += list(INT8_SAVE_NAMES)
-    elif cfg.quant == "int8_fused":
-        # Composed-path names only (fallback shapes + the int8 dw/dx):
-        # the pallas outputs themselves recompute — saving them by name
-        # measured SLOWER (304.8 vs 288.2 ms) at the flagship's memory
-        # pressure.
+        # abs-max/round/clip chains. Covers "int8_fused" too — its
+        # fallback shapes and int8 dw/dx use the composed path; the
+        # pallas outputs themselves recompute (saving them by name
+        # measured SLOWER, 304.8 vs 288.2 ms, at the flagship's memory
+        # pressure).
         from kubeflow_controller_tpu.ops.quant import INT8_SAVE_NAMES
 
         names += list(INT8_SAVE_NAMES)

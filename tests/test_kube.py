@@ -931,6 +931,47 @@ class TestKubeProtocol:
         assert sum(created) == 1
         assert agg.get("ns", "Pod", "p", "Fail", "boom").count == 8
 
+    def test_failed_event_create_is_recoverable(self):
+        """Review r5: if the creating POST fails, the key must not be
+        silenced forever — a later occurrence can claim creation
+        (begin_create), exactly one at a time, and abort_create releases
+        the claim for the next retry."""
+        from kubeflow_controller_tpu.cluster.event_recorder import (
+            EventAggregator,
+        )
+
+        agg = EventAggregator()
+        obs1 = agg.observe("ns", "Pod", "p", "Fail", "boom", 1.0)
+        assert obs1.created            # owns creation; POST "fails" here
+        obs2 = agg.observe("ns", "Pod", "p", "Fail", "boom", 2.0)
+        assert not obs2.created and obs2.record.handle is None
+        # creator still (nominally) in flight: claim denied
+        assert not agg.begin_create(obs2.key)
+        agg.abort_create(obs1.key)     # the failed creator releases
+        assert agg.begin_create(obs2.key)       # recovery claim granted
+        assert not agg.begin_create(obs2.key)   # ...to exactly one caller
+        agg.set_handle(obs2.key, "ev-1")        # retry POST succeeded
+        assert not agg.begin_create(obs2.key)   # handle set: no claims
+        assert agg.observe(
+            "ns", "Pod", "p", "Fail", "boom", 3.0
+        ).record.handle == "ev-1"
+
+    def test_aggregated_event_count_reachable_by_raw_message(self):
+        """Review r5: once similar-event aggregation trips, get() for a
+        raw message that collapsed onto the combined record must reach
+        the combined count instead of returning nothing."""
+        from kubeflow_controller_tpu.cluster.event_recorder import (
+            EventAggregator,
+        )
+
+        agg = EventAggregator()
+        for i in range(14):
+            agg.observe("ns", "TPUJob", "j", "BackOff", f"pod {i} died", i)
+        rec = agg.get("ns", "TPUJob", "j", "BackOff", "pod 13 died")
+        assert rec is not None and rec.count >= 2   # the combined record
+        # pre-threshold messages keep their own records
+        assert agg.get("ns", "TPUJob", "j", "BackOff", "pod 0 died").count == 1
+
     def test_event_posted_to_involved_objects_namespace(self, kube, cluster):
         """ADVICE r3: events for an object in another namespace must land
         in THAT namespace (a real apiserver rejects a mismatch between the
