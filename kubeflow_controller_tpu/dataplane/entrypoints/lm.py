@@ -95,6 +95,7 @@ def train(
     checkpoint_every: int = 0,
     pack: bool = False,
     quant: str = "",
+    grad_accum: int = 1,
 ) -> Dict[str, float]:
     ctx = ctx or ProcessContext.from_env()
     mlog = metrics_sink.from_context(ctx)
@@ -122,6 +123,7 @@ def train(
             total_steps=total_steps,
             log_every=max(1, total_steps // 10),
             checkpoint_every=checkpoint_every,
+            grad_accum=grad_accum,
         ),
         model_dir=model_dir or ctx.model_dir,
         param_shardings=jax.tree.map(
@@ -175,6 +177,9 @@ def main(argv=None) -> int:
                    help="packed documents per row (segment_ids; id 0 = pad)")
     p.add_argument("--quant", default="", choices=["", "int8"],
                    help="int8 = linear projections on the int8 MXU path")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="microbatches per optimizer step (gradient "
+                        "accumulation; batch must divide)")
     args = p.parse_args(argv)
     ctx = initialize_from_env()
     metrics = train(
@@ -188,6 +193,7 @@ def main(argv=None) -> int:
         attn=args.attn,
         pack=args.pack,
         quant=args.quant,
+        grad_accum=args.grad_accum,
     )
     return 0 if metrics.get("final_step", 0) > 0 else 1
 

@@ -197,6 +197,15 @@ class TrainLoopConfig:
     # ~1 ms MNIST step is otherwise dominated by the round-trip.
     # Checkpoint/eval/log cadences then land on K-step boundaries.
     steps_per_call: int = 1
+    # > 1: split each step's batch into this many microbatches, scan the
+    # forward/backward over them accumulating gradients, and apply ONE
+    # optimizer update on the mean — the standard way to train a global
+    # batch whose activations don't fit HBM. The batch's leading dim must
+    # divide. Weight/optimizer traffic is paid once per step (a paired
+    # measurement on the v5e even ran the accumulated form FASTER than
+    # the monolithic batch at some shapes — benchmarks/RESULTS.md round-5
+    # GPipe section). Composes with steps_per_call.
+    grad_accum: int = 1
     # Periodic validation (parity with the reference's post-train validation
     # cross-entropy report, mnist_replica.py:266-269, made continuous):
     # every eval_every steps, run eval_fn over eval_batches batches from the
@@ -314,22 +323,61 @@ class TrainLoop:
             # the dispatch loop free of device syncs.
             step_rng = jax.random.fold_in(rng, state.step)
 
-            if self.stateful:
-                def lossf(params):
-                    return self.loss_fn(
-                        params, state.model_state, batch, step_rng
-                    )
-            else:
-                def lossf(params):
-                    return self.loss_fn(params, batch, step_rng)
+            def grads_of(b, model_state, mb_rng):
+                if self.stateful:
+                    def lossf(params):
+                        return self.loss_fn(params, model_state, b, mb_rng)
+                else:
+                    def lossf(params):
+                        return self.loss_fn(params, b, mb_rng)
+                (loss, aux), grads = jax.value_and_grad(
+                    lossf, has_aux=True
+                )(state.params)
+                if self.stateful:
+                    metrics, new_model_state = aux
+                else:
+                    metrics, new_model_state = aux, model_state
+                return grads, loss, metrics, new_model_state
 
-            (loss, aux), grads = jax.value_and_grad(lossf, has_aux=True)(
-                state.params
-            )
-            if self.stateful:
-                metrics, model_state = aux
+            if cfg.grad_accum > 1:
+                # Microbatch scan with gradient accumulation: batch dim
+                # splits [A, B/A, ...] (the constraint keeps the data
+                # sharding on the new batch dim so SPMD doesn't
+                # repartition), grads average across microbatches,
+                # stateful model state (e.g. BN stats) threads through
+                # sequentially like it would across real steps.
+                A = cfg.grad_accum
+                micro = jax.tree.map(
+                    lambda x, s: jax.lax.with_sharding_constraint(
+                        x.reshape(A, x.shape[0] // A, *x.shape[1:]),
+                        NamedSharding(s.mesh, P(None, *s.spec)),
+                    ),
+                    batch,
+                    jax.tree.map(lambda _: batch_sharding(self.mesh), batch),
+                )
+
+                def acc(carry, mb_in):
+                    gacc, model_state = carry
+                    mb, i = mb_in
+                    g, loss, metrics, model_state = grads_of(
+                        mb, model_state, jax.random.fold_in(step_rng, i)
+                    )
+                    return (
+                        jax.tree.map(jnp.add, gacc, g), model_state,
+                    ), (loss, metrics)
+
+                g0 = jax.tree.map(jnp.zeros_like, state.params)
+                (gsum, model_state), (losses, metricses) = jax.lax.scan(
+                    acc, (g0, state.model_state),
+                    (micro, jnp.arange(A)),
+                )
+                grads = jax.tree.map(lambda g: g / A, gsum)
+                loss = losses.mean()
+                metrics = jax.tree.map(lambda m: m.mean(axis=0), metricses)
             else:
-                metrics, model_state = aux, state.model_state
+                grads, loss, metrics, model_state = grads_of(
+                    batch, state.model_state, step_rng
+                )
             updates, opt_state = self.tx.update(
                 grads, state.opt_state, state.params
             )
