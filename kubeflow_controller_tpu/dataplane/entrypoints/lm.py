@@ -82,6 +82,65 @@ def synthetic_lm(
         yield {"tokens": toks, "segment_ids": segs}
 
 
+def token_bin_lm(
+    path: str, batch_size: int, seq_len: int, seed: int = 0,
+    vocab_size: Optional[int] = None,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Real-data pretraining stream: a flat binary file of token ids —
+    the standard tokenised-corpus format (uint16 for vocabs < 65536,
+    uint32 otherwise; a companion ``<path>.meta.json`` may carry
+    ``{"dtype": ..., "vocab_size": ...}``). The file is memmapped (never
+    loaded into RAM) and each batch is ``batch_size`` random
+    ``seq_len+1`` crops — the usual i.i.d.-offsets pretraining sampler.
+    Distinct ``seed`` per data shard gives multi-host processes disjoint
+    sample streams.
+
+    Token ids are range-checked against the model vocab (same reasoning
+    as serve_lm's prompt check: XLA clamps out-of-range gather indices,
+    which would turn a tokenizer mismatch into silently-garbage training
+    with exit code 0)."""
+    import json
+    import os
+
+    meta = {}
+    mpath = path + ".meta.json"
+    if os.path.exists(mpath):
+        with open(mpath) as f:
+            meta = json.load(f)
+    dtype = np.dtype(meta.get("dtype", "uint16"))
+    data = np.memmap(path, dtype=dtype, mode="r")
+    if len(data) < seq_len + 2:
+        raise ValueError(
+            f"{path}: {len(data)} tokens < seq_len+2 ({seq_len + 2})"
+        )
+    if vocab_size is not None and meta.get("vocab_size") is not None:
+        if int(meta["vocab_size"]) > vocab_size:
+            raise ValueError(
+                f"{path}: corpus vocab {meta['vocab_size']} exceeds model "
+                f"vocab {vocab_size} (tokenizer mismatch)"
+            )
+    rng = np.random.default_rng(seed)
+    span = seq_len + 1
+    n_starts = len(data) - span
+
+    def stream() -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            idx = rng.integers(0, n_starts + 1, (batch_size,))
+            toks = np.stack([np.asarray(data[i:i + span]) for i in idx])
+            if vocab_size is not None:
+                mx = int(toks.max())
+                if mx >= vocab_size:
+                    raise ValueError(
+                        f"{path}: token id {mx} out of range for model "
+                        f"vocab {vocab_size} (tokenizer mismatch)"
+                    )
+            yield {"tokens": toks.astype(np.int32)}
+
+    # Validation above runs EAGERLY (a bare generator would defer it to
+    # the first next(), after the expensive model init).
+    return stream()
+
+
 def train(
     ctx: Optional[ProcessContext] = None,
     config: str = "tiny",
@@ -96,6 +155,7 @@ def train(
     pack: bool = False,
     quant: str = "",
     grad_accum: int = 1,
+    data_file: str = "",
 ) -> Dict[str, float]:
     ctx = ctx or ProcessContext.from_env()
     mlog = metrics_sink.from_context(ctx)
@@ -133,11 +193,28 @@ def train(
     batch_sh = {"tokens": batch_sharding(mesh)}
     if pack:
         batch_sh["segment_ids"] = batch_sharding(mesh)
-    data = device_prefetch(
-        synthetic_lm(cfg.vocab_size, global_batch, seq_len, pack=pack),
-        batch_sh,
-        chunk=8,
-    )
+    # Real corpus when given (--data, or the job spec's dataDir holding
+    # train.bin — the mnist entrypoint's TPUJOB_DATA_DIR convention);
+    # synthetic stream otherwise.
+    if not data_file and ctx.data_dir:
+        import os as _os
+        cand = _os.path.join(ctx.data_dir, "train.bin")
+        if _os.path.exists(cand):
+            data_file = cand
+    if data_file:
+        if pack:
+            raise ValueError("--pack is for the synthetic stream; a "
+                             "token-bin corpus is already contiguous text")
+        stream = token_bin_lm(
+            data_file, global_batch, seq_len,
+            seed=ctx.process_id, vocab_size=cfg.vocab_size,
+        )
+        logger.info("training on %s (shard seed %d)",
+                    data_file, ctx.process_id)
+    else:
+        stream = synthetic_lm(cfg.vocab_size, global_batch, seq_len,
+                              pack=pack)
+    data = device_prefetch(stream, batch_sh, chunk=8)
     last: Dict[str, float] = {}
 
     def on_metrics(m):
@@ -180,6 +257,10 @@ def main(argv=None) -> int:
     p.add_argument("--grad-accum", type=int, default=1,
                    help="microbatches per optimizer step (gradient "
                         "accumulation; batch must divide)")
+    p.add_argument("--data", default="",
+                   help="tokenised corpus: flat binary of token ids "
+                        "(uint16/uint32, optional <path>.meta.json); "
+                        "defaults to $TPUJOB_DATA_DIR/train.bin if present")
     args = p.parse_args(argv)
     ctx = initialize_from_env()
     metrics = train(
@@ -194,6 +275,7 @@ def main(argv=None) -> int:
         pack=args.pack,
         quant=args.quant,
         grad_accum=args.grad_accum,
+        data_file=args.data,
     )
     return 0 if metrics.get("final_step", 0) > 0 else 1
 
