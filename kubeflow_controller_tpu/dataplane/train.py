@@ -212,6 +212,14 @@ class TrainLoopConfig:
     # eval stream and report val_* metrics.
     eval_every: int = 0
     eval_batches: int = 1
+    # Async periodic checkpointing: orbax copies device state to host
+    # synchronously (safe against the next step's donated buffers) and
+    # writes to disk in a background thread, so big-model training never
+    # stalls on checkpoint IO. Off by default: quick in-process
+    # kill/restart cycles (the fake-cluster preemption tests) can catch
+    # the background finalize/GC mid-flight; long-running real jobs are
+    # where it pays. The final save always waits either way.
+    async_checkpoint: bool = False
     # When set, capture a jax.profiler trace of steps [profile_start,
     # profile_start + profile_steps) into this directory (SURVEY.md §5.1:
     # the reference has no profiling at all; this is the data-plane hook).
@@ -592,7 +600,7 @@ class TrainLoop:
                 jax.block_until_ready(pending.pop(0))
             step = py_step + take
             if crossed(cfg.checkpoint_every, py_step, step):
-                self.save(wait=True)
+                self.save(wait=not cfg.async_checkpoint)
             if (
                 self._eval_step is not None and eval_iter is not None
                 and crossed(cfg.eval_every, py_step, step)

@@ -729,3 +729,39 @@ spec:
         lines = [json.loads(line) for line in open(out)]
         assert len(lines) == 2
         assert all(len(r["completion"]) == 8 for r in lines)
+
+
+class TestAsyncCheckpoint:
+    def test_async_checkpoint_resumable(self, tmp_path):
+        """async_checkpoint=True: periodic saves don't block the step loop,
+        the final save still waits, and a fresh loop resumes from it."""
+        import optax
+
+        from kubeflow_controller_tpu.dataplane.train import (
+            TrainLoop, TrainLoopConfig,
+        )
+
+        mdir = str(tmp_path / "ckpt")
+        mesh = make_mesh(MeshConfig())
+
+        def make(total):
+            return TrainLoop(
+                mesh,
+                lambda _: {"w": jnp.zeros((8,))},
+                lambda p, b, r: (jnp.sum((p["w"] - 3.0) ** 2), {}),
+                optax.sgd(0.05),
+                TrainLoopConfig(total_steps=total, log_every=100,
+                                checkpoint_every=5, async_checkpoint=True),
+                model_dir=mdir,
+            )
+
+        def data():
+            while True:
+                yield {"x": np.zeros((8, 1), np.float32)}
+
+        state = make(20).run(data())
+        assert int(state.step) == 20
+        loop2 = make(40)
+        state = loop2.run(data())
+        assert loop2._restored
+        assert int(state.step) == 40
