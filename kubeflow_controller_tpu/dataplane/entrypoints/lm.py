@@ -141,6 +141,20 @@ def token_bin_lm(
     return stream()
 
 
+def _make_optimizer(learning_rate: float, total_steps: int, opt8bit: bool):
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, min(200, total_steps // 10 + 1), total_steps
+    )
+    if opt8bit:
+        from kubeflow_controller_tpu.ops.optim8 import adamw8bit
+
+        # 8-bit moment states: 1 byte/element vs 4 — ~6 bytes/param less
+        # HBM and ~+1.5 MFU at the flagship (400-step quality parity
+        # pinned in benchmarks/RESULTS.md).
+        return adamw8bit(sched, b1=0.9, b2=0.95, weight_decay=0.1)
+    return optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=0.1)
+
+
 def train(
     ctx: Optional[ProcessContext] = None,
     config: str = "tiny",
@@ -156,6 +170,7 @@ def train(
     quant: str = "",
     grad_accum: int = 1,
     data_file: str = "",
+    opt8bit: bool = False,
 ) -> Dict[str, float]:
     ctx = ctx or ProcessContext.from_env()
     mlog = metrics_sink.from_context(ctx)
@@ -173,12 +188,7 @@ def train(
         mesh=mesh,
         init_fn=tfm.make_init_fn(cfg),
         loss_fn=tfm.make_loss_fn(cfg),
-        optimizer=optax.adamw(
-            optax.warmup_cosine_decay_schedule(
-                0.0, learning_rate, min(200, total_steps // 10 + 1), total_steps
-            ),
-            b1=0.9, b2=0.95, weight_decay=0.1,
-        ),
+        optimizer=_make_optimizer(learning_rate, total_steps, opt8bit),
         config=TrainLoopConfig(
             total_steps=total_steps,
             log_every=max(1, total_steps // 10),
@@ -257,6 +267,9 @@ def main(argv=None) -> int:
     p.add_argument("--grad-accum", type=int, default=1,
                    help="microbatches per optimizer step (gradient "
                         "accumulation; batch must divide)")
+    p.add_argument("--opt8", action="store_true",
+                   help="8-bit Adam moments (ops/optim8.py): 1 byte per "
+                        "moment element, ~+1.5 MFU at the flagship")
     p.add_argument("--data", default="",
                    help="tokenised corpus: flat binary of token ids "
                         "(uint16/uint32, optional <path>.meta.json); "
@@ -276,6 +289,7 @@ def main(argv=None) -> int:
         quant=args.quant,
         grad_accum=args.grad_accum,
         data_file=args.data,
+        opt8bit=args.opt8,
     )
     return 0 if metrics.get("final_step", 0) > 0 else 1
 

@@ -1,0 +1,179 @@
+"""8-bit Adam — quantized optimizer moments (bitsandbytes-style, TPU-first).
+
+Adam's two fp32 moment tensors are pure HBM traffic on every step: at the
+335M-param flagship they add ~9 GB/step of reads+writes — measured ~11 ms
+of the 260 ms step (benchmarks/RESULTS.md round-5 optimizer section).
+They are also the largest per-param memory cost after the weights
+themselves (8 bytes/param). This module stores both moments in one byte
+per element:
+
+- **m (first moment)**: symmetric int8 with per-row dynamic scales —
+  the same scheme as the int8 matmul operands (``ops/quant.py``), scale
+  over the LAST axis so the reduction matches the weight shardings and
+  never forces a cross-shard regroup.
+- **v (second moment)**: uint8 in LOG space with a per-row (lo, range)
+  pair. v spans many orders of magnitude, so a linear code would snap
+  small entries to zero and blow up ``1/sqrt(v)``; a log code has
+  uniform RELATIVE error (~range/255 nats), which Adam tolerates — the
+  same reasoning as bitsandbytes' dynamic 8-bit code, in closed form.
+  Exact zeros (pre-first-update state) survive via a zero mask bit-free:
+  lo is floored at ``log(1e-30)`` and dequantized values at the floor
+  round back to ~0.
+
+The transform is a drop-in ``optax.GradientTransformation``
+(``adamw8bit(...)``); state tensors keep the parameter's shape (so
+``parallel.sharding.opt_state_shardings`` gives them the parameter's
+sharding by path+shape) with scale vectors replicated. Training-quality
+parity is pinned in tests/test_optim8.py and a paired 400-step run on
+the chip (RESULTS.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+_LOG_FLOOR = -69.0            # log(1e-30): "effectively zero" for v
+
+
+def _quantize_m(m: jax.Array):
+    """Signed per-row int8: m -> (q int8, scale f32[rows])."""
+    m32 = m.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(m32), axis=-1, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-30)
+    q = jnp.clip(jnp.round(m32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+def _dequantize_m(q: jax.Array, scale: jax.Array) -> jax.Array:
+    return q.astype(jnp.float32) * scale
+
+
+def _quantize_v(v: jax.Array):
+    """Non-negative per-row log-space uint8: v -> (q, lo, rng)."""
+    v32 = v.astype(jnp.float32)
+    lv = jnp.log(jnp.maximum(v32, 1e-30))
+    lo = jnp.min(lv, axis=-1, keepdims=True)
+    rng = jnp.maximum(jnp.max(lv, axis=-1, keepdims=True) - lo, 1e-6)
+    q = jnp.clip(
+        jnp.round((lv - lo) / rng * 255.0), 0, 255
+    ).astype(jnp.uint8)
+    return q, lo, rng
+
+def _dequantize_v(q: jax.Array, lo: jax.Array, rng: jax.Array) -> jax.Array:
+    out = jnp.exp(lo + q.astype(jnp.float32) / 255.0 * rng)
+    # values at (or dequantizing near) the floor are "exactly zero"
+    return jnp.where(out <= 2e-30, 0.0, out)
+
+
+class QLeafM(NamedTuple):
+    """Quantized first-moment leaf: int8 codes + per-row scale."""
+    q: jax.Array
+    scale: jax.Array
+
+
+class QLeafV(NamedTuple):
+    """Quantized second-moment leaf: uint8 log-codes + per-row (lo, range)."""
+    q: jax.Array
+    lo: jax.Array
+    rng: jax.Array
+
+
+def _is_qleaf(x) -> bool:
+    return isinstance(x, (QLeafM, QLeafV))
+
+
+class Adam8State(NamedTuple):
+    count: jax.Array
+    # Moment trees whose leaves are QLeafM/QLeafV for quantized tensors
+    # and plain f32 arrays for small ones. No placeholder leaves: a
+    # shared zero-scalar filler would alias the same buffer across many
+    # donated state leaves, which the TPU runtime rejects.
+    m: Any
+    v: Any
+
+
+def adamw8bit(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    min_quantized_size: int = 4096,
+) -> optax.GradientTransformation:
+    """AdamW with 8-bit moment states (1 byte/moment element vs 4).
+
+    Tensors smaller than ``min_quantized_size`` elements (norms, biases)
+    keep fp32 moments — their traffic is negligible and tiny tensors are
+    where quantization noise hurts most (the bitsandbytes default makes
+    the same carve-out).
+    """
+    sched = (
+        learning_rate if callable(learning_rate)
+        else (lambda _: learning_rate)
+    )
+
+    def qm(x):
+        if x.size < min_quantized_size:
+            return x.astype(jnp.float32)
+        return QLeafM(*_quantize_m(x))
+
+    def qv(x):
+        if x.size < min_quantized_size:
+            return x.astype(jnp.float32)
+        return QLeafV(*_quantize_v(x))
+
+    def deq(leaf):
+        if isinstance(leaf, QLeafM):
+            return _dequantize_m(leaf.q, leaf.scale)
+        if isinstance(leaf, QLeafV):
+            return _dequantize_v(leaf.q, leaf.lo, leaf.rng)
+        return leaf
+
+    def pack(tree, quant):
+        return jax.tree.map(quant, tree)
+
+    def unpack(tree):
+        return jax.tree.map(deq, tree, is_leaf=_is_qleaf)
+
+    def init(params):
+        # DISTINCT zero trees per moment: small (fp32) leaves pass
+        # through qm/qv via a no-op astype, so one shared zeros tree
+        # would alias the SAME buffer into both m and v — and donating
+        # the state then donates that buffer twice, which the TPU
+        # runtime rejects (INVALID_ARGUMENT at the next fetch).
+        return Adam8State(
+            count=jnp.zeros((), jnp.int32),
+            m=pack(jax.tree.map(jnp.zeros_like, params), qm),
+            v=pack(jax.tree.map(jnp.zeros_like, params), qv),
+        )
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("adamw8bit requires params (weight decay)")
+        count = state.count + 1
+        lr = sched(count)
+        m = unpack(state.m)
+        v = unpack(state.v)
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        m = jax.tree.map(lambda mm, g: b1 * mm + (1 - b1) * g, m, g32)
+        v = jax.tree.map(lambda vv, g: b2 * vv + (1 - b2) * g * g, v, g32)
+        c1 = 1 - b1 ** count.astype(jnp.float32)
+        c2 = 1 - b2 ** count.astype(jnp.float32)
+
+        def upd(p, mm, vv):
+            mhat = mm / c1
+            vhat = vv / c2
+            return (
+                -lr * (mhat / (jnp.sqrt(vhat) + eps)
+                       + weight_decay * p.astype(jnp.float32))
+            ).astype(p.dtype)
+
+        updates = jax.tree.map(upd, params, m, v)
+        return updates, Adam8State(
+            count=count, m=pack(m, qm), v=pack(v, qv),
+        )
+
+    return optax.GradientTransformation(init, update)

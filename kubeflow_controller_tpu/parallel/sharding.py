@@ -106,13 +106,19 @@ def opt_state_shardings(
     def pick(path, leaf):
         if leaf.ndim > 0:
             for plen in suffix_lens:  # longest path suffix wins
-                hit = by_path.get(tuple(path[-plen:]))
-                if hit is not None:
-                    pshape, s = hit
-                    # A factored/reduced-shape moment (e.g. adafactor row/
-                    # col stats) shares the path but not the shape; its
-                    # parameter's spec would be rank-wrong, so replicate.
-                    return s if leaf.shape == pshape else repl
+                # The param path may end the leaf path exactly (Adam's
+                # mu/nu mirror the tree) or sit ONE component from the
+                # end (wrapper leaves like optim8's QLeafM(q, scale):
+                # path ends ...['w'].q). The shape guard keeps wrapper
+                # fields that don't mirror the param (scales, factored
+                # moments) replicated.
+                for cand in (
+                    tuple(path[-plen:]), tuple(path[-plen - 1:-1]),
+                ):
+                    hit = by_path.get(cand)
+                    if hit is not None:
+                        pshape, s = hit
+                        return s if leaf.shape == pshape else repl
         return repl
 
     return jax.tree_util.tree_map_with_path(pick, shape)
