@@ -205,8 +205,10 @@ def train(
         batch_sh["segment_ids"] = batch_sharding(mesh)
     # Real corpus when given (--data, or the job spec's dataDir holding
     # train.bin — the mnist entrypoint's TPUJOB_DATA_DIR convention);
-    # synthetic stream otherwise.
-    if not data_file and ctx.data_dir:
+    # synthetic stream otherwise. --pack opts OUT of auto-detection (the
+    # packed stream is synthetic); an EXPLICIT --data with --pack is
+    # still a loud error below.
+    if not data_file and ctx.data_dir and not pack:
         import os as _os
         cand = _os.path.join(ctx.data_dir, "train.bin")
         if _os.path.exists(cand):

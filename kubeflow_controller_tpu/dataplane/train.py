@@ -355,6 +355,13 @@ class TrainLoop:
                 # stateful model state (e.g. BN stats) threads through
                 # sequentially like it would across real steps.
                 A = cfg.grad_accum
+                for leaf in jax.tree.leaves(batch):
+                    if leaf.shape[0] % A:
+                        raise ValueError(
+                            f"global batch {leaf.shape[0]} not divisible "
+                            f"by grad_accum={A}; adjust batch size or "
+                            "the accumulation factor"
+                        )
                 micro = jax.tree.map(
                     lambda x, s: jax.lax.with_sharding_constraint(
                         x.reshape(A, x.shape[0] // A, *x.shape[1:]),

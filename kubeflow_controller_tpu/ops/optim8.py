@@ -36,14 +36,14 @@ import jax
 import jax.numpy as jnp
 import optax
 
-_LOG_FLOOR = -69.0            # log(1e-30): "effectively zero" for v
+_V_FLOOR = 1e-30              # "effectively zero" clamp for the v log code
 
 
 def _quantize_m(m: jax.Array):
     """Signed per-row int8: m -> (q int8, scale f32[rows])."""
     m32 = m.astype(jnp.float32)
     scale = jnp.max(jnp.abs(m32), axis=-1, keepdims=True) / 127.0
-    scale = jnp.maximum(scale, 1e-30)
+    scale = jnp.maximum(scale, _V_FLOOR)
     q = jnp.clip(jnp.round(m32 / scale), -127, 127).astype(jnp.int8)
     return q, scale
 
@@ -54,7 +54,7 @@ def _dequantize_m(q: jax.Array, scale: jax.Array) -> jax.Array:
 def _quantize_v(v: jax.Array):
     """Non-negative per-row log-space uint8: v -> (q, lo, rng)."""
     v32 = v.astype(jnp.float32)
-    lv = jnp.log(jnp.maximum(v32, 1e-30))
+    lv = jnp.log(jnp.maximum(v32, _V_FLOOR))
     lo = jnp.min(lv, axis=-1, keepdims=True)
     rng = jnp.maximum(jnp.max(lv, axis=-1, keepdims=True) - lo, 1e-6)
     q = jnp.clip(
@@ -65,7 +65,7 @@ def _quantize_v(v: jax.Array):
 def _dequantize_v(q: jax.Array, lo: jax.Array, rng: jax.Array) -> jax.Array:
     out = jnp.exp(lo + q.astype(jnp.float32) / 255.0 * rng)
     # values at (or dequantizing near) the floor are "exactly zero"
-    return jnp.where(out <= 2e-30, 0.0, out)
+    return jnp.where(out <= 2 * _V_FLOOR, 0.0, out)
 
 
 class QLeafM(NamedTuple):
@@ -100,7 +100,7 @@ def adamw8bit(
     b1: float = 0.9,
     b2: float = 0.999,
     eps: float = 1e-8,
-    weight_decay: float = 0.0,
+    weight_decay: float = 1e-4,    # match optax.adamw's default: drop-in
     min_quantized_size: int = 4096,
 ) -> optax.GradientTransformation:
     """AdamW with 8-bit moment states (1 byte/moment element vs 4).
@@ -154,7 +154,11 @@ def adamw8bit(
         if params is None:
             raise ValueError("adamw8bit requires params (weight decay)")
         count = state.count + 1
-        lr = sched(count)
+        # optax convention: the FIRST update evaluates the schedule at 0
+        # (a zero-warmup schedule's first step is lr=0, exactly like
+        # optax.adamw) — the bias corrections below use the post-
+        # increment count like Adam's t.
+        lr = sched(state.count)
         m = unpack(state.m)
         v = unpack(state.v)
         g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
