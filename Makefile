@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test test-fast bench clean stamp
+.PHONY: all native test test-fast bench bench-cp clean stamp
 
 # Build-stamp analog of the reference's ldflags version injection
 # (/root/reference/Makefile:23-26): export the sha for build_version().
@@ -23,6 +23,11 @@ test-fast:
 
 bench:
 	$(PY) bench.py
+
+# Control-plane scale benchmark (reconcile path, no accelerator needed);
+# reports mean_sync_us and deepcopies_per_sync — see benchmarks/RESULTS.md.
+bench-cp:
+	$(PY) benchmarks/controlplane_bench.py --jobs 1000
 
 clean:
 	$(MAKE) -C csrc clean

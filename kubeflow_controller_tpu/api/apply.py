@@ -14,6 +14,7 @@ from __future__ import annotations
 import copy
 from typing import Callable, Optional
 
+from kubeflow_controller_tpu.api.core import thaw
 from kubeflow_controller_tpu.api.types import TPUJob
 from kubeflow_controller_tpu.cluster.store import AlreadyExists, Conflict
 
@@ -28,7 +29,9 @@ def apply_job_spec(
     """Create ``new`` if absent, else replace the existing job's spec with
     ``new.spec`` (keeping the stamped runtime id). Conflict-retried."""
     for _ in range(retries):
-        cur = get()
+        # get() may hand back a frozen store snapshot (cli serves straight
+        # off the store); thaw is free when it is already a private parse.
+        cur = thaw(get())
         if cur is None:
             try:
                 return create(new)

@@ -31,8 +31,136 @@ class PodPhase(str, enum.Enum):
     UNKNOWN = "Unknown"
 
 
+class FrozenObjectError(TypeError):
+    """Raised on any write to a frozen (sealed) API object.
+
+    Frozen objects are shared snapshots handed out by the copy-on-write
+    store/informer read path (client-go's "objects from a Lister MUST NOT
+    be mutated" contract, enforced). Thaw first: ``api.core.thaw(obj)``.
+    """
+
+
+class _FrozenDict(dict):
+    """Dict whose Python-level mutators raise once handed out frozen.
+
+    Built via the C-level ``dict`` constructor (which also shallow-copies,
+    severing aliasing with the caller's dict at freeze time). ``dict(fd)``
+    and ``fd.copy()`` still produce plain mutable dicts, so the hand-rolled
+    ``deepcopy()`` methods work unchanged on frozen objects.
+    """
+
+    def _raise(self, *a, **k):
+        raise FrozenObjectError(
+            "dict belongs to a frozen API object; thaw() the object first")
+
+    __setitem__ = __delitem__ = _raise
+    clear = pop = popitem = setdefault = update = _raise
+    __ior__ = _raise
+
+    def __reduce__(self):
+        return (dict, (dict(self),))
+
+
+class _FrozenList(list):
+    """List counterpart of :class:`_FrozenDict` (same escape hatches)."""
+
+    def _raise(self, *a, **k):
+        raise FrozenObjectError(
+            "list belongs to a frozen API object; thaw() the object first")
+
+    __setitem__ = __delitem__ = __iadd__ = __imul__ = _raise
+    append = extend = insert = pop = remove = clear = sort = reverse = _raise
+
+    def __reduce__(self):
+        return (list, (list(self),))
+
+
+class Sealable:
+    """Mixin giving API dataclasses a one-way freeze switch.
+
+    ``_sealed`` is a plain class attribute (not an annotated field) so it
+    stays out of ``dataclasses.fields()`` — generated ``__init__``/
+    ``__eq__``/``repr`` are unaffected, and fresh instances construct
+    normally against the inherited ``False``.
+
+    Sealing swaps the instance's class to a cached frozen variant whose
+    ``__setattr__``/``__delattr__`` raise. The alternative — a guard in a
+    Python-level ``__setattr__`` on this mixin — taxes EVERY field write
+    on every unsealed object (construction, deepcopy, kubelet/scheduler
+    mutation), which measured ~15% of control-plane bench wall time; the
+    class swap keeps unsealed writes at C speed and charges only sealed
+    objects, which raise anyway. The variant's ``__class__`` property
+    reports the base class, so dataclass ``__eq__`` (which compares
+    ``__class__``), ``repr``, and ``isinstance`` treat frozen and thawed
+    objects identically; only ``type(obj)`` reveals the variant.
+    """
+
+    _sealed = False
+
+    def _seal(self) -> None:
+        object.__setattr__(self, "__class__", _frozen_variant(type(self)))
+
+
+_FROZEN_VARIANTS: Dict[type, type] = {}
+
+
+def _frozen_variant(cls: type) -> type:
+    variant = _FROZEN_VARIANTS.get(cls)
+    if variant is None:
+        if cls.__dict__.get("_sealed"):
+            return cls  # already a frozen variant (double-seal)
+
+        def _raise(self, name, value=None):
+            raise FrozenObjectError(
+                f"{cls.__name__} is frozen (shared store snapshot); "
+                "thaw() it into an owned copy before mutating")
+
+        variant = type("_Frozen" + cls.__name__, (cls,), {
+            "_sealed": True,
+            "__setattr__": _raise,
+            "__delattr__": _raise,
+            "__class__": property(lambda self: cls),
+        })
+        _FROZEN_VARIANTS[cls] = variant
+    return variant
+
+
+def is_frozen(obj) -> bool:
+    """True when ``obj`` is a sealed API-object snapshot."""
+    return getattr(obj, "_sealed", False)
+
+
+def thaw(obj):
+    """Owned, mutable copy of ``obj`` — with copy elision.
+
+    Frozen input: one deepcopy (the mutation-boundary copy). Already-owned
+    input: returned as-is, no copy — so unconditional ``thaw()`` at a write
+    site costs nothing when the caller already holds a private object.
+    """
+    if obj is not None and is_frozen(obj):
+        return obj.deepcopy()
+    return obj
+
+
+# Top-level (Pod/Service/TPUJob) deepcopy counter — the bench samples it to
+# attribute control-plane wins to eliminated copies (deepcopies_per_sync in
+# benchmarks/controlplane_bench.py). Unlocked increment: exact under the
+# deterministic runtime, GIL-approximate (diagnostic-only) under threads.
+_deepcopies = 0
+
+
+def _note_deepcopy() -> None:
+    global _deepcopies
+    _deepcopies += 1
+
+
+def deepcopy_count() -> int:
+    """Process-wide count of top-level API-object deepcopies so far."""
+    return _deepcopies
+
+
 @dataclass
-class OwnerReference:
+class OwnerReference(Sealable):
     """Ownership link from a dependent object to its controller.
 
     Same contract the reference builds in ``newControllerRef``
@@ -62,9 +190,18 @@ class OwnerReference:
     def __deepcopy__(self, memo) -> "OwnerReference":
         return self.deepcopy()
 
+    # freeze() mirrors deepcopy() field-for-field (coverage guarded by
+    # tests/test_deepcopy.py + tests/test_cow_store.py): idempotent, stops
+    # at already-sealed children, wraps containers in _Frozen* and severs
+    # aliasing with the caller's containers in the process.
+    def freeze(self) -> "OwnerReference":
+        if not self._sealed:
+            self._seal()
+        return self
+
 
 @dataclass
-class ObjectMeta:
+class ObjectMeta(Sealable):
     name: str = ""
     generate_name: str = ""
     namespace: str = "default"
@@ -104,9 +241,19 @@ class ObjectMeta:
     def __deepcopy__(self, memo) -> "ObjectMeta":
         return self.deepcopy()
 
+    def freeze(self) -> "ObjectMeta":
+        if self._sealed:
+            return self
+        self.labels = _FrozenDict(self.labels)
+        self.annotations = _FrozenDict(self.annotations)
+        self.owner_references = _FrozenList(
+            r.freeze() for r in self.owner_references)
+        self._seal()
+        return self
+
 
 @dataclass
-class Container:
+class Container(Sealable):
     name: str
     image: str = ""
     command: List[str] = field(default_factory=list)
@@ -131,9 +278,20 @@ class Container:
     def __deepcopy__(self, memo) -> "Container":
         return self.deepcopy()
 
+    def freeze(self) -> "Container":
+        if self._sealed:
+            return self
+        self.command = _FrozenList(self.command)
+        self.args = _FrozenList(self.args)
+        self.env = _FrozenDict(self.env)
+        self.ports = _FrozenList(self.ports)
+        self.resources = _FrozenDict(self.resources)
+        self._seal()
+        return self
+
 
 @dataclass
-class PodSpec:
+class PodSpec(Sealable):
     containers: List[Container] = field(default_factory=list)
     restart_policy: str = "OnFailure"
     node_selector: Dict[str, str] = field(default_factory=dict)
@@ -160,9 +318,17 @@ class PodSpec:
     def __deepcopy__(self, memo) -> "PodSpec":
         return self.deepcopy()
 
+    def freeze(self) -> "PodSpec":
+        if self._sealed:
+            return self
+        self.containers = _FrozenList(c.freeze() for c in self.containers)
+        self.node_selector = _FrozenDict(self.node_selector)
+        self._seal()
+        return self
+
 
 @dataclass
-class PodStatus:
+class PodStatus(Sealable):
     phase: PodPhase = PodPhase.PENDING
     reason: str = ""
     message: str = ""
@@ -187,9 +353,14 @@ class PodStatus:
     def __deepcopy__(self, memo) -> "PodStatus":
         return self.deepcopy()
 
+    def freeze(self) -> "PodStatus":
+        if not self._sealed:
+            self._seal()
+        return self
+
 
 @dataclass
-class Pod:
+class Pod(Sealable):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodSpec = field(default_factory=PodSpec)
     status: PodStatus = field(default_factory=PodStatus)
@@ -198,6 +369,7 @@ class Pod:
     api_version: str = "v1"
 
     def deepcopy(self) -> "Pod":
+        _note_deepcopy()
         return Pod(
             metadata=self.metadata.deepcopy(),
             spec=self.spec.deepcopy(),
@@ -209,9 +381,18 @@ class Pod:
     def __deepcopy__(self, memo) -> "Pod":
         return self.deepcopy()
 
+    def freeze(self) -> "Pod":
+        if self._sealed:
+            return self
+        self.metadata.freeze()
+        self.spec.freeze()
+        self.status.freeze()
+        self._seal()
+        return self
+
 
 @dataclass
-class PodTemplateSpec:
+class PodTemplateSpec(Sealable):
     """Template stamped out (deep-copied — the reference's in-place template
     mutation at ``pkg/tensorflow/distributed.go:117-125`` is a known cache
     corruption bug, SURVEY.md §8) for each replica pod."""
@@ -227,9 +408,17 @@ class PodTemplateSpec:
     def __deepcopy__(self, memo) -> "PodTemplateSpec":
         return self.deepcopy()
 
+    def freeze(self) -> "PodTemplateSpec":
+        if self._sealed:
+            return self
+        self.metadata.freeze()
+        self.spec.freeze()
+        self._seal()
+        return self
+
 
 @dataclass
-class ServicePort:
+class ServicePort(Sealable):
     port: int
     name: str = ""
     target_port: Optional[int] = None
@@ -240,9 +429,14 @@ class ServicePort:
     def __deepcopy__(self, memo) -> "ServicePort":
         return self.deepcopy()
 
+    def freeze(self) -> "ServicePort":
+        if not self._sealed:
+            self._seal()
+        return self
+
 
 @dataclass
-class ServiceSpec:
+class ServiceSpec(Sealable):
     selector: Dict[str, str] = field(default_factory=dict)
     ports: List[ServicePort] = field(default_factory=list)
     cluster_ip: str = ""
@@ -257,9 +451,17 @@ class ServiceSpec:
     def __deepcopy__(self, memo) -> "ServiceSpec":
         return self.deepcopy()
 
+    def freeze(self) -> "ServiceSpec":
+        if self._sealed:
+            return self
+        self.selector = _FrozenDict(self.selector)
+        self.ports = _FrozenList(p.freeze() for p in self.ports)
+        self._seal()
+        return self
+
 
 @dataclass
-class Service:
+class Service(Sealable):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: ServiceSpec = field(default_factory=ServiceSpec)
 
@@ -267,6 +469,7 @@ class Service:
     api_version: str = "v1"
 
     def deepcopy(self) -> "Service":
+        _note_deepcopy()
         return Service(
             metadata=self.metadata.deepcopy(),
             spec=self.spec.deepcopy(),
@@ -276,6 +479,14 @@ class Service:
 
     def __deepcopy__(self, memo) -> "Service":
         return self.deepcopy()
+
+    def freeze(self) -> "Service":
+        if self._sealed:
+            return self
+        self.metadata.freeze()
+        self.spec.freeze()
+        self._seal()
+        return self
 
     def dns_name(self) -> str:
         return f"{self.metadata.name}.{self.metadata.namespace}.svc"

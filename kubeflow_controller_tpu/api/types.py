@@ -21,7 +21,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from kubeflow_controller_tpu.api.core import ObjectMeta, PodTemplateSpec
+from kubeflow_controller_tpu.api.core import (
+    ObjectMeta, PodTemplateSpec, Sealable, _FrozenDict, _FrozenList,
+    _note_deepcopy,
+)
 
 API_GROUP = "tpu.kubeflow.dev"
 API_VERSION = "v1alpha1"
@@ -86,7 +89,7 @@ class ReplicaState(str, enum.Enum):
 
 
 @dataclass
-class TPUSliceSpec:
+class TPUSliceSpec(Sealable):
     """TPU geometry for a worker replica group — the new surface that replaces
     the reference's free-form replica counts with physical slice shapes."""
 
@@ -110,9 +113,17 @@ class TPUSliceSpec:
     def __deepcopy__(self, memo) -> "TPUSliceSpec":
         return self.deepcopy()
 
+    # freeze() mirrors deepcopy() field-for-field across this module
+    # (coverage guarded by tests/test_deepcopy.py + tests/test_cow_store.py):
+    # idempotent, stops at already-sealed children, wraps containers.
+    def freeze(self) -> "TPUSliceSpec":
+        if not self._sealed:
+            self._seal()
+        return self
+
 
 @dataclass
-class ChiefSpec:
+class ChiefSpec(Sealable):
     # Reference ChiefSpec (types.go:86-89): which replica's exit decides
     # job completion.
     replica_name: str = "Worker"
@@ -124,9 +135,14 @@ class ChiefSpec:
     def __deepcopy__(self, memo) -> "ChiefSpec":
         return self.deepcopy()
 
+    def freeze(self) -> "ChiefSpec":
+        if not self._sealed:
+            self._seal()
+        return self
+
 
 @dataclass
-class TerminationPolicySpec:
+class TerminationPolicySpec(Sealable):
     chief: Optional[ChiefSpec] = None
 
     def deepcopy(self) -> "TerminationPolicySpec":
@@ -137,9 +153,17 @@ class TerminationPolicySpec:
     def __deepcopy__(self, memo) -> "TerminationPolicySpec":
         return self.deepcopy()
 
+    def freeze(self) -> "TerminationPolicySpec":
+        if self._sealed:
+            return self
+        if self.chief is not None:
+            self.chief.freeze()
+        self._seal()
+        return self
+
 
 @dataclass
-class ReplicaSpec:
+class ReplicaSpec(Sealable):
     """One replica group. For WORKER the effective pod count is derived from
     slice geometry (hosts-per-slice x num_slices), not from ``replicas`` —
     TPU hosts are not free-form. For LOCAL, ``replicas`` must be 1."""
@@ -169,9 +193,20 @@ class ReplicaSpec:
     def __deepcopy__(self, memo) -> "ReplicaSpec":
         return self.deepcopy()
 
+    def freeze(self) -> "ReplicaSpec":
+        if self._sealed:
+            return self
+        if self.template is not None:
+            self.template.freeze()
+        self.tpu.freeze()
+        if self.termination_policy is not None:
+            self.termination_policy.freeze()
+        self._seal()
+        return self
+
 
 @dataclass
-class TPUJobSpec:
+class TPUJobSpec(Sealable):
     # RuntimeID: stamped once at first reconcile, then immutable — the
     # reference regenerates it per sync, orphaning prior resources
     # (distributed.go:208-209, SURVEY.md §8).
@@ -211,9 +246,17 @@ class TPUJobSpec:
     def __deepcopy__(self, memo) -> "TPUJobSpec":
         return self.deepcopy()
 
+    def freeze(self) -> "TPUJobSpec":
+        if self._sealed:
+            return self
+        self.replica_specs = _FrozenList(
+            rs.freeze() for rs in self.replica_specs)
+        self._seal()
+        return self
+
 
 @dataclass
-class Condition:
+class Condition(Sealable):
     type: ConditionType = ConditionType.SCHEDULED
     status: ConditionStatus = ConditionStatus.UNKNOWN
     reason: str = ""
@@ -229,9 +272,14 @@ class Condition:
     def __deepcopy__(self, memo) -> "Condition":
         return self.deepcopy()
 
+    def freeze(self) -> "Condition":
+        if not self._sealed:
+            self._seal()
+        return self
+
 
 @dataclass
-class ReplicaStatus:
+class ReplicaStatus(Sealable):
     type: ReplicaType = ReplicaType.WORKER
     state: ReplicaState = ReplicaState.UNKNOWN
     # Histogram of pod states, mirror of TFReplicasStates (types.go:163-165).
@@ -243,9 +291,16 @@ class ReplicaStatus:
     def __deepcopy__(self, memo) -> "ReplicaStatus":
         return self.deepcopy()
 
+    def freeze(self) -> "ReplicaStatus":
+        if self._sealed:
+            return self
+        self.states = _FrozenDict(self.states)
+        self._seal()
+        return self
+
 
 @dataclass
-class TPUJobStatus:
+class TPUJobStatus(Sealable):
     phase: JobPhase = JobPhase.NONE
     reason: str = ""
     conditions: List[Condition] = field(default_factory=list)
@@ -281,6 +336,16 @@ class TPUJobStatus:
 
     def __deepcopy__(self, memo) -> "TPUJobStatus":
         return self.deepcopy()
+
+    def freeze(self) -> "TPUJobStatus":
+        if self._sealed:
+            return self
+        self.conditions = _FrozenList(
+            c.freeze() for c in self.conditions)
+        self.replica_statuses = _FrozenList(
+            r.freeze() for r in self.replica_statuses)
+        self._seal()
+        return self
 
     def set_condition(
         self,
@@ -331,7 +396,7 @@ class TPUJobStatus:
 
 
 @dataclass
-class TPUJob:
+class TPUJob(Sealable):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: TPUJobSpec = field(default_factory=TPUJobSpec)
     status: TPUJobStatus = field(default_factory=TPUJobStatus)
@@ -340,6 +405,7 @@ class TPUJob:
     api_version: str = f"{API_GROUP}/{API_VERSION}"
 
     def deepcopy(self) -> "TPUJob":
+        _note_deepcopy()
         return TPUJob(
             metadata=self.metadata.deepcopy(),
             spec=self.spec.deepcopy(),
@@ -350,6 +416,15 @@ class TPUJob:
 
     def __deepcopy__(self, memo) -> "TPUJob":
         return self.deepcopy()
+
+    def freeze(self) -> "TPUJob":
+        if self._sealed:
+            return self
+        self.metadata.freeze()
+        self.spec.freeze()
+        self.status.freeze()
+        self._seal()
+        return self
 
     @property
     def key(self) -> str:

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Protocol
 
-from kubeflow_controller_tpu.api.core import Pod, Service
+from kubeflow_controller_tpu.api.core import Pod, Service, thaw
 from kubeflow_controller_tpu.api.types import TPUJob
 from kubeflow_controller_tpu.cluster.cluster import FakeCluster
 
@@ -34,7 +34,15 @@ class ClusterClient(Protocol):
     def update_service(self, svc: Service) -> Service: ...
 
     def get_job(self, namespace: str, name: str) -> Optional[TPUJob]: ...
+    # Read-only job fetch: backends with a frozen store hand out the shared
+    # snapshot (zero-copy); wire backends return their private parse.
+    # Callers must treat the result as immutable (thaw() before writing).
+    def get_job_snapshot(self, namespace: str, name: str) -> Optional[TPUJob]: ...
     def update_job(self, job: TPUJob) -> TPUJob: ...
+    # Status-subresource write: persists only .status under the caller's
+    # resourceVersion. Spec/metadata in the passed job are never written,
+    # so frozen (shared) spec/metadata are legal there.
+    def update_job_status(self, job: TPUJob) -> TPUJob: ...
     def delete_job(self, namespace: str, name: str) -> None: ...
 
     # namespace: the involved object's namespace (a real apiserver rejects
@@ -109,10 +117,21 @@ class FakeClusterClient:
     # -- jobs ---------------------------------------------------------------
 
     def get_job(self, namespace: str, name: str) -> Optional[TPUJob]:
+        # Thawed owned copy: get_job callers (status updaters, RMW loops in
+        # controller._mutate_job) mutate what they get — same contract as
+        # the wire clients, whose responses are fresh private parses.
+        return thaw(self.cluster.jobs.try_get(namespace, name))
+
+    def get_job_snapshot(self, namespace: str, name: str) -> Optional[TPUJob]:
+        # Shared frozen snapshot, zero-copy: the store raises if a caller
+        # tries to write through it.
         return self.cluster.jobs.try_get(namespace, name)
 
     def update_job(self, job: TPUJob) -> TPUJob:
         return self.cluster.jobs.update(job)
+
+    def update_job_status(self, job: TPUJob) -> TPUJob:
+        return self.cluster.jobs.update_status(job)
 
     def delete_job(self, namespace: str, name: str) -> None:
         self.cluster.jobs.delete(namespace, name)

@@ -21,7 +21,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-from kubeflow_controller_tpu.api.core import Pod, PodPhase, Service
+from kubeflow_controller_tpu.api.core import Pod, PodPhase, Service, thaw
 from kubeflow_controller_tpu.cluster.event_recorder import EventAggregator
 from kubeflow_controller_tpu.cluster.events import EventType
 from kubeflow_controller_tpu.cluster.slices import (
@@ -109,11 +109,17 @@ class FakeCluster:
         # lists stay O(own pods) at any cluster size.
         from kubeflow_controller_tpu.tpu.naming import LABEL_JOB
 
+        # Frozen (copy-on-write) mode: reads, lists, and watch events are
+        # shared immutable snapshots — the whole in-process control plane
+        # runs zero-copy on the read path (docs/object_ownership.md).
         self.pods = ObjectStore(
-            "Pod", now_fn=lambda: self.now, index_labels=(LABEL_JOB,))
+            "Pod", now_fn=lambda: self.now, index_labels=(LABEL_JOB,),
+            copy_on_read=False)
         self.services = ObjectStore(
-            "Service", now_fn=lambda: self.now, index_labels=(LABEL_JOB,))
-        self.jobs = ObjectStore("TPUJob", now_fn=lambda: self.now)
+            "Service", now_fn=lambda: self.now, index_labels=(LABEL_JOB,),
+            copy_on_read=False)
+        self.jobs = ObjectStore(
+            "TPUJob", now_fn=lambda: self.now, copy_on_read=False)
         # Scheduler/kubelet work queues: every tick touches only pods that
         # can actually change state — unbound Pending pods (scheduler) and
         # live pods (kubelet) — instead of scanning the whole store.
@@ -414,8 +420,11 @@ class FakeCluster:
                     rt.started_at = self.now
                     self._transition(pod, PodPhase.RUNNING)
                     if policy.run_fn is not None:
-                        cur = self.pods.try_get(
-                            pod.metadata.namespace, pod.metadata.name)
+                        # run_fns are user workloads that may mutate their
+                        # pod (env twiddling etc.) — hand them an owned copy,
+                        # not the frozen store snapshot.
+                        cur = thaw(self.pods.try_get(
+                            pod.metadata.namespace, pod.metadata.name))
                         if cur is None:
                             continue  # deleted mid-transition: nothing to run
                         self._spawn_run_fn(pod, rt, policy, cur)

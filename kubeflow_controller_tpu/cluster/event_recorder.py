@@ -197,6 +197,25 @@ class EventAggregator:
             rec.creating = True
             return True
 
+    def reclaim_create(self, key: Tuple) -> bool:
+        """The stored Event vanished server-side (PATCH answered 404 —
+        events are TTL-GC'd on real clusters): atomically forget the stale
+        handle and claim re-creation. Exactly one of any number of
+        concurrent reclaimers gets True; the rest drop their write — the
+        count is aggregated, so the next repeat PATCHes the fresh Event."""
+        with self._lock:
+            rec = self._cache.get(key)
+            if rec is None:
+                return False
+            if rec.handle is not None:
+                rec.handle = None
+                rec.creating = True
+                return True
+            if not rec.creating:
+                rec.creating = True
+                return True
+            return False
+
     def abort_create(self, key: Tuple) -> None:
         """The claimed backend-create failed: release the claim so a
         later occurrence can retry."""

@@ -316,6 +316,18 @@ class KubeClusterClient:
         out = self._request("PUT", path + "/status", status_wire)
         return kube_wire.job_from_k8s(out)
 
+    def get_job_snapshot(self, namespace: str, name: str) -> Optional[TPUJob]:
+        return self.get_job(namespace, name)
+
+    def update_job_status(self, job: TPUJob) -> TPUJob:
+        """Status-only write: ONE ``/status`` PUT under the caller's
+        resourceVersion (``update_job`` needs two writes to move both
+        halves across the subresource split)."""
+        path = (f"{self._collection('TPUJob', job.metadata.namespace)}/"
+                f"{job.metadata.name}/status")
+        out = self._request("PUT", path, kube_wire.job_to_k8s(job))
+        return kube_wire.job_from_k8s(out)
+
     def delete_job(self, namespace: str, name: str) -> None:
         self._request(
             "DELETE", f"{self._collection('TPUJob', namespace)}/{name}"
@@ -380,9 +392,13 @@ class KubeClusterClient:
                     return
                 except NotFound:
                     # The stored Event was GC'd server-side (events have
-                    # a TTL on real clusters): re-create below and stash
-                    # the fresh handle on the same record.
-                    pass
+                    # a TTL on real clusters): forget the stale handle and
+                    # CLAIM re-creation before POSTing — without the claim
+                    # two racing PATCHers both fall through here and
+                    # double-create the Event. The loser drops its write
+                    # (aggregated: the next repeat PATCHes the new row).
+                    if not self._events.reclaim_create(obs.key):
+                        return
             try:
                 out = self._request(
                     "POST", f"/api/v1/namespaces/{ns}/events",

@@ -303,13 +303,17 @@ def merge_kubeconfig_docs(docs: Any) -> Dict[str, Any]:
     the named lists (clusters/contexts/users) the FIRST file to define a
     name wins and later files only contribute new names; for scalar
     fields (current-context, preferences) the first non-empty value
-    wins."""
+    wins. First-wins applies WITHIN one file too: the seen-name set grows
+    as entries append, so a duplicate name later in the same document is
+    dropped instead of silently shadowing lookups (clientcmd merges maps
+    keyed by name, which collapses intra-file dupes the same way)."""
     out: Dict[str, Any] = {}
     for doc in docs:
         for key in ("clusters", "contexts", "users"):
             have = {e.get("name") for e in out.get(key) or []}
             for entry in doc.get(key) or []:
                 if entry.get("name") not in have:
+                    have.add(entry.get("name"))
                     out.setdefault(key, []).append(entry)
         for k, v in doc.items():
             if k in ("clusters", "contexts", "users"):

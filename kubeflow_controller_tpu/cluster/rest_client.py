@@ -245,6 +245,11 @@ class RestClusterClient:
             return None
         return job_from_dict(out)
 
+    def get_job_snapshot(self, namespace: str, name: str) -> Optional[TPUJob]:
+        # Wire responses are already private parses — nothing shared to
+        # protect, so the "snapshot" is just a get.
+        return self.get_job(namespace, name)
+
     def update_job(self, job: TPUJob) -> TPUJob:
         out = self._req(
             "PUT",
@@ -253,6 +258,11 @@ class RestClusterClient:
             job_to_dict(job),
         )
         return job_from_dict(out)
+
+    def update_job_status(self, job: TPUJob) -> TPUJob:
+        # Framework-mode servers apply status on the main PUT; the strict
+        # k8s surface (kube_client) routes through /status instead.
+        return self.update_job(job)
 
     def apply_job(self, job: TPUJob) -> TPUJob:
         """kubectl-apply over the wire: create-or-update-spec-only with

@@ -310,13 +310,10 @@ def make_rest_handler(
                 ns, name = m.group(1), m.group(2)
                 incoming = kube_wire.job_from_k8s(self._body())
                 # Apply ONLY .status, under the caller's resourceVersion —
-                # store.update enforces the optimistic-concurrency check.
-                cur = cluster.jobs.get(ns, name)
-                cur.status = incoming.status
-                cur.metadata.resource_version = (
-                    incoming.metadata.resource_version
-                )
-                out = cluster.jobs.update(cur)
+                # store.update_status enforces the optimistic-concurrency
+                # check and structurally shares the stored frozen spec
+                # (no whole-job copy on the status write path).
+                out = cluster.jobs.update_status(incoming)
                 self._send(200, kube_wire.job_to_k8s(out))
                 return True
             m = K8S_EVENTS_RE.match(path)

@@ -7,19 +7,23 @@ something (SURVEY.md §7 "hard parts" #3):
 - resource versions bump on every write;
 - updates are optimistic-concurrency checked (the reference does whole-object
   PUT with no conflict handling, ``controller.go:630-636`` — a listed bug);
-- reads return deep copies (the reference mutates informer-cached objects in
-  place, ``updater/distributed.go:51-54`` — another listed bug; copies make
-  that class of corruption impossible here);
+- reads are aliasing-safe in one of two ways: **legacy mode** returns deep
+  copies; **frozen mode** (``copy_on_read=False``) returns shared immutable
+  snapshots and moves the deepcopy to the mutation boundary (the reference
+  mutates informer-cached objects in place, ``updater/distributed.go:51-54``
+  — a listed bug; both modes make that corruption impossible, frozen mode
+  without the per-read copy tax — see docs/object_ownership.md);
 - every mutation emits a WatchEvent to subscribers.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from kubeflow_controller_tpu.api.core import new_uid
+from kubeflow_controller_tpu.api.core import is_frozen, new_uid, thaw
 from kubeflow_controller_tpu.cluster.events import EventType, WatchEvent
 
 
@@ -43,6 +47,15 @@ class ObjectStore:
 
     Objects are any dataclass with ``.metadata`` (ObjectMeta) and
     ``.deepcopy()``. Keys are ``namespace/name``.
+
+    ``copy_on_read=True`` (default) is the legacy contract: every read and
+    watch emission is a private deep copy the caller may mutate. With
+    ``copy_on_read=False`` stored objects are frozen (``.freeze()``) and
+    ``get``/``try_get``/``list``, watch events, and subscribe-replay hand
+    out **shared frozen references** — zero read-path copies; writers thaw
+    at the mutation boundary (``api.core.thaw``). FakeCluster runs its
+    stores in frozen mode; bare ObjectStore constructions keep legacy
+    semantics.
     """
 
     def __init__(
@@ -50,9 +63,11 @@ class ObjectStore:
         kind: str,
         now_fn: Callable[[], float] = time.time,
         index_labels: tuple = (),
+        copy_on_read: bool = True,
     ):
         self.kind = kind
         self._now_fn = now_fn
+        self._copy_on_read = copy_on_read
         self._lock = threading.RLock()
         self._objects: Dict[str, Any] = {}
         self._rv = 0
@@ -97,9 +112,10 @@ class ObjectStore:
         with self._lock:
             if replay:
                 for obj in self._objects.values():
-                    listener(
-                        WatchEvent(EventType.ADDED, self.kind, obj.deepcopy())
-                    )
+                    listener(WatchEvent(
+                        EventType.ADDED, self.kind,
+                        obj.deepcopy() if self._copy_on_read else obj,
+                    ))
             self._listeners.append(listener)
 
     def unsubscribe(self, listener: Listener) -> None:
@@ -120,6 +136,10 @@ class ObjectStore:
 
     def create(self, obj: Any) -> Any:
         with self._lock:
+            # Frozen-mode callers may re-submit a frozen snapshot (e.g. a
+            # watch tombstone); stamp a private copy instead of their object.
+            if not self._copy_on_read and is_frozen(obj):
+                obj = obj.deepcopy()
             meta = obj.metadata
             if not meta.name:
                 if not meta.generate_name:
@@ -137,20 +157,29 @@ class ObjectStore:
             meta.resource_version = self._rv
             if not meta.creation_timestamp:
                 meta.creation_timestamp = self._now_fn()
+            # One copy total in frozen mode: the caller's object is stamped
+            # in place (and stays mutable in their hands); the store keeps
+            # a frozen private snapshot shared by the ADDED event, the
+            # return value, and every future read.
             stored = obj.deepcopy()
+            if not self._copy_on_read:
+                stored.freeze()
             self._objects[key] = stored
             self._index_add(key, stored)
-            self._emit(
-                WatchEvent(EventType.ADDED, self.kind, stored.deepcopy())
-            )
-            return stored.deepcopy()
+            if self._copy_on_read:
+                self._emit(
+                    WatchEvent(EventType.ADDED, self.kind, stored.deepcopy())
+                )
+                return stored.deepcopy()
+            self._emit(WatchEvent(EventType.ADDED, self.kind, stored))
+            return stored
 
     def get(self, namespace: str, name: str) -> Any:
         with self._lock:
             obj = self._objects.get(f"{namespace}/{name}")
             if obj is None:
                 raise NotFound(f"{self.kind} {namespace}/{name}")
-            return obj.deepcopy()
+            return obj.deepcopy() if self._copy_on_read else obj
 
     def try_get(self, namespace: str, name: str) -> Optional[Any]:
         try:
@@ -173,6 +202,24 @@ class ObjectStore:
                 )
             if cur.metadata.uid and obj.metadata.uid != cur.metadata.uid:
                 raise Conflict(f"{self.kind} {key}: uid changed (delete+recreate race)")
+            if not self._copy_on_read:
+                # Ownership transfer: an unfrozen input is rv-stamped and
+                # sealed in place — zero copies; the caller must not touch
+                # it afterwards (it raises if they do). A frozen input
+                # (rare: resubmitting a snapshot verbatim) is copied once.
+                if is_frozen(obj):
+                    obj = obj.deepcopy()
+                self._rv += 1
+                obj.metadata.resource_version = self._rv
+                old = cur
+                stored = obj.freeze()
+                self._index_remove(key, old)
+                self._objects[key] = stored
+                self._index_add(key, stored)
+                self._emit(WatchEvent(
+                    EventType.MODIFIED, self.kind, stored, old,
+                ))
+                return stored
             self._rv += 1
             obj.metadata.resource_version = self._rv
             old = cur
@@ -186,11 +233,64 @@ class ObjectStore:
             ))
             return stored.deepcopy()
 
+    def update_status(self, obj: Any) -> Any:
+        """Status-subresource update: replace only ``.status``, rv-checked.
+
+        Frozen mode exploits immutability for structural sharing: the next
+        snapshot is built with ``dataclasses.replace``, reusing the stored
+        object's frozen spec (the heavy half — pod templates) by reference.
+        Only metadata (rv bump) and the incoming status are new, so the
+        per-status-write cost stays O(status), not O(object) — the copy
+        pattern the whole-object ``update`` can't avoid. The caller's
+        status is sealed in place (ownership transfer, as in ``update``);
+        a frozen incoming status is copied once instead.
+
+        Labels/annotations can't change through this path (metadata comes
+        from the stored object), so the label indexes need no maintenance.
+        """
+        with self._lock:
+            key = self.key_of(obj)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFound(f"{self.kind} {key}")
+            if obj.metadata.resource_version != cur.metadata.resource_version:
+                raise Conflict(
+                    f"{self.kind} {key}: stale resource_version "
+                    f"{obj.metadata.resource_version} != {cur.metadata.resource_version}"
+                )
+            if cur.metadata.uid and obj.metadata.uid != cur.metadata.uid:
+                raise Conflict(f"{self.kind} {key}: uid changed (delete+recreate race)")
+            status = obj.status
+            if self._copy_on_read or is_frozen(status):
+                # legacy: the caller keeps their object mutable, so the
+                # stored status must be private
+                status = status.deepcopy()
+            self._rv += 1
+            meta = cur.metadata.deepcopy()
+            meta.resource_version = self._rv
+            old = cur
+            stored = dataclasses.replace(cur, metadata=meta, status=status)
+            if not self._copy_on_read:
+                stored.freeze()  # spec already sealed: O(1) for that branch
+                self._objects[key] = stored
+                self._emit(WatchEvent(
+                    EventType.MODIFIED, self.kind, stored, old,
+                ))
+                return stored
+            self._objects[key] = stored
+            self._emit(WatchEvent(
+                EventType.MODIFIED, self.kind,
+                stored.deepcopy(), old.deepcopy(),
+            ))
+            return stored.deepcopy()
+
     def mutate(self, namespace: str, name: str, fn: Callable[[Any], None]) -> Any:
         """Read-modify-write with internal retry — the conflict-safe update
-        helper status writers use."""
+        helper status writers use. ``fn`` always receives a private mutable
+        copy (thawed in frozen mode — one copy per attempt, the only copy
+        the whole round trip pays there)."""
         while True:
-            obj = self.get(namespace, name)
+            obj = thaw(self.get(namespace, name))
             fn(obj)
             try:
                 return self.update(obj)
@@ -211,6 +311,8 @@ class ObjectStore:
             # resourceVersion without tripping the 410 relist path.
             tomb = obj.deepcopy()
             tomb.metadata.resource_version = self._rv
+            if not self._copy_on_read:
+                tomb.freeze()
             self._emit(WatchEvent(EventType.DELETED, self.kind, tomb))
             return obj
 
@@ -237,7 +339,7 @@ class ObjectStore:
                     continue
                 if label_selector and not selector_matches(label_selector, obj.metadata.labels):
                     continue
-                out.append(obj.deepcopy())
+                out.append(obj.deepcopy() if self._copy_on_read else obj)
             return out
 
     @property

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, List
 
-from kubeflow_controller_tpu.api.core import OwnerReference
+from kubeflow_controller_tpu.api.core import OwnerReference, thaw
 from kubeflow_controller_tpu.api.types import TPUJob
 from kubeflow_controller_tpu.cluster.store import selector_matches
 
@@ -42,7 +42,9 @@ def claim_objects(
             if selector_matches(selector, obj.metadata.labels):
                 claimed.append(obj)
             else:
-                # Release: labels diverged from our selector.
+                # Release: labels diverged from our selector. Candidates are
+                # frozen informer/store snapshots — thaw before patching.
+                obj = thaw(obj)
                 obj.metadata.owner_references = [
                     r for r in obj.metadata.owner_references
                     if r.uid != job.metadata.uid
@@ -56,6 +58,7 @@ def claim_objects(
                 continue
             if job.metadata.deletion_timestamp is not None:
                 continue  # deleting jobs adopt nothing (RecheckDeletionTimestamp)
+            obj = thaw(obj)  # adopting stamps an ownerRef on the snapshot
             obj.metadata.owner_references.append(
                 OwnerReference(
                     api_version=job.api_version,

@@ -5,8 +5,9 @@ Rebuild of ``pkg/controller/controller.go`` (NewController ``:74-152``, Run
 manageTFJob ``:343-428``, resource handlers ``:430-590``) with the stubs and
 bugs closed (SURVEY.md §8): deletion handlers re-enqueue (reference logged
 "To Be Implemented"), status writes are conflict-retried (reference did a raw
-whole-object PUT), the informer cache is never mutated (everything is deep
-copies), and pod creation is gang-batched, not incremental.
+whole-object PUT), the informer cache is never mutated (cache entries are
+frozen shared snapshots — writes raise; see docs/object_ownership.md), and
+pod creation is gang-batched, not incremental.
 
 Effects happen only through the ClusterClient seam; decisions come only from
 the pure planner/updater/checker modules.
@@ -14,6 +15,7 @@ the pure planner/updater/checker modules.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import random
 import string
@@ -22,7 +24,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-from kubeflow_controller_tpu.api.core import Pod, Service
+from kubeflow_controller_tpu.api.core import Pod, Service, is_frozen
 from kubeflow_controller_tpu.api.types import (
     ConditionStatus,
     ConditionType,
@@ -311,7 +313,7 @@ class Controller:
         # the deleted-job cleanup path, removing pods/services too.
         ttl = job.spec.ttl_seconds_after_finished
         if ttl is not None and job.is_done():
-            cur = self.client.get_job(namespace, name)
+            cur = self.client.get_job_snapshot(namespace, name)  # read-only
             # guard on the phase, not on completion_time's truthiness —
             # t=0.0 is a legitimate completion time on a simulated clock
             if cur is not None and cur.is_done():
@@ -505,10 +507,22 @@ class Controller:
         # Write only when something changed (the reference's ShouldUpdate
         # contract) — an unconditional write would emit MODIFIED, re-enqueue
         # the job, and reconcile would chase its own tail forever.
+        #
+        # Runs every sync, so it must not copy the whole job: the scratch
+        # object shares the snapshot's frozen metadata/spec and carries a
+        # private status copy — compute_status writes only .status, and
+        # update_job_status persists only .status (structurally sharing the
+        # spec store-side too). Steady-state syncs copy one status and
+        # write nothing.
         for _ in range(10):
-            job = self.client.get_job(ns, name)
-            if job is None:
+            snap = self.client.get_job_snapshot(ns, name)
+            if snap is None:
                 return
+            if is_frozen(snap):
+                job = dataclasses.replace(
+                    snap, status=snap.status.deepcopy())
+            else:
+                job = snap  # wire parse: already a private copy
             changed = compute_status(
                 job, pods, now, fail_reason=fail_reason,
                 recovering=recovering, suspended=suspended,
@@ -516,7 +530,7 @@ class Controller:
             if not changed:
                 return
             try:
-                self.client.update_job(job)
+                self.client.update_job_status(job)
                 return
             except Conflict:
                 continue

@@ -7,9 +7,13 @@ maintain a local read cache, dispatch add/update/delete handlers, and offer a
 periodic resync that re-delivers everything (the level-trigger safety net; the
 reference uses a 30s resync).
 
-The local cache is intentionally a *separate copy* from the store so the
-cache-staleness race the expectations machinery guards against is actually
-reproducible in tests.
+The cache holds **frozen** objects (client-go's Lister contract, enforced):
+every event object is frozen on ingest — a no-op for frozen-mode store
+events (already sealed snapshots), one seal pass for the private parses a
+wire watch source (REST/kube) delivers — and ``get``/``list`` hand the
+cached reference out uncopied. The cache is still *state-separate* from
+the store (it lags the watch stream), so the cache-staleness race the
+expectations machinery guards against stays reproducible in tests.
 """
 
 from __future__ import annotations
@@ -18,6 +22,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from kubeflow_controller_tpu.api.core import is_frozen
 from kubeflow_controller_tpu.cluster.events import EventType, WatchEvent
 from kubeflow_controller_tpu.cluster.store import ObjectStore, selector_matches
 
@@ -62,6 +67,12 @@ class Informer:
     # -- event path ----------------------------------------------------------
 
     def _on_event(self, ev: WatchEvent) -> None:
+        # Freeze on ingest: the cache (and every handler) only ever sees a
+        # sealed snapshot, so a thawed object can never leak into the read
+        # path. Idempotent for frozen-store events; seals the private parse
+        # a wire source delivers.
+        if not is_frozen(ev.obj):
+            ev.obj.freeze()
         key = f"{ev.obj.metadata.namespace}/{ev.obj.metadata.name}"
         with self._lock:
             if ev.type == EventType.DELETED:
@@ -88,15 +99,16 @@ class Informer:
     # -- lister --------------------------------------------------------------
 
     def get(self, namespace: str, name: str) -> Optional[Any]:
+        """Shared frozen reference (zero-copy); ``thaw()`` before mutating."""
         with self._lock:
-            obj = self._cache.get(f"{namespace}/{name}")
-            return obj.deepcopy() if obj is not None else None
+            return self._cache.get(f"{namespace}/{name}")
 
     def list(
         self,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[Any]:
+        """Shared frozen references (zero-copy); ``thaw()`` before mutating."""
         with self._lock:
             out = []
             for obj in self._cache.values():
@@ -106,5 +118,5 @@ class Informer:
                     label_selector, obj.metadata.labels
                 ):
                     continue
-                out.append(obj.deepcopy())
+                out.append(obj)
             return out

@@ -389,6 +389,15 @@ class TrainLoop:
                 grads = jax.tree.map(lambda g: g / A, gsum)
                 loss = losses.mean()
                 metrics = jax.tree.map(lambda m: m.mean(axis=0), metricses)
+                if isinstance(metrics, dict) and "perplexity" in metrics:
+                    # Perplexity is exp(CE): averaging per-microbatch
+                    # perplexities is mean-of-exp — Jensen-biased high
+                    # vs. the monolithic path. The geometric mean
+                    # exp(mean(log ppl_i)) == exp(mean CE_i) reports the
+                    # same number an un-accumulated step would.
+                    metrics["perplexity"] = jnp.exp(
+                        jnp.log(metricses["perplexity"]).mean(axis=0)
+                    )
             else:
                 grads, loss, metrics, model_state = grads_of(
                     batch, state.model_state, step_rng

@@ -368,6 +368,27 @@ def prefill(
     )
 
 
+def _check_cache_capacity(cache: KVCache, new_tokens: int, what: str) -> None:
+    """Reject writes past the cache's allocated window.
+
+    ``dynamic_update_slice`` CLAMPS out-of-range start indices instead of
+    erroring, so overflowing the cache silently overwrites the newest
+    earlier positions — a corrupted cache, not a crash (``generate()``
+    guards the same way). ``cache.length`` is a traced value inside jit;
+    there the check is skipped (best effort) rather than breaking tracing.
+    """
+    try:
+        used = int(cache.length)
+    except (TypeError, jax.errors.ConcretizationTypeError):
+        return
+    max_seq = cache.k.shape[2]
+    if used + new_tokens > max_seq:
+        raise ValueError(
+            f"{what}: cache length {used} + {new_tokens} new tokens "
+            f"exceeds max_seq {max_seq}"
+        )
+
+
 def prefill_continue(
     cfg: TransformerConfig,
     params: Params,
@@ -396,6 +417,7 @@ def prefill_continue(
     hd = cfg.head_dim
     max_seq = cache.k.shape[2]
     L = cache.length
+    _check_cache_capacity(cache, s, "prefill_continue")
     rep = cfg.n_heads // cfg.n_kv_heads
     x = params["embed"].astype(dt)[new_tokens]          # [B, S, D]
     positions = L + jnp.broadcast_to(
@@ -534,6 +556,7 @@ def generate_from_cache(
     (prefilled or continued) cache + its last-position logits. This is
     the multi-turn serving entry: prefill turn 1 with ``prefill``, later
     turns with ``prefill_continue``, then decode from here."""
+    _check_cache_capacity(cache, max_new_tokens, "generate_from_cache")
     rng = rng if rng is not None else jax.random.key(0)
 
     def pick(logits, key):

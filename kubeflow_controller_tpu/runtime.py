@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Callable, Optional, Union
 
+from kubeflow_controller_tpu.api.core import thaw
 from kubeflow_controller_tpu.api.serialization import load_job_yaml
 from kubeflow_controller_tpu.api.types import JobPhase, TPUJob
 from kubeflow_controller_tpu.api.validation import validate_job
@@ -144,7 +145,9 @@ class LocalRuntime:
         return self.cluster.jobs.create(job)
 
     def get_job(self, namespace: str, name: str) -> Optional[TPUJob]:
-        return self.cluster.jobs.try_get(namespace, name)
+        # Owned mutable copy (the store's snapshot is frozen): callers
+        # routinely get-modify-update, matching the wire-client contract.
+        return thaw(self.cluster.jobs.try_get(namespace, name))
 
     def delete_job(self, namespace: str, name: str) -> None:
         self.cluster.jobs.delete(namespace, name)

@@ -18,10 +18,27 @@ CPU_COLLECTIVE_TIMEOUT_FLAGS = (
 )
 
 
+def _jaxlib_version() -> tuple:
+    """(major, minor) of the installed jaxlib; () when unavailable.
+    ``jaxlib.version`` is a constants-only module — importing it does not
+    pull in jax or initialize any backend."""
+    try:
+        from jaxlib.version import __version__ as v
+        return tuple(int(p) for p in v.split(".")[:2])
+    except Exception:
+        return ()
+
+
 def with_cpu_collective_timeouts(flags: str) -> str:
     """Append the rendezvous-timeout flags to an XLA_FLAGS string, skipping
     any flag the ambient value already sets (XLA parses last-wins; never
-    override the user)."""
+    override the user).
+
+    No-op on jaxlib < 0.5: those XLA builds predate the flags and ABORT the
+    process on any unknown XLA_FLAGS entry at backend init — which would
+    turn this safety knob into guaranteed process death."""
+    if _jaxlib_version() < (0, 5):
+        return flags.strip()
     for name, value in CPU_COLLECTIVE_TIMEOUT_FLAGS:
         if name not in flags:
             flags += f" --{name}={value}"

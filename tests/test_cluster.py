@@ -5,11 +5,13 @@ import pytest
 
 from kubeflow_controller_tpu.api.core import (
     Container,
+    FrozenObjectError,
     ObjectMeta,
     Pod,
     PodPhase,
     PodSpec,
     Service,
+    thaw,
 )
 from kubeflow_controller_tpu.cluster import (
     AlreadyExists,
@@ -61,11 +63,18 @@ def gang_pod(name, gang, accel, gang_size, slice_idx=0, host_idx=0, num_slices=1
 
 
 class TestStore:
-    def test_create_get_deepcopy_isolation(self):
+    def test_create_get_aliasing_isolation(self):
+        # FakeCluster stores run in frozen (copy-on-write) mode: create's
+        # return is a sealed snapshot — mutating it raises instead of
+        # corrupting the store, and a thawed copy is private.
         c = FakeCluster()
         pod = make_pod("a")
         created = c.pods.create(pod)
-        created.status.phase = PodPhase.RUNNING  # mutate the returned copy
+        with pytest.raises(FrozenObjectError):
+            created.status.phase = PodPhase.RUNNING
+        pod.status.phase = PodPhase.RUNNING   # caller's object stays mutable
+        mine = thaw(c.pods.get("default", "a"))
+        mine.status.phase = PodPhase.RUNNING
         again = c.pods.get("default", "a")
         assert again.status.phase == PodPhase.PENDING  # store unaffected
 
@@ -85,8 +94,8 @@ class TestStore:
     def test_conflict_on_stale_update(self):
         c = FakeCluster()
         c.pods.create(make_pod("a"))
-        copy1 = c.pods.get("default", "a")
-        copy2 = c.pods.get("default", "a")
+        copy1 = thaw(c.pods.get("default", "a"))
+        copy2 = thaw(c.pods.get("default", "a"))
         copy1.status.phase = PodPhase.RUNNING
         c.pods.update(copy1)
         copy2.status.phase = PodPhase.FAILED

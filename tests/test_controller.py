@@ -14,6 +14,7 @@ from kubeflow_controller_tpu.api.core import (
     PodPhase,
     PodSpec,
     PodTemplateSpec,
+    thaw,
 )
 from kubeflow_controller_tpu.api.types import (
     ChiefSpec,
@@ -375,7 +376,9 @@ class TestGangJobLifecycle:
         rt.submit(worker_job())
         rt.controller.drain()
         # strip owner refs, simulating an orphaned resource
+        # (list hands out frozen snapshots; thaw to edit)
         for pod in rt.cluster.pods.list("default"):
+            pod = thaw(pod)
             pod.metadata.owner_references = []
             rt.cluster.pods.update(pod)
         rt.step(steps=2)

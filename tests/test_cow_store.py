@@ -1,0 +1,256 @@
+"""Copy-on-write store contract (docs/object_ownership.md).
+
+Frozen mode (``copy_on_read=False``, what FakeCluster runs): reads, lists,
+watch events, and subscribe-replay hand out shared immutable snapshots —
+mutating one raises ``FrozenObjectError`` instead of corrupting the cache
+(client-go's Lister contract, enforced) — and the deepcopy moves to the
+mutation boundary. Legacy mode (the constructor default) keeps the old
+private-copy-per-read semantics for bare stores (tests/test_races.py).
+"""
+
+import pytest
+
+from kubeflow_controller_tpu.api.core import (
+    Container,
+    FrozenObjectError,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodSpec,
+    deepcopy_count,
+    is_frozen,
+    thaw,
+)
+from kubeflow_controller_tpu.cluster.events import EventType
+from kubeflow_controller_tpu.cluster.store import Conflict, ObjectStore
+from kubeflow_controller_tpu.controller.informer import Informer
+
+
+def make_pod(name: str, labels=None) -> Pod:
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace="default",
+                            labels=labels or {"job": "j"}),
+        spec=PodSpec(containers=[Container(name="c", image="i")]),
+    )
+
+
+def frozen_store(**kw) -> ObjectStore:
+    return ObjectStore("Pod", copy_on_read=False, **kw)
+
+
+class TestFrozenReads:
+    def test_get_is_shared_and_immutable(self):
+        s = frozen_store()
+        s.create(make_pod("a"))
+        got = s.get("default", "a")
+        assert is_frozen(got)
+        assert s.get("default", "a") is got          # shared, zero-copy
+        with pytest.raises(FrozenObjectError):
+            got.status.phase = PodPhase.RUNNING
+        with pytest.raises(FrozenObjectError):
+            got.metadata.labels["x"] = "y"
+        with pytest.raises(FrozenObjectError):
+            got.spec.containers.append(None)
+
+    def test_list_returns_frozen_refs(self):
+        s = frozen_store(index_labels=("job",))
+        s.create(make_pod("a"))
+        s.create(make_pod("b"))
+        for p in s.list("default", {"job": "j"}):
+            assert is_frozen(p)
+            assert s.get("default", p.metadata.name) is p
+            with pytest.raises(FrozenObjectError):
+                p.metadata.labels["k"] = "v"
+
+    def test_watch_events_are_frozen(self):
+        s = frozen_store()
+        seen = []
+        s.subscribe(seen.append, replay=False)
+        s.create(make_pod("a"))
+        s.mutate("default", "a",
+                 lambda p: setattr(p.status, "phase", PodPhase.RUNNING))
+        s.delete("default", "a")
+        assert [ev.type for ev in seen] == [
+            EventType.ADDED, EventType.MODIFIED, EventType.DELETED]
+        for ev in seen:
+            assert is_frozen(ev.obj)
+            with pytest.raises(FrozenObjectError):
+                ev.obj.status.reason = "edited"
+        assert is_frozen(seen[1].old_obj)            # MODIFIED carries old
+
+    def test_subscribe_replay_is_frozen(self):
+        s = frozen_store()
+        s.create(make_pod("a"))
+        replayed = []
+        s.subscribe(replayed.append, replay=True)
+        (ev,) = replayed
+        assert ev.obj is s.get("default", "a")       # the shared snapshot
+        with pytest.raises(FrozenObjectError):
+            ev.obj.metadata.annotations["k"] = "v"
+
+    def test_zero_deepcopies_on_read_path(self):
+        s = frozen_store()
+        s.create(make_pod("a"))
+        s.subscribe(lambda ev: None, replay=False)
+        before = deepcopy_count()
+        for _ in range(50):
+            s.get("default", "a")
+            s.list("default")
+        s.subscribe(lambda ev: None, replay=True)
+        assert deepcopy_count() == before
+
+
+class TestMutationBoundary:
+    def test_create_caller_object_stays_mutable(self):
+        s = frozen_store()
+        mine = make_pod("a")
+        stored = s.create(mine)
+        assert is_frozen(stored) and not is_frozen(mine)
+        assert mine.metadata.uid                     # stamped in place
+        mine.status.phase = PodPhase.RUNNING         # still my object
+        assert s.get("default", "a").status.phase == PodPhase.PENDING
+
+    def test_create_accepts_frozen_input(self):
+        s1, s2 = frozen_store(), frozen_store()
+        snap = s1.create(make_pod("a"))
+        out = s2.create(snap)                        # e.g. replaying elsewhere
+        assert is_frozen(out) and s2.get("default", "a") is out
+
+    def test_update_takes_ownership_of_unfrozen_input(self):
+        s = frozen_store()
+        s.create(make_pod("a"))
+        mine = thaw(s.get("default", "a"))
+        mine.status.phase = PodPhase.RUNNING
+        out = s.update(mine)
+        assert out is mine                           # sealed in place, 0 copies
+        assert is_frozen(mine)
+        with pytest.raises(FrozenObjectError):       # I gave it away
+            mine.status.reason = "late-write"
+        assert s.get("default", "a") is out
+
+    def test_update_copies_frozen_input_once(self):
+        s = frozen_store()
+        snap = s.create(make_pod("a"))
+        out = s.update(snap)                         # resubmit the snapshot
+        assert out is not snap and is_frozen(out)
+        assert out.metadata.resource_version > snap.metadata.resource_version
+
+    def test_mutate_roundtrip_thaw_update_freeze(self):
+        s = frozen_store()
+        s.create(make_pod("a"))
+        before = s.get("default", "a")
+
+        def fn(p):
+            assert not is_frozen(p)                  # fn gets a private copy
+            p.status.phase = PodPhase.RUNNING
+
+        out = s.mutate("default", "a", fn)
+        assert is_frozen(out) and s.get("default", "a") is out
+        assert out.status.phase == PodPhase.RUNNING
+        assert before.status.phase == PodPhase.PENDING   # old snapshot intact
+
+    def test_stale_thawed_copy_still_conflicts(self):
+        s = frozen_store()
+        s.create(make_pod("a"))
+        stale = thaw(s.get("default", "a"))
+        s.mutate("default", "a",
+                 lambda p: setattr(p.status, "phase", PodPhase.RUNNING))
+        stale.status.phase = PodPhase.FAILED
+        with pytest.raises(Conflict):
+            s.update(stale)
+
+    def test_delete_tombstone_is_frozen(self):
+        s = frozen_store()
+        seen = []
+        s.subscribe(seen.append, replay=False)
+        s.create(make_pod("a"))
+        s.delete("default", "a")
+        tomb = seen[-1].obj
+        assert seen[-1].type == EventType.DELETED and is_frozen(tomb)
+        with pytest.raises(FrozenObjectError):
+            tomb.metadata.name = "x"
+
+
+class _UnfrozenSource:
+    """Watch source delivering private UNFROZEN parses — what a wire
+    (REST/kube) watch source hands the informer."""
+
+    kind = "Pod"
+
+    def __init__(self):
+        self._listeners = []
+
+    def subscribe(self, listener, replay=True):
+        self._listeners.append(listener)
+
+    def unsubscribe(self, listener):
+        self._listeners.remove(listener)
+
+    def emit(self, ev):
+        for fn in self._listeners:
+            fn(ev)
+
+
+class TestInformerNeverLeaksThawed:
+    def test_cache_shares_the_store_snapshot(self):
+        s = frozen_store()
+        s.create(make_pod("a"))
+        inf = Informer(s)
+        inf.start()
+        try:
+            cached = inf.get("default", "a")
+            assert cached is s.get("default", "a")   # zero-copy lister
+            assert inf.list("default") == [cached]
+            assert inf.list("default")[0] is cached
+            with pytest.raises(FrozenObjectError):
+                cached.status.phase = PodPhase.RUNNING
+        finally:
+            inf.stop()
+
+    def test_wire_events_frozen_on_ingest(self):
+        from kubeflow_controller_tpu.cluster.events import WatchEvent
+
+        src = _UnfrozenSource()
+        inf = Informer(src)
+        inf.start()
+        try:
+            pod = make_pod("w")                      # private parse, unfrozen
+            src.emit(WatchEvent(EventType.ADDED, "Pod", pod))
+            cached = inf.get("default", "w")
+            assert cached is pod and is_frozen(cached)
+            with pytest.raises(FrozenObjectError):
+                cached.metadata.labels["x"] = "y"
+        finally:
+            inf.stop()
+
+    def test_resync_redelivers_frozen(self):
+        s = frozen_store()
+        s.create(make_pod("a"))
+        inf = Informer(s)
+        inf.start()
+        try:
+            seen = []
+            inf.add_handler(seen.append)
+            inf.resync()
+            (ev,) = seen
+            assert ev.type == EventType.MODIFIED and is_frozen(ev.obj)
+        finally:
+            inf.stop()
+
+
+class TestLegacyModeUnchanged:
+    def test_default_store_still_hands_out_mutable_copies(self):
+        s = ObjectStore("Pod")                       # copy_on_read=True
+        s.create(make_pod("a"))
+        got = s.get("default", "a")
+        assert not is_frozen(got)
+        got.status.phase = PodPhase.RUNNING          # private copy: fine
+        assert s.get("default", "a").status.phase == PodPhase.PENDING
+
+    def test_legacy_events_are_private_copies(self):
+        s = ObjectStore("Pod")
+        seen = []
+        s.subscribe(seen.append, replay=False)
+        s.create(make_pod("a"))
+        seen[0].obj.metadata.labels["scribble"] = "1"    # must not corrupt
+        assert "scribble" not in s.get("default", "a").metadata.labels

@@ -204,5 +204,80 @@ def test_no_field_drift():
             f"{cls.__name__} fields changed: added "
             f"{actual - expected or '{}'}, removed "
             f"{expected - actual or '{}'} — update {cls.__name__}.deepcopy "
-            f"AND this guard (tests/test_deepcopy.py)"
+            f"AND {cls.__name__}.freeze AND this guard "
+            f"(tests/test_deepcopy.py)"
         )
+
+
+# -- freeze/thaw coverage (the copy-on-write store contract) -----------------
+#
+# freeze() mirrors deepcopy() field-for-field. The walkers below verify the
+# mirror is complete on fully-populated objects: freezing seals every nested
+# dataclass and wraps every container, thawing yields a fully-mutable,
+# contentwise-equal private copy. A freeze() that misses a field fails here.
+
+
+def _assert_deeply_frozen(obj, path="root"):
+    assert getattr(obj, "_sealed", False), (
+        f"{path}: {type(obj).__name__} not sealed — its parent's freeze() "
+        f"misses it")
+    for f in dataclasses.fields(obj):
+        _assert_value_frozen(getattr(obj, f.name), f"{path}.{f.name}")
+
+
+def _assert_value_frozen(v, path):
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        _assert_deeply_frozen(v, path)
+    elif isinstance(v, dict):
+        assert type(v) is core._FrozenDict, (
+            f"{path}: plain dict inside a frozen object")
+        for k, item in v.items():
+            _assert_value_frozen(item, f"{path}[{k!r}]")
+    elif isinstance(v, list):
+        assert type(v) is core._FrozenList, (
+            f"{path}: plain list inside a frozen object")
+        for i, item in enumerate(v):
+            _assert_value_frozen(item, f"{path}[{i}]")
+
+
+def _assert_deeply_thawed(obj, path="root"):
+    assert not getattr(obj, "_sealed", False), f"{path}: still sealed"
+    for f in dataclasses.fields(obj):
+        v = getattr(obj, f.name)
+        if dataclasses.is_dataclass(v) and not isinstance(v, type):
+            _assert_deeply_thawed(v, f"{path}.{f.name}")
+        elif isinstance(v, dict):
+            assert type(v) is dict, f"{path}.{f.name}: frozen dict leaked"
+        elif isinstance(v, list):
+            assert type(v) is list, f"{path}.{f.name}: frozen list leaked"
+
+
+class TestFreezeThaw:
+    def test_freeze_covers_every_field(self):
+        for make in (full_pod, full_service, full_job):
+            obj = make()
+            assert obj.freeze() is obj          # freezes in place
+            _assert_deeply_frozen(obj)
+            assert obj.freeze() is obj          # idempotent
+
+    def test_thaw_roundtrip_equal_and_mutable(self):
+        for make in (full_pod, full_service, full_job):
+            frozen = make().freeze()
+            t = core.thaw(frozen)
+            assert t is not frozen and t == frozen
+            _assert_deeply_thawed(t)
+            assert core.thaw(t) is t            # copy elision when owned
+
+    def test_deepcopy_of_frozen_is_thawed(self):
+        for make in (full_pod, full_service, full_job):
+            frozen = make().freeze()
+            cp = frozen.deepcopy()
+            assert cp == frozen
+            _assert_deeply_thawed(cp)
+
+    def test_every_api_class_is_sealable(self):
+        for cls in EXPECTED_FIELDS:
+            assert issubclass(cls, core.Sealable), cls
+            assert callable(getattr(cls, "freeze", None)), (
+                f"{cls.__name__} has deepcopy but no freeze — the store "
+                f"cannot snapshot it")

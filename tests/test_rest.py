@@ -315,7 +315,10 @@ def test_apply_job_over_rest(client, cluster):
     assert created.spec.replica_specs[0].tpu.num_slices == 1
 
     # controller-side writes land in between: runtime id + status
-    j = cluster.jobs.get("default", "apl")
+    # (store snapshots are frozen; thaw into an owned copy to write)
+    from kubeflow_controller_tpu.api.core import thaw
+
+    j = thaw(cluster.jobs.get("default", "apl"))
     j.spec.runtime_id = "rid42"
     j.status.restarts = 1
     cluster.jobs.update(j)
