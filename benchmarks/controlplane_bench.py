@@ -9,9 +9,15 @@ a pool with capacity for all of them, tick the cluster, and report
 - submit -> gang-running latency percentiles (simulated seconds) — the
   BASELINE.md north-star metric #2,
 - wall-clock reconcile throughput (syncs/sec) and per-sync latency from
-  the controller's own traces.
+  the controller's own traces,
+- async watch-pipeline counters (events_coalesced, max delta-queue depth)
+  and the no-op short-circuit's syncs_skipped_noop, plus a steady-state
+  resync phase that must perform ZERO status writes (docs/watch_pipeline.md).
 
 Deterministic: simulated time, seeded names; wall numbers vary with host.
+``--workers N`` switches to threaded mode (N reconcile workers + a
+wall-clock ticker) so threaded scaling is measurable; 0 (default) is the
+deterministic single-thread drive.
 
 Usage: python benchmarks/controlplane_bench.py [--jobs 100 --slices-each 1]
 """
@@ -64,6 +70,9 @@ def main() -> None:
     ap.add_argument("--jobs", type=int, default=100)
     ap.add_argument("--slices-each", type=int, default=1)
     ap.add_argument("--max-sim-steps", type=int, default=2000)
+    ap.add_argument("--workers", type=int, default=0,
+                    help="reconcile worker threads (0 = deterministic "
+                         "single-thread drive)")
     ap.add_argument("--default-gc", action="store_true",
                     help="skip the serve daemons' GC tuning (for measuring "
                          "the untuned curve)")
@@ -107,9 +116,49 @@ def main() -> None:
             running.add(i)
         return True
 
-    ok = rt.run_until(all_running, dt=1.0, max_steps=args.max_sim_steps)
+    if args.workers:
+        rt.start_threads(workers=args.workers)
+        deadline = time.time() + max(120.0, args.max_sim_steps * 0.1)
+        ok = False
+        while time.time() < deadline:
+            if all_running():
+                ok = True
+                break
+            time.sleep(0.02)
+    else:
+        ok = rt.run_until(all_running, dt=1.0, max_steps=args.max_sim_steps)
     wall = time.perf_counter() - t_wall
     dcopies = deepcopy_count() - dc0
+
+    # Settle the queue tail: the poll above exits on phase alone, leaving
+    # the final status-write events (each job's steady, fingerprint-
+    # recording sync) parked behind drain()'s per-call item cap.
+    def quiesce(budget_s: float = 60.0) -> None:
+        if args.workers:
+            deadline = time.time() + budget_s
+            while (time.time() < deadline
+                   and not rt.controller.queue.empty_and_idle()):
+                time.sleep(0.01)
+        else:
+            while rt.controller.drain(max_items=5000):
+                pass
+
+    quiesce()
+
+    # Steady-state resync: re-deliver every cached object as MODIFIED and
+    # reconcile all N jobs again. With the no-op short-circuit the whole
+    # wave must cost fingerprint compares only — zero job status writes.
+    rv_before = rt.cluster.jobs.revision
+    skipped_before = rt.controller.syncs_skipped_noop
+    t_resync = time.perf_counter()
+    for inf in (rt.job_informer, rt.pod_informer, rt.service_informer):
+        inf.resync()
+    quiesce()
+    if args.workers:
+        rt.stop()
+    resync_wall = time.perf_counter() - t_resync
+    resync_status_writes = rt.cluster.jobs.revision - rv_before
+    resync_skipped = rt.controller.syncs_skipped_noop - skipped_before
 
     lat = []
     if ok:   # all_running_time defaults to 0.0 until a gang actually runs
@@ -120,9 +169,11 @@ def main() -> None:
         lat = [float("nan")]
     n_syncs = rt.controller.sync_count
     sync_wall = rt.controller.sync_wall_s
+    stores = (rt.cluster.jobs, rt.cluster.pods, rt.cluster.services)
     print(json.dumps({
         "jobs": args.jobs,
         "slices_each": args.slices_each,
+        "workers": args.workers,
         "all_running": ok,
         "pods": len(rt.cluster.pods.list("default")),
         "submit_to_running_sim_s": {
@@ -149,6 +200,18 @@ def main() -> None:
         "deepcopies_total": dcopies,
         "deepcopies_per_sync": round(dcopies / n_syncs, 2)
         if n_syncs else None,
+        # async watch pipeline (summed/maxed over the three stores)
+        "events_coalesced": sum(s.events_coalesced for s in stores),
+        "watch_queue_depth_max": max(
+            s.max_watch_queue_depth for s in stores),
+        "watch_queue_overflows": sum(
+            s.watch_queue_overflows for s in stores),
+        # no-op short-circuit: total skips, and the steady-state resync
+        # wave's cost — status writes MUST be 0 when nothing changed
+        "syncs_skipped_noop": rt.controller.syncs_skipped_noop,
+        "resync_status_writes": resync_status_writes,
+        "resync_syncs_skipped": resync_skipped,
+        "resync_wall_s": round(resync_wall, 2),
     }))
 
 

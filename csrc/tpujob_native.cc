@@ -57,9 +57,22 @@ class WorkQueue {
       return;
     }
     std::lock_guard<std::mutex> g(mu_);
-    if (shutdown_ || queued_.count(key)) return;
-    queued_.insert(key);
-    delayed_.push(DelayedItem{now_s() + delay, seq_++, key});
+    if (shutdown_) return;
+    double due = now_s() + delay;
+    if (queued_.count(key)) {
+      auto it = delayed_due_.find(key);
+      // Already ready in the FIFO: fires sooner than any delay.
+      if (it == delayed_due_.end()) return;
+      // Parked with an earlier-or-equal deadline already.
+      if (due >= it->second) return;
+      // Parked with a LATER deadline: keep the earliest one (client-go
+      // delaying-queue semantics). The old heap entry goes stale and is
+      // skipped when it surfaces in PromoteDueLocked.
+    } else {
+      queued_.insert(key);
+    }
+    delayed_due_[key] = due;
+    delayed_.push(DelayedItem{due, seq_++, key});
     cv_.notify_one();
   }
 
@@ -132,12 +145,14 @@ class WorkQueue {
 
   int Len() {
     std::lock_guard<std::mutex> g(mu_);
-    return static_cast<int>(fifo_.size() + delayed_.size());
+    // delayed_due_ counts real parked items; delayed_ may hold stale
+    // superseded entries.
+    return static_cast<int>(fifo_.size() + delayed_due_.size());
   }
 
   bool EmptyAndIdle() {
     std::lock_guard<std::mutex> g(mu_);
-    return fifo_.empty() && delayed_.empty() && processing_.empty() &&
+    return fifo_.empty() && delayed_due_.empty() && processing_.empty() &&
            redo_.empty();
   }
 
@@ -161,6 +176,9 @@ class WorkQueue {
       // parked for a long TTL/backoff swallows event-driven re-enqueues
       // until the delay fires.
       if (!InFifoLocked(key)) {
+        // The parked heap entry goes stale (due-map cleared) and is
+        // skipped when it surfaces.
+        delayed_due_.erase(key);
         fifo_.push_back(key);
         cv_.notify_one();
       }
@@ -175,9 +193,18 @@ class WorkQueue {
   // delayed item, or -1 if none.
   double PromoteDueLocked() {
     double now = now_s();
-    while (!delayed_.empty() && delayed_.top().due <= now) {
-      std::string key = delayed_.top().key;
+    while (!delayed_.empty()) {
+      const DelayedItem& top = delayed_.top();
+      auto it = delayed_due_.find(top.key);
+      if (it == delayed_due_.end() || it->second != top.due) {
+        // Stale: superseded by a shorter deadline or an immediate Add.
+        delayed_.pop();
+        continue;
+      }
+      if (top.due > now) break;
+      std::string key = top.key;
       delayed_.pop();
+      delayed_due_.erase(key);
       if (queued_.count(key)) {  // not cancelled
         if (processing_.count(key)) {
           redo_.insert(key);
@@ -202,6 +229,8 @@ class WorkQueue {
   std::priority_queue<DelayedItem, std::vector<DelayedItem>,
                       std::greater<DelayedItem>>
       delayed_;
+  // key -> authoritative due time; heap entries that disagree are stale.
+  std::unordered_map<std::string, double> delayed_due_;
   uint64_t seq_ = 0;
   std::unordered_map<std::string, int> failures_;
   bool shutdown_ = false;

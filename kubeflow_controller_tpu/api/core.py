@@ -207,6 +207,11 @@ class ObjectMeta(Sealable):
     namespace: str = "default"
     uid: str = ""
     resource_version: int = 0
+    # Spec revision (k8s metadata.generation): the store bumps it only when
+    # .spec changes; status-subresource writes keep it. Paired with
+    # status.observed_generation it powers the controller's no-op sync
+    # short-circuit (docs/watch_pipeline.md).
+    generation: int = 0
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     owner_references: List[OwnerReference] = field(default_factory=list)
@@ -231,6 +236,7 @@ class ObjectMeta(Sealable):
             namespace=self.namespace,
             uid=self.uid,
             resource_version=self.resource_version,
+            generation=self.generation,
             labels=dict(self.labels),
             annotations=dict(self.annotations),
             owner_references=[r.deepcopy() for r in self.owner_references],

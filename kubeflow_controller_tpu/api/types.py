@@ -319,6 +319,10 @@ class TPUJobStatus(Sealable):
     # When the last gang restart fired (controller clock) — drives the
     # exponential failure-restart backoff.
     last_restart_time: float = 0.0
+    # metadata.generation of the spec this status was computed from
+    # (training-operator observedGeneration): the no-op sync short-circuit
+    # trusts a steady fingerprint only once status has caught up to spec.
+    observed_generation: int = 0
 
     def deepcopy(self) -> "TPUJobStatus":
         return TPUJobStatus(
@@ -332,6 +336,7 @@ class TPUJobStatus(Sealable):
             restarts=self.restarts,
             resizes=self.resizes,
             last_restart_time=self.last_restart_time,
+            observed_generation=self.observed_generation,
         )
 
     def __deepcopy__(self, memo) -> "TPUJobStatus":

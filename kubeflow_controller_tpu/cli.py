@@ -162,7 +162,8 @@ def _make_handler(rt: LocalRuntime):
                         "duration_ms": round(tr.duration * 1000, 3),
                         "error": tr.error, "note": tr.note,
                     }
-                    for tr in rt.controller.traces[-200:]
+                    # traces is a bounded deque: copy before slicing
+                    for tr in list(rt.controller.traces)[-200:]
                 ]}
             if parts[:1] == ["slices"] and method == "GET" and len(parts) == 2:
                 from kubeflow_controller_tpu.cluster.slices import (

@@ -231,6 +231,15 @@ class FakeCluster:
         for _ in range(steps):
             with self._lock:
                 self.now += dt
+            # Quiesce the async watch pipelines before acting on this
+            # step's clock: _pending_keys/_active_keys are fed by the pod
+            # watch stream, and scheduler/kubelet decisions must see every
+            # write completed before the tick (determinism contract,
+            # docs/watch_pipeline.md). Flushed outside self._lock — a
+            # delta handler may take it.
+            self.pods.flush()
+            self.services.flush()
+            self.jobs.flush()
             self._schedule_pending()
             self._advance_pods()
 

@@ -13,7 +13,11 @@ something (SURVEY.md §7 "hard parts" #3):
   mutates informer-cached objects in place, ``updater/distributed.go:51-54``
   — a listed bug; both modes make that corruption impossible, frozen mode
   without the per-read copy tax — see docs/object_ownership.md);
-- every mutation emits a WatchEvent to subscribers.
+- every mutation emits a WatchEvent to subscribers — through per-subscriber
+  delta queues drained OUTSIDE the store lock (client-go's sharedProcessor /
+  DeltaFIFO shape), with consecutive MODIFIEDs per key coalesced to the
+  latest snapshot. See docs/watch_pipeline.md for the ordering/flush
+  contract.
 """
 
 from __future__ import annotations
@@ -21,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from kubeflow_controller_tpu.api.core import is_frozen, new_uid, thaw
@@ -40,6 +45,30 @@ class Conflict(ValueError):
 
 
 Listener = Callable[[WatchEvent], None]
+
+
+class _Subscription:
+    """One watch listener's delta queue + dispatch state.
+
+    The client-go ``processorListener`` analog: writers append deltas under
+    the store lock (cheap — one dict probe and a deque append), and whichever
+    thread wins the ``dispatching`` flag delivers them with NO store lock
+    held. ``tail`` maps key -> the newest still-coalescible pending entry so
+    a burst of MODIFIEDs for one key collapses to the latest snapshot
+    (DeltaFIFO semantics) instead of queueing N handler invocations.
+    """
+
+    __slots__ = ("listener", "lock", "cond", "pending", "tail", "dispatching")
+
+    def __init__(self, listener: Listener):
+        self.listener = listener
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        # entries are mutable [event, key] pairs so coalescing can swap the
+        # event in place without disturbing queue order
+        self.pending: deque = deque()
+        self.tail: Dict[str, list] = {}
+        self.dispatching = False
 
 
 class ObjectStore:
@@ -64,6 +93,7 @@ class ObjectStore:
         now_fn: Callable[[], float] = time.time,
         index_labels: tuple = (),
         copy_on_read: bool = True,
+        watch_queue_soft_max: int = 1024,
     ):
         self.kind = kind
         self._now_fn = now_fn
@@ -72,7 +102,16 @@ class ObjectStore:
         self._objects: Dict[str, Any] = {}
         self._rv = 0
         self._last_delete_rv = 0
-        self._listeners: List[Listener] = []
+        self._subs: List[_Subscription] = []
+        self._sub_by_listener: Dict[Listener, _Subscription] = {}
+        # Delta-queue instrumentation (benchmarks/controlplane_bench.py).
+        # The bound is soft: coalescing keeps steady-state depth at O(hot
+        # keys), and a writer cannot block under the store lock without
+        # inviting deadlock, so overflow is counted, not enforced.
+        self._watch_queue_soft_max = watch_queue_soft_max
+        self._events_coalesced = 0
+        self._max_queue_depth = 0
+        self._queue_overflows = 0
         # Label indexes (client-go Indexer analog): selector lists on an
         # indexed key touch only matching objects instead of scanning the
         # namespace — the difference between O(jobs) and O(jobs^2) total
@@ -104,29 +143,139 @@ class ObjectStore:
         """Register a watch listener. With ``replay``, synthesizes ADDED events
         for existing objects first (how a fresh informer list+watch behaves).
 
-        Replay + registration are atomic under the store lock (and _emit
-        also runs under it), so a subscriber can never observe a newer
-        event before the stale replay copy — the watch stream is totally
-        ordered. Listeners must therefore be fast and must not call back
-        into a *different* store (same-store reentry is fine: RLock)."""
+        Replay + registration are atomic under the store lock (enqueues also
+        happen under it), so a subscriber can never observe a newer event
+        before the stale replay copy — each subscriber's queue is totally
+        ordered by resource version. Delivery itself happens OFF the lock:
+        the writing thread (or whichever thread currently owns the
+        subscriber's dispatch flag) drains the queue after the store lock is
+        released, so a slow handler never serializes other writers. A
+        listener may call back into this or any other store."""
+        sub = _Subscription(listener)
         with self._lock:
             if replay:
-                for obj in self._objects.values():
-                    listener(WatchEvent(
+                for key, obj in self._objects.items():
+                    self._enqueue(sub, key, WatchEvent(
                         EventType.ADDED, self.kind,
                         obj.deepcopy() if self._copy_on_read else obj,
                     ))
-            self._listeners.append(listener)
+            self._subs.append(sub)
+            self._sub_by_listener[listener] = sub
+        self._drain(sub)
 
     def unsubscribe(self, listener: Listener) -> None:
         with self._lock:
-            if listener in self._listeners:
-                self._listeners.remove(listener)
+            sub = self._sub_by_listener.pop(listener, None)
+            if sub is not None:
+                self._subs.remove(sub)
+
+    # -- delta queues + dispatcher -------------------------------------------
 
     def _emit(self, ev: WatchEvent) -> None:
-        # Caller holds self._lock: delivery order == resource-version order.
-        for listener in list(self._listeners):
-            listener(ev)
+        # Caller holds self._lock: enqueue order == resource-version order.
+        # No listener runs here — the write path only appends deltas; the
+        # caller invokes _dispatch() after releasing the lock.
+        key = f"{ev.obj.metadata.namespace}/{ev.obj.metadata.name}"
+        for sub in self._subs:
+            self._enqueue(sub, key, ev)
+
+    def _enqueue(self, sub: _Subscription, key: str, ev: WatchEvent) -> None:
+        with sub.lock:
+            entry = sub.tail.get(key)
+            if entry is not None and ev.type == EventType.MODIFIED:
+                # Coalesce: consecutive MODIFIEDs for one key collapse to the
+                # latest snapshot; a pending ADDED absorbs the MODIFIED and
+                # stays ADDED (client-go DeltaFIFO). old_obj keeps the oldest
+                # undelivered state so handlers still see the cumulative diff.
+                prior = entry[0]
+                entry[0] = WatchEvent(prior.type, ev.kind, ev.obj,
+                                      prior.old_obj)
+                self._events_coalesced += 1
+                return
+            entry = [ev, key]
+            sub.pending.append(entry)
+            depth = len(sub.pending)
+            if ev.type == EventType.DELETED:
+                # Nothing coalesces across a tombstone: a re-create after
+                # delete must arrive as its own ADDED.
+                sub.tail.pop(key, None)
+            else:
+                sub.tail[key] = entry
+        if depth > self._max_queue_depth:
+            self._max_queue_depth = depth
+        if depth > self._watch_queue_soft_max:
+            self._queue_overflows += 1
+
+    def _dispatch(self) -> None:
+        """Drain every subscriber's queue, called with NO store lock held."""
+        with self._lock:
+            subs = list(self._subs)
+        for sub in subs:
+            self._drain(sub)
+
+    @staticmethod
+    def _drain(sub: _Subscription) -> None:
+        with sub.lock:
+            if sub.dispatching:
+                return  # the active dispatcher will deliver our entries too
+            sub.dispatching = True
+        while True:
+            with sub.lock:
+                if not sub.pending:
+                    sub.dispatching = False
+                    sub.cond.notify_all()
+                    return
+                entry = sub.pending.popleft()
+                ev, key = entry
+                if sub.tail.get(key) is entry:
+                    del sub.tail[key]
+            try:
+                sub.listener(ev)
+            except BaseException:
+                with sub.lock:
+                    sub.dispatching = False
+                    sub.cond.notify_all()
+                raise
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Quiesce the watch pipeline: block until every subscriber's delta
+        queue is empty and no dispatcher is mid-delivery. The determinism
+        hook FakeCluster.tick / Controller.drain rely on — after flush(),
+        every completed write has been observed by every subscriber. Returns
+        False only if a foreign dispatcher failed to finish within
+        ``timeout`` wall seconds (it keeps our own draining unbounded)."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            subs = list(self._subs)
+        for sub in subs:
+            while True:
+                self._drain(sub)
+                with sub.lock:
+                    if not sub.pending and not sub.dispatching:
+                        break
+                    if sub.dispatching:
+                        if time.monotonic() >= deadline:
+                            return False
+                        sub.cond.wait(0.05)
+        return True
+
+    @property
+    def events_coalesced(self) -> int:
+        """MODIFIED events absorbed into a newer pending snapshot."""
+        with self._lock:
+            return self._events_coalesced
+
+    @property
+    def max_watch_queue_depth(self) -> int:
+        """High-water mark of any subscriber's pending delta queue."""
+        with self._lock:
+            return self._max_queue_depth
+
+    @property
+    def watch_queue_overflows(self) -> int:
+        """Enqueues observed past the soft bound (diagnostic)."""
+        with self._lock:
+            return self._queue_overflows
 
     # -- CRUD ----------------------------------------------------------------
 
@@ -155,6 +304,7 @@ class ObjectStore:
                 meta.uid = new_uid(self.kind.lower())
             self._rv += 1
             meta.resource_version = self._rv
+            meta.generation = 1   # apiserver stamps generation 1 on create
             if not meta.creation_timestamp:
                 meta.creation_timestamp = self._now_fn()
             # One copy total in frozen mode: the caller's object is stamped
@@ -170,9 +320,12 @@ class ObjectStore:
                 self._emit(
                     WatchEvent(EventType.ADDED, self.kind, stored.deepcopy())
                 )
-                return stored.deepcopy()
-            self._emit(WatchEvent(EventType.ADDED, self.kind, stored))
-            return stored
+                ret = stored.deepcopy()
+            else:
+                self._emit(WatchEvent(EventType.ADDED, self.kind, stored))
+                ret = stored
+        self._dispatch()
+        return ret
 
     def get(self, namespace: str, name: str) -> Any:
         with self._lock:
@@ -211,6 +364,7 @@ class ObjectStore:
                     obj = obj.deepcopy()
                 self._rv += 1
                 obj.metadata.resource_version = self._rv
+                self._stamp_generation(obj, cur)
                 old = cur
                 stored = obj.freeze()
                 self._index_remove(key, old)
@@ -219,19 +373,31 @@ class ObjectStore:
                 self._emit(WatchEvent(
                     EventType.MODIFIED, self.kind, stored, old,
                 ))
-                return stored
-            self._rv += 1
-            obj.metadata.resource_version = self._rv
-            old = cur
-            stored = obj.deepcopy()
-            self._index_remove(key, old)
-            self._objects[key] = stored
-            self._index_add(key, stored)
-            self._emit(WatchEvent(
-                EventType.MODIFIED, self.kind,
-                stored.deepcopy(), old.deepcopy(),
-            ))
-            return stored.deepcopy()
+                ret = stored
+            else:
+                self._rv += 1
+                obj.metadata.resource_version = self._rv
+                self._stamp_generation(obj, cur)
+                old = cur
+                stored = obj.deepcopy()
+                self._index_remove(key, old)
+                self._objects[key] = stored
+                self._index_add(key, stored)
+                self._emit(WatchEvent(
+                    EventType.MODIFIED, self.kind,
+                    stored.deepcopy(), old.deepcopy(),
+                ))
+                ret = stored.deepcopy()
+        self._dispatch()
+        return ret
+
+    @staticmethod
+    def _stamp_generation(obj: Any, cur: Any) -> None:
+        """k8s generation semantics: metadata.generation bumps iff the
+        desired state (.spec) changed; status-only writes keep it. The
+        no-op sync short-circuit keys off this (docs/watch_pipeline.md)."""
+        bump = 1 if (hasattr(obj, "spec") and obj.spec != cur.spec) else 0
+        obj.metadata.generation = cur.metadata.generation + bump
 
     def update_status(self, obj: Any) -> Any:
         """Status-subresource update: replace only ``.status``, rv-checked.
@@ -276,13 +442,16 @@ class ObjectStore:
                 self._emit(WatchEvent(
                     EventType.MODIFIED, self.kind, stored, old,
                 ))
-                return stored
-            self._objects[key] = stored
-            self._emit(WatchEvent(
-                EventType.MODIFIED, self.kind,
-                stored.deepcopy(), old.deepcopy(),
-            ))
-            return stored.deepcopy()
+                ret = stored
+            else:
+                self._objects[key] = stored
+                self._emit(WatchEvent(
+                    EventType.MODIFIED, self.kind,
+                    stored.deepcopy(), old.deepcopy(),
+                ))
+                ret = stored.deepcopy()
+        self._dispatch()
+        return ret
 
     def mutate(self, namespace: str, name: str, fn: Callable[[Any], None]) -> Any:
         """Read-modify-write with internal retry — the conflict-safe update
@@ -314,7 +483,8 @@ class ObjectStore:
             if not self._copy_on_read:
                 tomb.freeze()
             self._emit(WatchEvent(EventType.DELETED, self.kind, tomb))
-            return obj
+        self._dispatch()
+        return obj
 
     # -- listing -------------------------------------------------------------
 

@@ -21,8 +21,9 @@ import random
 import string
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from kubeflow_controller_tpu.api.core import Pod, Service, is_frozen
 from kubeflow_controller_tpu.api.types import (
@@ -110,10 +111,17 @@ class Controller:
 
         self.queue = make_queue()
         self.expectations = make_expectations()
-        self.traces: List[SyncTrace] = []   # ring buffer (last 1000)
+        # Ring buffer of the last 1000 traces. deque(maxlen=) trims on
+        # append under the GIL — safe with concurrent workers, unlike the
+        # old unlocked append + del[:-1000] pair.
+        self.traces: Deque[SyncTrace] = deque(maxlen=1000)
         self.sync_count = 0                 # total syncs, never truncated
         self.sync_wall_s = 0.0              # wall seconds inside sync()
+        self.syncs_skipped_noop = 0         # fingerprint fast-path exits
         self._count_lock = threading.Lock()
+        # key -> fingerprint of the last fully-steady sync; a matching
+        # fingerprint lets sync() exit before claim/plan/status work.
+        self._last_sync_fp: Dict[str, Tuple] = {}
         # Sim-clock backoff deadlines (key -> now_fn deadline); see
         # _requeue_after / _kick_sim_backoffs.
         self._sim_backoffs: Dict[str, float] = {}
@@ -131,6 +139,8 @@ class Controller:
         if ev.type == EventType.DELETED:
             # Deletion path the reference stubbed (controller.go:505-508).
             self.expectations.delete_expectations(key)
+            with self._count_lock:
+                self._last_sync_fp.pop(key, None)
         self.queue.add(key)
 
     def _on_resource_event(self, ev: WatchEvent) -> None:
@@ -190,14 +200,30 @@ class Controller:
         """Synchronously process every ready queue item — the deterministic
         test-mode alternative to run()."""
         self._kick_sim_backoffs()
+        self._flush_informers()
         n = 0
         while n < max_items:
             item = self.queue.get(timeout=0)
             if item is None:
-                return n
+                # A dispatcher on another thread may still be delivering
+                # watch events that will enqueue more work: quiesce the
+                # pipeline and look again before declaring the queue dry.
+                self._flush_informers()
+                item = self.queue.get(timeout=0)
+                if item is None:
+                    return n
             self._process(item)
             n += 1
         return n
+
+    def _flush_informers(self) -> None:
+        """Quiesce the async watch pipeline: every event from a completed
+        store write is delivered before this returns (no-op for watch
+        sources without a flush hook, e.g. wire watches)."""
+        for inf in (self.jobs, self.pods, self.services):
+            flush = getattr(inf, "flush", None)
+            if flush is not None:
+                flush()
 
     def _process(self, key: str) -> None:
         import time as _time
@@ -225,7 +251,6 @@ class Controller:
                 # under the simulated clock.
                 self.sync_wall_s += wall
             self.traces.append(trace)
-            del self.traces[:-1000]
 
     # -- the sync handler ----------------------------------------------------
 
@@ -238,6 +263,28 @@ class Controller:
             self._cleanup_deleted(namespace, name)
             trace.outcome = "deleted-cleanup"
             return
+        deleting = job.metadata.deletion_timestamp is not None
+
+        # No-op short-circuit (training-operator generation/observedGeneration
+        # skip): when the job's spec generation has been observed by status
+        # and nothing in the observable world — job rv, owned pod/service
+        # rvs, slice health — moved since the last fully-steady sync, the
+        # whole validate/claim/plan/status pass is provably a no-op. Any
+        # store change emits a watch event that re-enqueues the key and
+        # shifts this fingerprint, so the skip is self-correcting; eventless
+        # health flips (sim fault injection) shift the slice component and
+        # are caught on the next resync.
+        fp = None
+        if (
+            satisfied and not deleting
+            and job.status.observed_generation == job.metadata.generation
+        ):
+            fp = self._sync_fingerprint(namespace, name, job)
+            with self._count_lock:
+                if fp == self._last_sync_fp.get(key):
+                    self.syncs_skipped_noop += 1
+                    trace.outcome = "noop-skip"
+                    return
 
         try:
             validate_job(job)
@@ -276,15 +323,11 @@ class Controller:
         # planner will read it — for local/terminal/suspended/unstamped jobs
         # the slice query (an HTTP round-trip on the REST backend) is waste.
         health = None
-        if (
-            job.spec.runtime_id and not job.is_done()
-            and not job.spec.suspend and job.worker_spec() is not None
-        ):
+        if self._wants_health(job):
             health = assess_health(
                 pods, self.client.job_slices(
                     job.metadata.uid, job.metadata.name))
         plan = plan_job(job, pods, services, health=health)
-        deleting = job.metadata.deletion_timestamp is not None
 
         executed = False
         if satisfied and not deleting:
@@ -294,7 +337,7 @@ class Controller:
 
         # Status update (conflict-retried, unlike controller.go:630-636).
         now = self.opts.now_fn()
-        self._update_status(
+        wrote = self._update_status(
             namespace, name, pods, now,
             fail_reason=plan.fail_reason,
             recovering=plan.gang_restart,
@@ -312,6 +355,7 @@ class Controller:
         # (k8s Job / training-operator semantics). Deletion flows through
         # the deleted-job cleanup path, removing pods/services too.
         ttl = job.spec.ttl_seconds_after_finished
+        requeued = False
         if ttl is not None and job.is_done():
             cur = self.client.get_job_snapshot(namespace, name)  # read-only
             # guard on the phase, not on completion_time's truthiness —
@@ -326,10 +370,62 @@ class Controller:
                     trace.outcome = "ttl-deleted"
                     return
                 self._requeue_after(key, remaining)
+                requeued = True
 
         if trace.outcome == "":
             trace.outcome = "executed" if executed else "steady"
         trace.note = plan.note
+
+        # Record the fingerprint only after a *provably* steady pass: the
+        # planner found nothing to do, nothing was executed or written, and
+        # no deferred work (TTL timer, restart backoff — the latter keeps
+        # plan.gang_restart set, failing is_noop) is pending. Recording on
+        # any other pass could freeze out a sync the deferral depends on.
+        if (
+            fp is not None and not executed and not wrote
+            and not requeued and plan.is_noop()
+        ):
+            with self._count_lock:
+                self._last_sync_fp[key] = fp
+
+    @staticmethod
+    def _wants_health(job: TPUJob) -> bool:
+        """Whether the planner will read slice health for this job (shared
+        gate between the full sync path and the fingerprint)."""
+        return bool(
+            job.spec.runtime_id and not job.is_done()
+            and not job.spec.suspend and job.worker_spec() is not None
+        )
+
+    def _sync_fingerprint(self, namespace: str, name: str, job: TPUJob) -> Tuple:
+        """The observable world a sync would act on, as a cheap comparable:
+        job identity/rv/generation, owned pod and service resource versions
+        (label-selected, pre-claim — an adoptable orphan shifts it), and
+        the slice-health picture the planner would see. Store lists are
+        label-indexed, so this is O(owned objects), no claim writes, no
+        planning, no status diff."""
+        pods = self.client.list_pods(namespace, {naming.LABEL_JOB: name})
+        services = self.client.list_services(
+            namespace, {naming.LABEL_JOB: name})
+        health_key = None
+        if self._wants_health(job):
+            health_key = tuple(sorted(
+                (s.name, s.healthy)
+                for s in self.client.job_slices(
+                    job.metadata.uid, job.metadata.name)
+            ))
+        return (
+            job.metadata.uid,
+            job.metadata.resource_version,
+            job.metadata.generation,
+            tuple(sorted(
+                (p.metadata.uid, p.metadata.resource_version)
+                for p in pods)),
+            tuple(sorted(
+                (s.metadata.uid, s.metadata.resource_version)
+                for s in services)),
+            health_key,
+        )
 
     def _stamp_runtime_id(
         self, namespace: str, name: str, stamp: Callable[[TPUJob], None]
@@ -503,7 +599,11 @@ class Controller:
     def _update_status(
         self, ns: str, name: str, pods: List[Pod], now: float,
         fail_reason: str, recovering: bool, suspended: bool = False,
-    ) -> None:
+    ) -> bool:
+        """Returns True when a status write happened (or was attempted and
+        kept conflicting) — the no-op fingerprint must not be recorded on
+        such a pass, because the write's own MODIFIED event will re-enqueue
+        the key with a new resource version."""
         # Write only when something changed (the reference's ShouldUpdate
         # contract) — an unconditional write would emit MODIFIED, re-enqueue
         # the job, and reconcile would chase its own tail forever.
@@ -517,7 +617,7 @@ class Controller:
         for _ in range(10):
             snap = self.client.get_job_snapshot(ns, name)
             if snap is None:
-                return
+                return False
             if is_frozen(snap):
                 job = dataclasses.replace(
                     snap, status=snap.status.deepcopy())
@@ -528,12 +628,13 @@ class Controller:
                 recovering=recovering, suspended=suspended,
             )
             if not changed:
-                return
+                return False
             try:
                 self.client.update_job_status(job)
-                return
+                return True
             except Conflict:
                 continue
+        return True
 
     # -- deleted-job cleanup -------------------------------------------------
 
@@ -541,6 +642,8 @@ class Controller:
         """Job object is gone: delete owned resources, release slices.
         (The reference leaks everything here — deletion handlers are stubs.)"""
         self.expectations.delete_expectations(f"{namespace}/{name}")
+        with self._count_lock:
+            self._last_sync_fp.pop(f"{namespace}/{name}", None)
         uids = set()
         for pod in self.client.list_pods(namespace, {naming.LABEL_JOB: name}):
             ref = pod.metadata.controller_ref()

@@ -40,6 +40,14 @@ class Informer:
         self._resync_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._synced = False
+        # Mirror the store's label indexes (client-go Indexer): selector
+        # lists on an indexed key touch only matching cache entries instead
+        # of scanning everything. Wire-backed sources (REST/kube watch)
+        # advertise no indexes — the scan fallback still works.
+        self._index_labels = tuple(getattr(store, "_index_labels", ()))
+        self._index: Dict[str, Dict[str, set]] = {
+            lk: {} for lk in self._index_labels
+        }
 
     # -- wiring --------------------------------------------------------------
 
@@ -64,6 +72,31 @@ class Informer:
     def has_synced(self) -> bool:
         return self._synced
 
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Quiesce the watch pipeline feeding this informer: after this,
+        every completed store write has passed through ``_on_event`` (cache
+        + handlers). No-op (True) for watch sources without a flush hook."""
+        fl = getattr(self._store, "flush", None)
+        return fl(timeout) if fl is not None else True
+
+    # -- label index maintenance (caller holds self._lock) -------------------
+
+    def _index_add(self, key: str, obj: Any) -> None:
+        for lk in self._index_labels:
+            v = obj.metadata.labels.get(lk)
+            if v is not None:
+                self._index[lk].setdefault(v, set()).add(key)
+
+    def _index_remove(self, key: str, obj: Any) -> None:
+        for lk in self._index_labels:
+            v = obj.metadata.labels.get(lk)
+            if v is not None:
+                bucket = self._index[lk].get(v)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del self._index[lk][v]
+
     # -- event path ----------------------------------------------------------
 
     def _on_event(self, ev: WatchEvent) -> None:
@@ -75,10 +108,14 @@ class Informer:
             ev.obj.freeze()
         key = f"{ev.obj.metadata.namespace}/{ev.obj.metadata.name}"
         with self._lock:
+            old = self._cache.get(key)
+            if old is not None:
+                self._index_remove(key, old)
             if ev.type == EventType.DELETED:
                 self._cache.pop(key, None)
             else:
                 self._cache[key] = ev.obj
+                self._index_add(key, ev.obj)
         for h in list(self._handlers):
             h(ev)
 
@@ -110,8 +147,15 @@ class Informer:
     ) -> List[Any]:
         """Shared frozen references (zero-copy); ``thaw()`` before mutating."""
         with self._lock:
+            candidates = self._cache
+            if label_selector:
+                for lk in self._index_labels:
+                    if lk in label_selector:
+                        keys = self._index[lk].get(label_selector[lk], set())
+                        candidates = {k: self._cache[k] for k in keys}
+                        break
             out = []
-            for obj in self._cache.values():
+            for obj in candidates.values():
                 if namespace is not None and obj.metadata.namespace != namespace:
                     continue
                 if label_selector and not selector_matches(

@@ -35,6 +35,11 @@ class RateLimitingQueue:
         self._processing: Set[Hashable] = set()
         self._redo: Set[Hashable] = set()      # re-added while processing
         self._delayed: List[Tuple[float, int, Hashable]] = []  # min-heap
+        # item -> authoritative due time. The heap may hold superseded
+        # entries (an add_after with a shorter delay re-pushes); an entry
+        # whose due time disagrees with this map is stale and is skipped
+        # lazily in _promote_due. Count delayed items here, not in the heap.
+        self._delayed_due: Dict[Hashable, float] = {}
         self._delayed_seq = 0
         self._failures: Dict[Hashable, int] = {}
         self._shutdown = False
@@ -55,6 +60,9 @@ class RateLimitingQueue:
                     # add BEATS the pending delay — k8s workqueue semantics.
                     # Without this, a key parked for a long TTL/backoff
                     # would swallow event-driven re-enqueues until it fires.
+                    # Its heap entry goes stale (due-map cleared) and is
+                    # skipped when it surfaces.
+                    self._delayed_due.pop(item, None)
                     self._queue.append(item)
                     self._cond.notify()
                 return
@@ -67,13 +75,25 @@ class RateLimitingQueue:
             self.add(item)
             return
         with self._cond:
-            if self._shutdown or item in self._queued:
+            if self._shutdown:
                 return
-            self._queued.add(item)
+            due = time.monotonic() + delay
+            if item in self._queued:
+                cur = self._delayed_due.get(item)
+                if cur is None:
+                    # Already ready in the FIFO — fires sooner than any delay.
+                    return
+                if due >= cur:
+                    # Parked with an earlier-or-equal deadline already.
+                    return
+                # Parked with a LATER deadline: keep the earliest one
+                # (client-go delaying-queue semantics). The old heap entry
+                # is now stale and is skipped when it surfaces.
+            else:
+                self._queued.add(item)
+            self._delayed_due[item] = due
             self._delayed_seq += 1
-            heapq.heappush(
-                self._delayed, (time.monotonic() + delay, self._delayed_seq, item)
-            )
+            heapq.heappush(self._delayed, (due, self._delayed_seq, item))
             self._cond.notify()
 
     def add_rate_limited(self, item: Hashable) -> None:
@@ -97,8 +117,16 @@ class RateLimitingQueue:
         """Move due delayed items into the FIFO; return seconds until the next
         delayed item (None if heap empty)."""
         now = time.monotonic()
-        while self._delayed and self._delayed[0][0] <= now:
-            _, _, item = heapq.heappop(self._delayed)
+        while self._delayed:
+            due, _, item = self._delayed[0]
+            if self._delayed_due.get(item) != due:
+                # Stale: superseded by a shorter deadline or an immediate add.
+                heapq.heappop(self._delayed)
+                continue
+            if due > now:
+                break
+            heapq.heappop(self._delayed)
+            del self._delayed_due[item]
             if item in self._queued:  # not cancelled
                 if item in self._processing:
                     self._redo.add(item)
@@ -147,8 +175,11 @@ class RateLimitingQueue:
 
     def __len__(self) -> int:
         with self._cond:
-            return len(self._queue) + len(self._delayed)
+            return len(self._queue) + len(self._delayed_due)
 
     def empty_and_idle(self) -> bool:
         with self._cond:
-            return not (self._queue or self._delayed or self._processing or self._redo)
+            return not (
+                self._queue or self._delayed_due
+                or self._processing or self._redo
+            )

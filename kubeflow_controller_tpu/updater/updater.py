@@ -75,7 +75,13 @@ def compute_status(
             for r in st.replica_statuses
         ),
         st.all_running_time, st.completion_time, st.submit_time,
+        st.observed_generation,
     )
+
+    # observedGeneration: status has now been computed against this spec
+    # (training-operator JobStatus.ObservedGeneration). The no-op sync
+    # short-circuit only trusts fingerprints once this catches up.
+    st.observed_generation = job.metadata.generation
 
     if not st.submit_time:
         st.submit_time = job.metadata.creation_timestamp or now
@@ -191,5 +197,6 @@ def compute_status(
             for r in st.replica_statuses
         ),
         st.all_running_time, st.completion_time, st.submit_time,
+        st.observed_generation,
     )
     return before != after

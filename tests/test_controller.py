@@ -752,3 +752,73 @@ class TestSuspendResume:
             == ConditionStatus.FALSE
         assert j.status.get_condition(ConditionType.GANG_SCHEDULED).status \
             == ConditionStatus.FALSE
+
+
+class TestNoopSyncShortCircuit:
+    """The generation/observedGeneration fingerprint fast path: a steady
+    job's resync costs a fingerprint compare — no claim, no plan, no
+    status write (docs/watch_pipeline.md)."""
+
+    def _steady_runtime(self):
+        rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=10000))
+        rt.cluster.slice_pool.add_pool("v5p-8", 2)
+        rt.submit(worker_job("steady"))
+        assert rt.wait_for_phase(
+            "default", "steady", JobPhase.RUNNING, max_steps=10)
+        rt.step(steps=5)   # settle: status writes finished, fp recorded
+        return rt
+
+    def test_steady_resync_skips_and_writes_nothing(self):
+        rt = self._steady_runtime()
+        rv0 = rt.cluster.jobs.revision
+        skipped0 = rt.controller.syncs_skipped_noop
+
+        for inf in (rt.job_informer, rt.pod_informer, rt.service_informer):
+            inf.resync()
+        rt.controller.drain()
+
+        assert rt.controller.syncs_skipped_noop > skipped0
+        assert rt.cluster.jobs.revision == rv0   # zero status writes
+        assert any(
+            t.outcome == "noop-skip" for t in rt.controller.traces)
+        # generation bookkeeping that gates the fast path: create stamps 1,
+        # the controller's runtime_id stamp is a spec write and bumps to 2
+        snap = rt.cluster.jobs.try_get("default", "steady")
+        assert snap.metadata.generation == 2
+        assert snap.status.observed_generation == 2
+
+    def test_spec_change_defeats_the_short_circuit(self):
+        rt = self._steady_runtime()
+        job = rt.get_job("default", "steady")
+        job.spec.suspend = True
+        rt.cluster.jobs.update(job)     # spec write: generation bumps
+        assert rt.wait_for_phase(
+            "default", "steady", JobPhase.SUSPENDED, max_steps=20)
+        rt.step(steps=3)
+        snap = rt.cluster.jobs.try_get("default", "steady")
+        assert snap.metadata.generation == 3   # one past the steady gen of 2
+        assert snap.status.observed_generation == 3
+        assert not rt.cluster.pods.list("default")
+
+    def test_health_flip_defeats_the_short_circuit_on_resync(self):
+        """degrade emits no watch event; the slice-health component of the
+        fingerprint must still catch it on the next resync."""
+        rt = self._steady_runtime()
+        job = rt.get_job("default", "steady")
+        held = rt.cluster.slice_pool.holdings(job.metadata.uid)
+        assert held
+        restarts0 = job.status.restarts
+        rt.cluster.slice_pool.mark_unhealthy(held[0].name)
+
+        rt.job_informer.resync()
+        rt.step(steps=5)
+        job = rt.get_job("default", "steady")
+        assert job.status.restarts == restarts0 + 1   # gang restart fired
+
+    def test_status_only_write_keeps_generation(self):
+        rt = self._steady_runtime()
+        snap = rt.cluster.jobs.try_get("default", "steady")
+        # rv moved well past generation: every status write bumped rv but
+        # only the create (1) and runtime_id stamp (2) touched generation
+        assert snap.metadata.generation == 2
+        assert snap.metadata.resource_version > snap.metadata.generation

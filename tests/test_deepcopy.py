@@ -42,7 +42,8 @@ def full_pod() -> core.Pod:
 def full_meta() -> core.ObjectMeta:
     return core.ObjectMeta(
         name="n", generate_name="n-", namespace="ns", uid="u",
-        resource_version=9, labels={"l": "1"}, annotations={"a": "2"},
+        resource_version=9, generation=3, labels={"l": "1"},
+        annotations={"a": "2"},
         owner_references=[core.OwnerReference(
             api_version="v1", kind="TPUJob", name="j", uid="ju",
             controller=True, block_owner_deletion=False,
@@ -101,6 +102,7 @@ def full_job() -> types.TPUJob:
             )],
             submit_time=1.0, all_running_time=2.0, completion_time=3.0,
             restarts=2, resizes=1, last_restart_time=4.0,
+            observed_generation=3,
         ),
     )
     return job
@@ -160,8 +162,8 @@ EXPECTED_FIELDS = {
         "block_owner_deletion"},
     core.ObjectMeta: {
         "name", "generate_name", "namespace", "uid", "resource_version",
-        "labels", "annotations", "owner_references", "creation_timestamp",
-        "deletion_timestamp"},
+        "generation", "labels", "annotations", "owner_references",
+        "creation_timestamp", "deletion_timestamp"},
     core.Container: {
         "name", "image", "command", "args", "env", "ports", "resources"},
     core.PodSpec: {
@@ -192,7 +194,7 @@ EXPECTED_FIELDS = {
     types.TPUJobStatus: {
         "phase", "reason", "conditions", "replica_statuses", "submit_time",
         "all_running_time", "completion_time", "restarts", "resizes",
-        "last_restart_time"},
+        "last_restart_time", "observed_generation"},
     types.TPUJob: {"metadata", "spec", "status", "kind", "api_version"},
 }
 

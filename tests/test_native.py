@@ -187,3 +187,46 @@ def test_add_beats_pending_add_after(Queue):
     assert q.get(timeout=0.5) == "k"
     q.done("k")
     assert q.get(timeout=0.05) is None
+
+
+@pytest.mark.parametrize("Queue", queue_impls())
+class TestEarliestDeadline:
+    """client-go delaying-queue semantics: re-adding a parked key keeps the
+    EARLIEST deadline, in BOTH implementations."""
+
+    def test_shorter_delay_wins(self, Queue):
+        q = Queue()
+        q.add_after("k", 3600.0)     # parked far in the future
+        q.add_after("k", 0.05)       # must supersede, not be swallowed
+        assert q.get(timeout=1.0) == "k"
+        q.done("k")
+        # the superseded 3600s entry must not fire a second time
+        assert q.get(timeout=0.1) is None
+        assert q.empty_and_idle()
+
+    def test_longer_delay_does_not_extend(self, Queue):
+        q = Queue()
+        q.add_after("k", 0.05)
+        q.add_after("k", 3600.0)     # later deadline: ignored
+        assert q.get(timeout=1.0) == "k"
+        q.done("k")
+        assert q.get(timeout=0.1) is None
+        assert q.empty_and_idle()
+
+    def test_len_counts_parked_item_once(self, Queue):
+        q = Queue()
+        q.add_after("k", 3600.0)
+        q.add_after("k", 1800.0)
+        q.add_after("k", 900.0)      # three heap entries, one real item
+        assert len(q) == 1
+        assert not q.empty_and_idle()
+
+    def test_immediate_add_then_due_fires_once(self, Queue):
+        q = Queue()
+        q.add_after("k", 0.05)
+        q.add("k")                   # beats the delay
+        assert q.get(timeout=0.5) == "k"
+        q.done("k")
+        time.sleep(0.08)             # let the parked deadline pass
+        assert q.get(timeout=0.05) is None
+        assert q.empty_and_idle()
