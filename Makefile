@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all native test test-fast bench bench-cp clean stamp
+.PHONY: all native test test-fast bench bench-cp bench-serve clean stamp
 
 # Build-stamp analog of the reference's ldflags version injection
 # (/root/reference/Makefile:23-26): export the sha for build_version().
@@ -28,6 +28,13 @@ bench:
 # reports mean_sync_us and deepcopies_per_sync — see benchmarks/RESULTS.md.
 bench-cp:
 	$(PY) benchmarks/controlplane_bench.py --jobs 1000
+
+# Continuous-batching vs static serving on the tiny config (CPU, mixed
+# prompt/output lengths + early EOS); one JSON summary line — see
+# benchmarks/RESULTS.md and docs/serving.md.
+bench-serve:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/serving_bench.py \
+		--json benchmarks/serving_bench_summary.json
 
 clean:
 	$(MAKE) -C csrc clean

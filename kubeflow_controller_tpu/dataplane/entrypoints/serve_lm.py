@@ -11,8 +11,12 @@ Pipeline: load params from the train loop's orbax checkpoint in
 ``--model-dir`` (``spec.modelDir`` / ``TPUJOB_MODEL_DIR``) or init fresh;
 prepare serving weights (bf16 cast, or weight-only int8 with
 ``--quant int8``); read prompts (token-id JSONL from ``--input``, else a
-synthetic batch); **block prefill** + one-scan greedy/sampled decode;
-write completions JSONL to ``--output`` (``spec.exportDir`` analog).
+synthetic batch); run the **continuous-batching engine**
+(``dataplane/serving_engine.py`` — per-slot KV cache, prefill-on-admit,
+EOS/budget retirement, slot reuse; docs/serving.md) over the requests;
+write completions JSONL to ``--output`` (``spec.exportDir`` analog) and
+report TTFT/TPOT/tokens-per-sec/slot-utilization, to the return dict and
+to the job's ``log_dir`` metrics sink when one is wired.
 """
 
 from __future__ import annotations
@@ -114,8 +118,15 @@ def serve(
     temperature: float = 0.0,
     seed: int = 0,
     turns: int = 1,
+    slots: int = 0,
+    eos_id: Optional[int] = None,
 ) -> Dict[str, float]:
     import jax
+
+    from kubeflow_controller_tpu.dataplane import metrics as metrics_mod
+    from kubeflow_controller_tpu.dataplane.serving_engine import (
+        Request, ServingEngine,
+    )
 
     ctx = ctx or ProcessContext.from_env()
     cfg = CONFIGS[config]()
@@ -135,20 +146,37 @@ def serve(
 
     t0 = time.perf_counter()
     rng = jax.random.key(seed) if temperature > 0 else None
+    serving: Dict[str, float] = {}
     # Size the KV cache to the actual request (prompt + new tokens), not
     # cfg.max_seq — an 8192-wide cache for a 64-token serve on the llama
     # configs would waste HBM and cap the batch.
     if turns <= 1:
-        toks = gen.generate(
-            cfg, params, prompts, max_new_tokens=max_new_tokens,
+        # Continuous-batching engine: one slot per request up to --slots
+        # (0 = the whole batch at once, the old static shape). With
+        # --eos-id set, finished rows retire early and their slots admit
+        # the next queued request instead of idling to batch completion.
+        n_slots = min(slots, b) if slots > 0 else b
+        engine = ServingEngine(
+            cfg, params, n_slots=n_slots, max_seq=s + max_new_tokens,
             temperature=temperature, rng=rng,
-            max_seq=s + max_new_tokens,
         )
+        prompts_np = np.asarray(prompts)
+        completions = engine.run([
+            Request(rid=i, prompt=prompts_np[i],
+                    max_new_tokens=max_new_tokens, eos_id=eos_id)
+            for i in range(b)
+        ])
+        completions.sort(key=lambda c: c.rid)
+        tok_rows = [c.tokens for c in completions]
+        dt = time.perf_counter() - t0
+        serving = engine.stats.summary(wall_s=dt)
     else:
         # Multi-turn chat shape: the first turn block-prefills a fresh
         # cache; every later turn extends it with prefill_continue (ONE
         # forward per turn, not O(turn tokens) decode dispatches); each
-        # turn then decodes its reply into the same cache.
+        # turn then decodes its reply into the same cache, whose decoded
+        # state generate_from_cache hands back — the reply's KVs are
+        # already in place, so nothing is re-encoded between turns.
         max_seq = turns * (s + max_new_tokens)
         cache = gen.init_kv_cache(cfg, b, max_seq)
         logits, cache = jax.jit(
@@ -166,41 +194,35 @@ def serve(
                     jnp.int32,
                 )
                 logits, cache = continue_fn(params, follow_up, cache)
-            toks = gen.generate_from_cache(
+            toks, logits, cache = gen.generate_from_cache(
                 cfg, params, logits, cache, max_new_tokens,
                 temperature=temperature,
                 # Distinct randomness per turn: the same key would make
                 # every turn draw an identical key sequence.
                 rng=None if rng is None else jax.random.fold_in(rng, turn),
+                return_state=True,
             )
             replies.append(np.asarray(jax.device_get(toks)))
-            if turn + 1 < turns:
-                # The reply becomes context for the next turn. The decode
-                # scan's cache updates live only inside
-                # generate_from_cache, so re-encode the reply block into
-                # the persistent cache (one prefill_continue call).
-                logits, cache = continue_fn(
-                    params, jnp.asarray(replies[-1]), cache)
         toks = np.concatenate(replies, axis=1)
-    toks = np.asarray(jax.device_get(toks))
-    dt = time.perf_counter() - t0
+        tok_rows = [toks[i].tolist() for i in range(b)]
+        dt = time.perf_counter() - t0
 
     if output_file:
         with open(output_file, "w") as f:
             for i in range(b):
                 f.write(json.dumps({
                     "prompt": np.asarray(prompts[i]).tolist(),
-                    "completion": toks[i].tolist(),
+                    "completion": list(map(int, tok_rows[i])),
                 }) + "\n")
-    new_total = max_new_tokens * max(turns, 1)
-    tps = b * new_total / dt
+    new_total = sum(len(r) for r in tok_rows)
+    tps = new_total / dt
     logger.info(
-        "served %d prompts (%d new tokens each%s) in %.2fs (%.0f tok/s%s)",
+        "served %d prompts (%d new tokens total%s) in %.2fs (%.0f tok/s%s)",
         b, new_total,
         f" across {turns} turns" if turns > 1 else "",
         dt, tps, f", {quant} weights" if quant else "",
     )
-    return {
+    out = {
         "prompts": float(b),
         "new_tokens": float(max_new_tokens),
         "tokens_per_sec": tps,
@@ -212,6 +234,15 @@ def serve(
             -1 if restored_step is None else restored_step
         ),
     }
+    out.update(serving)
+    ml = metrics_mod.from_context(ctx)
+    if ml is not None:
+        # One summary line into the job's log_dir sink — the same JSONL
+        # stream training scalars use, so `grep ttft` works on a serve
+        # job's logs exactly like `grep loss` on a train job's.
+        ml.write(0, out)
+        ml.close()
+    return out
 
 
 def main(argv=None) -> int:
@@ -233,6 +264,13 @@ def main(argv=None) -> int:
                    help="multi-turn chat shape: each turn appends a "
                         "prompt via block prefill_continue, then decodes "
                         "a reply into the shared KV cache")
+    p.add_argument("--slots", type=int, default=0,
+                   help="continuous-batching slot-pool size (0 = one "
+                        "slot per request); with fewer slots than "
+                        "requests, retired slots admit queued work")
+    p.add_argument("--eos-id", type=int, default=-1,
+                   help="token id that retires a sequence early "
+                        "(-1 = decode the full budget)")
     args = p.parse_args(argv)
     ctx = initialize_from_env()
     metrics = serve(
@@ -247,6 +285,8 @@ def main(argv=None) -> int:
         quant=args.quant,
         temperature=args.temperature,
         turns=args.turns,
+        slots=args.slots,
+        eos_id=None if args.eos_id < 0 else args.eos_id,
     )
     return 0 if metrics["prompts"] > 0 else 1
 

@@ -6,6 +6,11 @@ training process appends JSONL scalars to
 ``{log_dir}/metrics-p{process_id}.jsonl``. One line per report —
 ``{"ts": ..., "step": ..., "<name>": value, ...}`` — greppable, tailable,
 and trivially loadable into pandas; no TensorBoard dependency.
+
+Serving adds :class:`ServingStats`: the per-request latency/throughput
+aggregate (TTFT, TPOT, tokens/sec, slot utilization) the continuous-
+batching engine maintains and ``serve_lm`` reports — definitions in
+docs/serving.md.
 """
 
 from __future__ import annotations
@@ -13,9 +18,71 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Dict, Optional
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
 
 from kubeflow_controller_tpu.dataplane.dist import ProcessContext
+
+
+def percentile(xs: List[float], p: float) -> float:
+    """Nearest-rank percentile (p in [0, 100]); 0.0 on empty input —
+    serving summaries must stay JSON-clean even for an idle engine."""
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    idx = min(len(s) - 1, max(0, int(round(p / 100.0 * (len(s) - 1)))))
+    return s[idx]
+
+
+@dataclass
+class ServingStats:
+    """Aggregate serving metrics across one engine's lifetime.
+
+    * **TTFT** (time to first token): submit -> first sampled token of a
+      request. Queue wait counts — that is the latency a caller sees.
+    * **TPOT** (time per output token): mean inter-token gap after the
+      first token, per request; the p50 across requests is the steady
+      decode cadence.
+    * **slot utilization**: active-slot steps / (steps * n_slots) — the
+      fraction of the pool's decode capacity that produced real tokens.
+      Static run-to-completion batching bleeds this on early-EOS rows;
+      continuous batching re-fills them.
+    """
+
+    n_slots: int = 0
+    submitted: int = 0
+    admitted: int = 0
+    finished: int = 0
+    tokens_out: int = 0
+    steps: int = 0
+    active_slot_steps: int = 0
+    ttfts_s: List[float] = field(default_factory=list)
+    tpots_s: List[float] = field(default_factory=list)
+
+    def record(self, completion) -> None:
+        self.finished += 1
+        self.ttfts_s.append(completion.ttft_s)
+        if len(completion.tokens) > 1:
+            self.tpots_s.append(completion.tpot_s)
+
+    @property
+    def slot_utilization(self) -> float:
+        denom = self.steps * self.n_slots
+        return self.active_slot_steps / denom if denom else 0.0
+
+    def summary(self, wall_s: float = 0.0) -> Dict[str, float]:
+        out = {
+            "requests": float(self.finished),
+            "tokens_out": float(self.tokens_out),
+            "ttft_p50_ms": percentile(self.ttfts_s, 50) * 1e3,
+            "ttft_p95_ms": percentile(self.ttfts_s, 95) * 1e3,
+            "tpot_p50_ms": percentile(self.tpots_s, 50) * 1e3,
+            "tpot_p95_ms": percentile(self.tpots_s, 95) * 1e3,
+            "slot_utilization": self.slot_utilization,
+        }
+        if wall_s > 0:
+            out["tokens_per_sec"] = self.tokens_out / wall_s
+        return out
 
 
 class MetricsLogger:

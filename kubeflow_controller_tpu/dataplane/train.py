@@ -32,6 +32,21 @@ from kubeflow_controller_tpu.parallel.sharding import (
 logger = logging.getLogger("tpujob.train")
 
 
+def _ambient_mesh(mesh: Mesh):
+    """Context manager establishing ``mesh`` as the ambient mesh for
+    trace-time code, across jax versions: ``jax.set_mesh`` (>= 0.6),
+    ``jax.sharding.use_mesh`` (0.5.x experimental), else the classic
+    global-mesh context (``with mesh:``), which is what those APIs wrap
+    on 0.4.x."""
+    set_mesh = getattr(jax, "set_mesh", None)
+    if set_mesh is not None:
+        return set_mesh(mesh)
+    use_mesh = getattr(jax.sharding, "use_mesh", None)
+    if use_mesh is not None:
+        return use_mesh(mesh)
+    return mesh
+
+
 def _producer_stream(make_items, size: int) -> Iterator[Any]:
     """Shared producer-thread scaffolding for the prefetch helpers.
 
@@ -442,7 +457,7 @@ class TrainLoop:
         # the ambient abstract mesh; jit alone never establishes one, so the
         # first (tracing) call must run under set_mesh.
         def call(state, batch, rng):
-            with jax.set_mesh(self.mesh):
+            with _ambient_mesh(self.mesh):
                 return jitted(state, batch, rng)
 
         return call
@@ -459,7 +474,7 @@ class TrainLoop:
         )
 
         def call(state, batch):
-            with jax.set_mesh(self.mesh):
+            with _ambient_mesh(self.mesh):
                 return jitted(state, batch)
 
         return call

@@ -33,6 +33,22 @@ class KVCache(NamedTuple):
     length: jax.Array     # [] int32 — number of valid positions
 
 
+class SlotKVCache(NamedTuple):
+    """Per-slot generalization of :class:`KVCache` for continuous batching.
+
+    Each batch row is an independent *slot* with its own sequence length
+    and liveness: rows prefill, decode, retire, and get reused without a
+    shared scalar position. Shapes stay static (fixed slot count, fixed
+    ``max_seq``) so the decode step compiles once; retired slots are
+    masked, not removed.
+    """
+
+    k: jax.Array          # [L, B, max_seq, KVH, D]
+    v: jax.Array          # [L, B, max_seq, KVH, D]
+    length: jax.Array     # [B] int32 — valid positions per slot
+    active: jax.Array     # [B] bool — slot is decoding (length advances)
+
+
 # Projection weights eligible for weight-only int8 serving: 2D-per-layer
 # matmul operands whose contraction axis is the second-to-last dim. Embed
 # (gather table), norms (tiny), and the MoE router (full-precision routing
@@ -368,6 +384,157 @@ def prefill(
     )
 
 
+def init_slot_cache(
+    cfg: TransformerConfig, n_slots: int, max_seq: int,
+) -> SlotKVCache:
+    shape = (cfg.n_layers, n_slots, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return SlotKVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+        length=jnp.zeros((n_slots,), jnp.int32),
+        active=jnp.zeros((n_slots,), bool),
+    )
+
+
+def _decode_layer_slots(
+    cfg: TransformerConfig,
+    lp: Params,
+    x: jax.Array,               # [B, 1, D_model]
+    pos: jax.Array,             # [B] int32 — per-slot write position
+    layer: jax.Array,           # [] int32 layer index into the cache
+    k_all: jax.Array,           # [L, B, max_seq, KVH, D] — FULL cache
+    v_all: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """``_decode_layer`` generalized to per-slot positions: each row b
+    writes its new k/v at ``pos[b]`` (batched scatter at per-row offsets;
+    out-of-bounds rows — a retired slot at capacity — are dropped, never
+    clamped onto live positions) and attends under its own
+    ``arange(max_seq) <= pos[b]`` mask. Identical math to the scalar
+    layer when every row shares one position (pinned by
+    test_decode_step_slots_matches_scalar)."""
+    b = x.shape[0]
+    hd = cfg.head_dim
+    dt = cfg.dtype
+    max_seq = k_all.shape[2]
+
+    h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ _w(lp, "wq", dt)).reshape(b, 1, cfg.n_heads, hd)
+    k = (h @ _w(lp, "wk", dt)).reshape(b, 1, cfg.n_kv_heads, hd)
+    v = (h @ _w(lp, "wv", dt)).reshape(b, 1, cfg.n_kv_heads, hd)
+    positions = pos[:, None]                     # [B, 1]
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    # Batched per-row scatter: row b of layer `layer` gets its k/v at
+    # column pos[b]. Stays an in-place update on the fori_loop carry like
+    # the scalar path's dynamic_update_slice; "drop" guarantees a row
+    # whose position is past max_seq writes NOTHING (dynamic_update_slice
+    # would clamp into the newest valid column and corrupt it).
+    rows = jnp.arange(b)
+    k_all = k_all.at[layer, rows, pos].set(
+        k[:, 0].astype(k_all.dtype), mode="drop")
+    v_all = v_all.at[layer, rows, pos].set(
+        v[:, 0].astype(v_all.dtype), mode="drop")
+    k_cache = k_all[layer]                       # read-only gather
+    v_cache = v_all[layer]
+
+    rep = cfg.n_heads // cfg.n_kv_heads
+    qg = q.reshape(b, 1, cfg.n_kv_heads, rep, hd)
+    s = jnp.einsum(
+        "bqgrd,bkgd->bgrqk", qg, k_cache,
+        preferred_element_type=jnp.float32,
+    ) * (hd ** -0.5)                             # [B, G, rep, 1, S]
+    valid = jnp.arange(max_seq)[None, :] <= pos[:, None]     # [B, S]
+    s = jnp.where(valid[:, None, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    attn = jnp.einsum(
+        "bgrqk,bkgd->bqgrd", p, v_cache
+    ).reshape(b, 1, -1)
+    x = x + attn @ _w(lp, "wo", dt)
+
+    h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.moe_experts:
+        x = x + _moe_decode_ffn(cfg, lp, h)
+    else:
+        gate = jax.nn.silu(h @ _w(lp, "w_gate", dt))
+        up = h @ _w(lp, "w_up", dt)
+        x = x + (gate * up) @ _w(lp, "w_down", dt)
+    return x, k_all, v_all
+
+
+def decode_step_slots(
+    cfg: TransformerConfig,
+    params: Params,
+    tokens: jax.Array,          # [B, 1] int32
+    cache: SlotKVCache,
+) -> Tuple[jax.Array, SlotKVCache]:
+    """One decode step across all slots at their OWN positions. Returns
+    logits [B, vocab] and the cache with ``length`` advanced only on
+    active slots (inactive rows write past their length — masked on every
+    future read — and their length/contents stay untouched, so a retired
+    slot is free to be reused or ignored)."""
+    x = params["embed"].astype(cfg.dtype)[tokens]     # [B, 1, D]
+    pos = cache.length
+
+    def body(layer, state):
+        x, k_all, v_all = state
+        lp = jax.tree.map(
+            lambda p: lax.dynamic_index_in_dim(p, layer, keepdims=False),
+            params["layers"],
+        )
+        return _decode_layer_slots(cfg, lp, x, pos, layer, k_all, v_all)
+
+    x, k_new, v_new = lax.fori_loop(
+        0, cfg.n_layers, body, (x, cache.k, cache.v)
+    )
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = _head_logits(cfg, params, x[:, 0])
+    return logits, SlotKVCache(
+        k=k_new, v=v_new,
+        length=jnp.where(cache.active, pos + 1, pos),
+        active=cache.active,
+    )
+
+
+def prefill_into_slot(
+    cfg: TransformerConfig,
+    params: Params,
+    prompt: jax.Array,          # [1, S] int32 — ONE request's prompt
+    cache: SlotKVCache,
+    slot: jax.Array,            # [] int32 — destination slot
+) -> Tuple[jax.Array, SlotKVCache]:
+    """Admit one request: block-prefill its prompt (one fused forward)
+    and install the result into slot ``slot`` of a live slot cache —
+    write k/v for the S prompt positions, length[slot] = S,
+    active[slot] = True. Every OTHER slot's rows are untouched, so
+    admission composes with slots mid-decode. Stale KV from the slot's
+    previous tenant survives beyond column S, but no mask ever reaches
+    it: the row's attention window is ``arange(max_seq) <= pos`` and
+    later decode writes overwrite columns S, S+1, ... in order. The
+    mini prefill cache is sized to the PROMPT, not the pool — admission
+    cost scales with S, not max_seq. Compiles once per prompt length."""
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            f"prefill_into_slot admits one request (got batch "
+            f"{prompt.shape[0]})"
+        )
+    max_seq = cache.k.shape[2]
+    if prompt.shape[1] > max_seq:
+        raise ValueError(
+            f"prompt {prompt.shape[1]} exceeds slot capacity {max_seq}"
+        )
+    logits, mini = prefill(
+        cfg, params, prompt, init_kv_cache(cfg, 1, prompt.shape[1]))
+    k = lax.dynamic_update_slice(
+        cache.k, mini.k.astype(cache.k.dtype), (0, slot, 0, 0, 0))
+    v = lax.dynamic_update_slice(
+        cache.v, mini.v.astype(cache.v.dtype), (0, slot, 0, 0, 0))
+    return logits, SlotKVCache(
+        k=k, v=v,
+        length=cache.length.at[slot].set(prompt.shape[1]),
+        active=cache.active.at[slot].set(True),
+    )
+
+
 def _check_cache_capacity(cache: KVCache, new_tokens: int, what: str) -> None:
     """Reject writes past the cache's allocated window.
 
@@ -551,13 +718,20 @@ def generate_from_cache(
     top_k: int = 0,
     top_p: float = 1.0,
     rng: Optional[jax.Array] = None,
-) -> jax.Array:
+    return_state: bool = False,
+):
     """The decode scan of ``generate``, starting from an existing
     (prefilled or continued) cache + its last-position logits. This is
     the multi-turn serving entry: prefill turn 1 with ``prefill``, later
-    turns with ``prefill_continue``, then decode from here."""
+    turns with ``prefill_continue``, then decode from here.
+
+    ``return_state=True`` additionally returns the scan's final
+    (logits, cache): the cache holds the KVs of every token just decoded
+    (length advanced by ``max_new_tokens``), so a multi-turn caller
+    continues straight into the next turn's ``prefill_continue`` without
+    re-encoding the reply it already decoded.
+    """
     _check_cache_capacity(cache, max_new_tokens, "generate_from_cache")
-    rng = rng if rng is not None else jax.random.key(0)
 
     def pick(logits, key):
         if temperature <= 0.0:
@@ -576,8 +750,20 @@ def generate_from_cache(
         new_logits, cache = decode_step(cfg, params, tok[:, None], cache)
         return (new_logits, cache), tok
 
-    keys = jax.random.split(rng, max_new_tokens)
-    _, toks = lax.scan(body, (logits, cache), keys)
+    if temperature <= 0.0:
+        # Greedy pick is a pure argmax — no key is ever consumed, so
+        # don't split max_new_tokens of them (a threefry tree per call
+        # for nothing); scan over nothing with a fixed trip count.
+        (logits, cache), toks = lax.scan(
+            lambda c, _: body(c, None), (logits, cache), None,
+            length=max_new_tokens,
+        )
+    else:
+        rng = rng if rng is not None else jax.random.key(0)
+        keys = jax.random.split(rng, max_new_tokens)
+        (logits, cache), toks = lax.scan(body, (logits, cache), keys)
+    if return_state:
+        return toks.T, logits, cache
     return toks.T                                     # [B, new]
 
 

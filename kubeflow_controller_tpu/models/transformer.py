@@ -38,6 +38,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from kubeflow_controller_tpu.ops.attention import mha
+from kubeflow_controller_tpu.util import jax_compat
 
 from kubeflow_controller_tpu.parallel.mesh import DATA_AXES as BATCH_AXES
 
@@ -256,7 +257,7 @@ def _mesh_axis_size(*names: str) -> int:
     """Product of the active abstract mesh's sizes for ``names`` (1 off-mesh).
     Lets trace-time code pick shard-aligned shapes/algorithms; under plain
     single-device jit every axis reports size 1."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = jax_compat.get_abstract_mesh()
     if mesh is None or not mesh.shape_tuple:
         return 1
     sizes = dict(mesh.shape_tuple)
@@ -313,7 +314,7 @@ def _remat_policy(cfg: TransformerConfig):
 def _constrain(x: jax.Array, spec: P) -> jax.Array:
     """Sharding hint that degrades to a no-op when no mesh is active (plain
     single-device jit, e.g. the driver's entry() compile check)."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = jax_compat.get_abstract_mesh()
     if mesh is None or not mesh.shape_tuple:
         return x
     names = set()

@@ -134,7 +134,13 @@ def mha(
         # be automatically partitioned". A non-empty varying-mesh-axes
         # set on the operand is exactly that context; route to XLA there.
         # (Fully-manual regions like ring attention do their own math.)
-        vma = getattr(jax.typeof(q), "vma", None) or frozenset()
+        # jax.typeof landed after 0.4.x; older jax has no vma concept at
+        # all (shard_map there never annotates varying mesh axes), so an
+        # empty set is the faithful answer, not just a fallback.
+        _typeof = getattr(jax, "typeof", None)
+        vma = (
+            getattr(_typeof(q), "vma", None) if _typeof else None
+        ) or frozenset()
         use_flash = (
             _default_backend() == "tpu"
             and not vma

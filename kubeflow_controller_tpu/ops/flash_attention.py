@@ -153,8 +153,9 @@ def _out_struct(shape, dtype, *operands) -> jax.ShapeDtypeStruct:
     kwarg is a no-op."""
     vma = frozenset()
     seen = False
+    _typeof = getattr(jax, "typeof", None)  # absent (and vma-less) pre-0.5
     for op in operands:
-        v = getattr(jax.typeof(op), "vma", None)
+        v = getattr(_typeof(op), "vma", None) if _typeof else None
         if v is not None:
             seen = True
             vma |= v

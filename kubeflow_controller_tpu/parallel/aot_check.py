@@ -98,7 +98,11 @@ def aot_compile_train_step(
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    with jax.set_mesh(mesh):
+    # jax.set_mesh is >= 0.6; the classic global-mesh context is the
+    # 0.4.x spelling of the same ambient-mesh establishment.
+    set_mesh = getattr(jax, "set_mesh", None) or (
+        getattr(jax.sharding, "use_mesh", None) or (lambda m: m))
+    with set_mesh(mesh):
         jitted = jax.jit(
             train_step,
             in_shardings=(param_sh, opt_sh, batch_sh),

@@ -140,7 +140,9 @@ def gpipe(
 
 def pp_stage_count(mesh: Optional[jax.sharding.Mesh] = None) -> int:
     """Size of the ambient (or given) mesh's pp axis; 1 when absent."""
-    mesh = mesh or jax.sharding.get_abstract_mesh()
+    from kubeflow_controller_tpu.util.jax_compat import get_abstract_mesh
+
+    mesh = mesh or get_abstract_mesh()
     if mesh is None or "pp" not in getattr(mesh, "shape", {}):
         return 1
     return mesh.shape["pp"]

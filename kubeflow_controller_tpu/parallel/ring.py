@@ -145,7 +145,9 @@ def ring_mha(
     single-device jit — falls back to dense XLA attention, which is the same
     math.
     """
-    mesh = jax.sharding.get_abstract_mesh()
+    from kubeflow_controller_tpu.util.jax_compat import get_abstract_mesh
+
+    mesh = get_abstract_mesh()
     if mesh is None or axis_name not in mesh.axis_names:
         from kubeflow_controller_tpu.ops.attention import mha_xla
 

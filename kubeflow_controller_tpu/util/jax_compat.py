@@ -1,0 +1,51 @@
+"""Version-compat shims for jax APIs that moved between 0.4.x and 0.6.x.
+
+The codebase is written against the modern spellings (``jax.set_mesh``,
+``jax.sharding.get_abstract_mesh``); on older jaxlib (e.g. the 0.4.x
+line some serving images pin) those names don't exist, but the same
+machinery is reachable through the classic global-mesh context. These
+helpers paper over exactly that — no behavioral differences, just name
+resolution.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def get_abstract_mesh():
+    """The ambient abstract mesh, or None when no mesh is active.
+
+    Modern jax: ``jax.sharding.get_abstract_mesh()`` (normalized so an
+    EMPTY ambient mesh comes back as None — every caller here treats the
+    two identically). 0.4.x: the physical mesh installed by the ``with
+    mesh:`` context, surfaced through its ``abstract_mesh`` view so
+    callers see one type either way.
+    """
+    fn = getattr(jax.sharding, "get_abstract_mesh", None)
+    if fn is not None:
+        mesh = fn()
+        if mesh is None or not getattr(mesh, "shape_tuple", ()):
+            return None
+        return mesh
+    from jax._src import mesh as mesh_lib
+
+    pm = mesh_lib.thread_resources.env.physical_mesh
+    if pm.empty:
+        return None
+    return pm.abstract_mesh
+
+
+def ambient_mesh_context(mesh):
+    """Context manager that establishes ``mesh`` as the ambient mesh:
+    ``jax.set_mesh`` (>= 0.6), ``jax.sharding.use_mesh`` (0.5.x), else
+    the classic global-mesh context (``with mesh:``) those wrap."""
+    set_mesh = getattr(jax, "set_mesh", None)
+    if set_mesh is not None:
+        return set_mesh(mesh)
+    use_mesh = getattr(jax.sharding, "use_mesh", None)
+    if use_mesh is not None:
+        return use_mesh(mesh)
+    return mesh

@@ -332,3 +332,46 @@ def test_sampled_generation_respects_top_k():
     hot = gen.generate(cfg, params, prompt, max_new_tokens=8,
                        temperature=5.0, rng=jax.random.key(7))
     assert (np.asarray(hot) != np.asarray(greedy)).any()
+
+
+def test_generate_from_cache_return_state_continues_multiturn(cfg, params):
+    """return_state=True hands back the post-decode (logits, cache) so a
+    multi-turn caller continues into prefill_continue WITHOUT
+    re-encoding the reply it just decoded. Pin: decoding turn 1, then
+    continuing with turn 2, equals the from-scratch prefill of
+    prompt+reply+turn2."""
+    rng = np.random.default_rng(21)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 5)), jnp.int32)
+    turn2 = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 4)), jnp.int32)
+    n_new = 6
+
+    logits, cache = gen.prefill(cfg, params, prompt,
+                                gen.init_kv_cache(cfg, 1, 32))
+    toks, logits, cache = gen.generate_from_cache(
+        cfg, params, logits, cache, n_new, return_state=True)
+    assert toks.shape == (1, n_new)
+    assert int(cache.length) == 5 + n_new
+    la, cache = gen.prefill_continue(cfg, params, turn2, cache)
+
+    # from scratch: one prefill over prompt + decoded reply + turn2
+    full = jnp.concatenate([prompt, toks.astype(jnp.int32), turn2], axis=1)
+    lb, ref = gen.prefill(cfg, params, full, gen.init_kv_cache(cfg, 1, 32))
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=3e-4)
+    np.testing.assert_allclose(
+        np.asarray(cache.k[:, :, :int(ref.length)]),
+        np.asarray(ref.k[:, :, :int(ref.length)]), atol=3e-4)
+
+
+def test_generate_from_cache_greedy_ignores_rng(cfg, params):
+    """temperature<=0 must not consume (or require) an rng key — the
+    greedy scan skips key splitting entirely, and any key passed cannot
+    change the output."""
+    prompt = jnp.asarray(
+        np.random.default_rng(22).integers(0, cfg.vocab_size, (2, 4)),
+        jnp.int32)
+    logits, cache = gen.prefill(cfg, params, prompt,
+                                gen.init_kv_cache(cfg, 2, 32))
+    a = gen.generate_from_cache(cfg, params, logits, cache, 6, rng=None)
+    b = gen.generate_from_cache(cfg, params, logits, cache, 6,
+                                rng=jax.random.key(123))
+    assert np.array_equal(np.asarray(a), np.asarray(b))
