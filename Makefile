@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: all native test test-fast bench bench-cp bench-serve clean stamp
+.PHONY: all native test test-fast bench bench-cp bench-serve \
+	bench-overload clean stamp
 
 # Build-stamp analog of the reference's ldflags version injection
 # (/root/reference/Makefile:23-26): export the sha for build_version().
@@ -35,6 +36,15 @@ bench-cp:
 bench-serve:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/serving_bench.py \
 		--json benchmarks/serving_bench_summary.json
+
+# Open-loop overload benchmark (Poisson arrivals past saturation):
+# robust policy (bounded queue + deadlines) vs naive unbounded FIFO.
+# Smoke config; exits nonzero if goodput at >=2x load falls below 90%
+# of at-capacity goodput — see benchmarks/RESULTS.md.
+bench-overload:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/overload_bench.py \
+		--loads 1,2 --duration-s 2.0 --capacity-requests 24 \
+		--json benchmarks/overload_bench_summary.json
 
 clean:
 	$(MAKE) -C csrc clean

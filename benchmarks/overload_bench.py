@@ -1,0 +1,279 @@
+"""Open-loop overload benchmark for the continuous-batching engine.
+
+Closed-loop harnesses (``serving_bench.py``) can never overload the
+engine: each completed request "admits" the next, so offered load tracks
+capacity by construction. Real traffic is OPEN-LOOP — arrivals are a
+Poisson process that does not care how busy the server is — and past the
+saturation point a deadline-oblivious unbounded-FIFO server collapses:
+the queue (and its memory) grows without bound, every request's queue
+wait blows through its latency budget, and the slots spend their time
+decoding replies nobody is waiting for anymore.
+
+This benchmark drives the engine at offered loads ABOVE capacity and
+compares two policies over identical Poisson arrival schedules:
+
+* **naive**: unbounded queue, no deadlines — the pre-overload-layer
+  engine. Every request eventually completes, but past saturation the
+  completions are late: deadline-met goodput collapses toward zero while
+  the queue high-water mark grows linearly with the overload.
+* **robust**: ``max_queue`` bounds admission (typed ``Rejected``
+  sheds), ``Request.deadline_s`` sheds queued requests at admission and
+  retires in-flight ones mid-decode — slot time only goes to requests
+  that can still meet their deadline, so goodput stays ~flat past the
+  saturation point and queue memory stays bounded.
+
+Protocol: measure capacity closed-loop (tokens/sec with the pool kept
+full, no deadlines), derive the at-capacity request rate, then for each
+offered-load multiple run the SAME seeded arrival schedule through both
+policies. Goodput = tokens of completions that finished (eos/length)
+within their deadline, per wall second from first arrival to engine
+idle. Every request is accounted for: completions + rejections ==
+submissions is asserted per run (no silent drops).
+
+Prints one JSON object; ``--json`` also writes it to a file. Run via
+``make bench-overload`` (smoke config) — full-sweep numbers live in
+benchmarks/RESULTS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np
+
+
+def make_requests(cfg, n: int, prompt_len: int, budgets, seed: int,
+                  deadline_s: Optional[float], rid0: int = 0):
+    from kubeflow_controller_tpu.dataplane.serving_engine import Request
+
+    rng = np.random.default_rng(seed)
+    return [
+        Request(
+            rid=rid0 + i,
+            prompt=rng.integers(0, cfg.vocab_size, prompt_len).astype(
+                np.int32),
+            max_new_tokens=int(rng.choice(budgets)),
+            deadline_s=deadline_s,
+        )
+        for i in range(n)
+    ]
+
+
+def measure_capacity(engine, cfg, prompt_len: int, budgets,
+                     n: int, seed: int) -> Dict[str, float]:
+    """Closed-loop saturation: submit everything, drain, tokens/sec.
+    This is the engine's ceiling — the pool never idles waiting for an
+    arrival. Includes a warmup run so compile time stays out of the
+    number."""
+    reqs = make_requests(engine.cfg, n, prompt_len, budgets, seed, None)
+    engine.run(list(reqs))                   # warmup: compile + run
+    engine.reset()
+    reqs = make_requests(engine.cfg, n, prompt_len, budgets, seed, None)
+    t0 = time.perf_counter()
+    comps = engine.run(reqs)
+    wall = time.perf_counter() - t0
+    tokens = sum(len(c.tokens) for c in comps)
+    mean_budget = float(np.mean([r.max_new_tokens for r in
+                                 make_requests(engine.cfg, n, prompt_len,
+                                               budgets, seed, None)]))
+    return {
+        "tokens_per_sec": tokens / wall,
+        "requests_per_sec": (tokens / wall) / mean_budget,
+        "mean_budget": mean_budget,
+        "wall_s": wall,
+    }
+
+
+def run_open_loop(
+    engine, cfg, prompt_len: int, budgets, rate_rps: float,
+    duration_s: float, deadline_s: float, seed: int, robust: bool,
+    max_queue: int,
+) -> Dict:
+    """One offered-load run: Poisson arrivals at ``rate_rps`` for
+    ``duration_s``, stepped against the wall clock until the engine
+    drains. ``robust`` toggles the overload layer (bounded queue +
+    per-request deadlines) on the SAME arrival schedule."""
+    from kubeflow_controller_tpu.dataplane.serving_engine import Rejected
+
+    rng = np.random.default_rng(seed)
+    arrivals: List[float] = []
+    t = 0.0
+    while True:
+        t += float(rng.exponential(1.0 / rate_rps))
+        if t >= duration_s:
+            break
+        arrivals.append(t)
+    reqs = make_requests(
+        cfg, len(arrivals), prompt_len, budgets, seed + 1,
+        deadline_s if robust else None,
+    )
+
+    engine.reset()
+    engine.max_queue = max_queue if robust else None
+    rejected = 0
+    comps = []
+    i = 0
+    t0 = time.perf_counter()
+    while i < len(reqs) or not engine.idle:
+        now = time.perf_counter() - t0
+        while i < len(arrivals) and arrivals[i] <= now:
+            try:
+                engine.submit(reqs[i])
+            except Rejected:
+                rejected += 1
+            i += 1
+        if not engine.idle:
+            comps.extend(engine.step())
+        elif i < len(arrivals):
+            time.sleep(max(0.0, min(arrivals[i] - now, 1e-3)))
+    wall = time.perf_counter() - t0
+
+    assert len(comps) + rejected == len(reqs), (
+        f"silent drop: {len(reqs)} submitted, {len(comps)} completions "
+        f"+ {rejected} rejections"
+    )
+    by_reason: Dict[str, int] = {}
+    good_tokens = 0
+    late = 0
+    for c in comps:
+        by_reason[c.finish_reason] = by_reason.get(c.finish_reason, 0) + 1
+        if c.finish_reason in ("eos", "length"):
+            if c.done_t - c.submit_t <= deadline_s:
+                good_tokens += len(c.tokens)
+            else:
+                late += 1
+    st = engine.stats
+    from kubeflow_controller_tpu.dataplane.metrics import percentile
+    return {
+        "policy": "robust" if robust else "naive",
+        "offered_rps": round(rate_rps, 2),
+        "arrivals": len(reqs),
+        "wall_s": round(wall, 3),
+        "goodput_tps": round(good_tokens / wall, 1),
+        "good_tokens": good_tokens,
+        "deadline_met": sum(
+            v for k, v in by_reason.items() if k in ("eos", "length")
+        ) - late,
+        "late": late,
+        "rejected_queue_full": rejected,
+        "finish_reasons": by_reason,
+        "queue_depth_max": st.queue_depth_max,
+        "queue_wait_p50_ms": round(
+            percentile(st.queue_waits_s, 50) * 1e3, 1),
+        "queue_wait_p95_ms": round(
+            percentile(st.queue_waits_s, 95) * 1e3, 1),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="tiny")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--chunk", type=int, default=4)
+    p.add_argument("--prompt-len", type=int, default=8)
+    p.add_argument("--budgets", default="12,16,24,32",
+                   help="output-token budgets drawn uniformly")
+    p.add_argument("--capacity-requests", type=int, default=48,
+                   help="closed-loop requests for the capacity probe")
+    p.add_argument("--loads", default="1,2,3",
+                   help="offered-load multiples of capacity")
+    p.add_argument("--duration-s", type=float, default=4.0,
+                   help="arrival-window length per load")
+    p.add_argument("--deadline-factor", type=float, default=4.0,
+                   help="per-request deadline = factor * mean service "
+                        "time at capacity")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--skip-naive", action="store_true",
+                   help="only run the robust policy (faster smoke)")
+    p.add_argument("--json", default="", help="also write the summary here")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from kubeflow_controller_tpu.dataplane.entrypoints.lm import CONFIGS
+    from kubeflow_controller_tpu.dataplane.serving_engine import (
+        ServingEngine,
+    )
+    from kubeflow_controller_tpu.models import generate as gen
+    from kubeflow_controller_tpu.models import transformer as tfm
+
+    cfg = CONFIGS[args.config]()
+    params = gen.inference_params(
+        cfg, tfm.init_params(cfg, jax.random.key(0)))
+    budgets = [int(x) for x in args.budgets.split(",")]
+    max_seq = args.prompt_len + max(budgets)
+    engine = ServingEngine(
+        cfg, params, n_slots=args.slots, max_seq=max_seq,
+        decode_chunk=args.chunk,
+    )
+
+    cap = measure_capacity(
+        engine, cfg, args.prompt_len, budgets,
+        args.capacity_requests, args.seed)
+    # Deadline = factor x the mean per-request service time with the
+    # pool full; queue bound sized so a full queue's drain time still
+    # fits inside the deadline budget.
+    mean_service_s = cap["mean_budget"] / (
+        cap["tokens_per_sec"] / args.slots)
+    deadline_s = args.deadline_factor * mean_service_s
+    max_queue = max(2, int(cap["requests_per_sec"] * deadline_s * 0.5))
+
+    loads = [float(x) for x in args.loads.split(",")]
+    runs = []
+    for mult in loads:
+        rate = mult * cap["requests_per_sec"]
+        runs.append(run_open_loop(
+            engine, cfg, args.prompt_len, budgets, rate,
+            args.duration_s, deadline_s, args.seed, robust=True,
+            max_queue=max_queue,
+        ))
+        if not args.skip_naive and mult >= 1.0:
+            runs.append(run_open_loop(
+                engine, cfg, args.prompt_len, budgets, rate,
+                args.duration_s, deadline_s, args.seed, robust=False,
+                max_queue=max_queue,
+            ))
+
+    robust = {r["offered_rps"]: r for r in runs if r["policy"] == "robust"}
+    base_rate = round(cap["requests_per_sec"], 2)
+    at_cap = min(robust, key=lambda k: abs(k - base_rate))
+    over = [k for k in robust if k >= 2 * base_rate * 0.99]
+    ratio = (
+        min(robust[k]["goodput_tps"] for k in over)
+        / robust[at_cap]["goodput_tps"]
+        if over and robust[at_cap]["goodput_tps"] > 0 else 0.0
+    )
+    out = {
+        "metric": "overload_goodput_ratio_at_2x",
+        "value": round(ratio, 3),
+        "unit": "goodput(>=2x load) / goodput(1x load), robust policy",
+        "acceptance": ratio >= 0.9,
+        "capacity": {k: round(v, 2) for k, v in cap.items()},
+        "deadline_s": round(deadline_s, 3),
+        "max_queue": max_queue,
+        "workload": {
+            "slots": args.slots, "chunk": args.chunk,
+            "prompt_len": args.prompt_len, "budgets": budgets,
+            "duration_s": args.duration_s, "loads": loads,
+        },
+        "runs": runs,
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+    return 0 if (not over or ratio >= 0.9) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,6 +17,14 @@ EOS/budget retirement, slot reuse; docs/serving.md) over the requests;
 write completions JSONL to ``--output`` (``spec.exportDir`` analog) and
 report TTFT/TPOT/tokens-per-sec/slot-utilization, to the return dict and
 to the job's ``log_dir`` metrics sink when one is wired.
+
+Overload-safe by default when run as a process: ``main`` installs the
+two-strike SIGTERM/SIGINT handler (``util/signals.py``), so preemption
+drains the engine within ``--drain-grace-s`` and flushes partial
+completions (tagged with finish reasons) plus the metrics JSONL instead
+of dying with empty artifacts. ``--max-queue`` bounds admission and
+``--deadline-s`` sheds/retires requests past their latency budget —
+docs/serving.md "Overload & shutdown semantics".
 """
 
 from __future__ import annotations
@@ -120,12 +128,22 @@ def serve(
     turns: int = 1,
     slots: int = 0,
     eos_id: Optional[int] = None,
+    deadline_s: Optional[float] = None,
+    max_queue: Optional[int] = None,
+    drain_grace_s: float = 2.0,
+    stop=None,
 ) -> Dict[str, float]:
+    """``stop`` is a ``threading.Event`` (e.g. from
+    ``util.signals.setup_signal_handler``): when it fires mid-serve, the
+    engine drains within ``drain_grace_s``, partial completions are
+    written to ``output_file`` with their finish reasons, and the
+    metrics JSONL still flushes — SIGTERM/preemption loses the tail of
+    each stream, not the run's artifacts."""
     import jax
 
     from kubeflow_controller_tpu.dataplane import metrics as metrics_mod
     from kubeflow_controller_tpu.dataplane.serving_engine import (
-        Request, ServingEngine,
+        Rejected, Request, ServingEngine,
     )
 
     ctx = ctx or ProcessContext.from_env()
@@ -147,6 +165,9 @@ def serve(
     t0 = time.perf_counter()
     rng = jax.random.key(seed) if temperature > 0 else None
     serving: Dict[str, float] = {}
+    interrupted = False
+    finish_reasons: List[str] = ["length"] * b
+    rids: List[int] = list(range(b))
     # Size the KV cache to the actual request (prompt + new tokens), not
     # cfg.max_seq — an 8192-wide cache for a 64-token serve on the llama
     # configs would waste HBM and cap the batch.
@@ -158,15 +179,45 @@ def serve(
         n_slots = min(slots, b) if slots > 0 else b
         engine = ServingEngine(
             cfg, params, n_slots=n_slots, max_seq=s + max_new_tokens,
-            temperature=temperature, rng=rng,
+            temperature=temperature, rng=rng, max_queue=max_queue,
         )
         prompts_np = np.asarray(prompts)
-        completions = engine.run([
-            Request(rid=i, prompt=prompts_np[i],
-                    max_new_tokens=max_new_tokens, eos_id=eos_id)
-            for i in range(b)
-        ])
+        completions = []
+        for i in range(b):
+            try:
+                engine.submit(Request(
+                    rid=i, prompt=prompts_np[i],
+                    max_new_tokens=max_new_tokens, eos_id=eos_id,
+                    deadline_s=deadline_s,
+                ))
+            except Rejected as e:
+                logger.warning("request %d rejected: %s", i, e.reason)
+        max_steps = b * max_new_tokens + 2 * b + 4
+        announced = False
+        for _ in range(max_steps):
+            if stop is not None and stop.is_set():
+                logger.info(
+                    "stop requested: draining engine (grace %.1fs)",
+                    drain_grace_s)
+                completions.extend(engine.drain(drain_grace_s))
+                interrupted = True
+                break
+            completions.extend(engine.step())
+            if not announced and engine.stats.tokens_out > 0:
+                # Marker for harnesses that want to interrupt mid-decode
+                # (tests/test_signals.py) — decoding has really started.
+                logger.info("serving: first tokens decoded")
+                announced = True
+            if engine.idle:
+                break
+        if not interrupted and not engine.idle:
+            # Step-budget overrun is an engine bug, but the operator
+            # still gets every completion that did finish.
+            logger.error("engine failed to drain; flushing partials")
+            completions.extend(engine.drain(0.0))
         completions.sort(key=lambda c: c.rid)
+        rids = [c.rid for c in completions]
+        finish_reasons = [c.finish_reason for c in completions]
         tok_rows = [c.tokens for c in completions]
         dt = time.perf_counter() - t0
         serving = engine.stats.summary(wall_s=dt)
@@ -208,11 +259,16 @@ def serve(
         dt = time.perf_counter() - t0
 
     if output_file:
+        # One line per completion (possibly fewer than b after an
+        # interrupted drain): rid + finish_reason make partial output
+        # attributable — a consumer can tell "finished" from "cut off".
         with open(output_file, "w") as f:
-            for i in range(b):
+            for row, (rid, reason) in enumerate(zip(rids, finish_reasons)):
                 f.write(json.dumps({
-                    "prompt": np.asarray(prompts[i]).tolist(),
-                    "completion": list(map(int, tok_rows[i])),
+                    "rid": rid,
+                    "prompt": np.asarray(prompts[rid]).tolist(),
+                    "completion": list(map(int, tok_rows[row])),
+                    "finish_reason": reason,
                 }) + "\n")
     new_total = sum(len(r) for r in tok_rows)
     tps = new_total / dt
@@ -233,6 +289,9 @@ def serve(
         "restored_step": float(
             -1 if restored_step is None else restored_step
         ),
+        # 1.0 when a stop event interrupted the run and the engine
+        # drained with partial completions (SIGTERM/preemption path).
+        "interrupted": float(interrupted),
     }
     out.update(serving)
     ml = metrics_mod.from_context(ctx)
@@ -271,8 +330,29 @@ def main(argv=None) -> int:
     p.add_argument("--eos-id", type=int, default=-1,
                    help="token id that retires a sequence early "
                         "(-1 = decode the full budget)")
+    p.add_argument("--deadline-s", type=float, default=0.0,
+                   help="per-request latency budget in seconds from "
+                        "submission (0 = none); queued requests past it "
+                        "are shed, in-flight ones retire with partial "
+                        "tokens")
+    p.add_argument("--max-queue", type=int, default=0,
+                   help="bound the engine FIFO (0 = unbounded); submits "
+                        "beyond it are rejected with reason queue_full")
+    p.add_argument("--drain-grace-s", type=float, default=2.0,
+                   help="wall seconds the SIGTERM drain lets in-flight "
+                        "slots finish before retiring them with partial "
+                        "output")
     args = p.parse_args(argv)
     ctx = initialize_from_env()
+    # Two-strike SIGTERM/SIGINT drain (util/signals.py, signals.go:26-40
+    # parity): first signal sets the stop event — the engine drains and
+    # the completions/metrics artifacts still flush; a second signal
+    # hard-exits for operators who really mean it.
+    from kubeflow_controller_tpu.util.signals import setup_signal_handler
+    try:
+        stop = setup_signal_handler()
+    except RuntimeError:
+        stop = None    # embedding process already owns signal handling
     metrics = serve(
         ctx,
         config=args.config,
@@ -287,7 +367,13 @@ def main(argv=None) -> int:
         turns=args.turns,
         slots=args.slots,
         eos_id=None if args.eos_id < 0 else args.eos_id,
+        deadline_s=args.deadline_s if args.deadline_s > 0 else None,
+        max_queue=args.max_queue if args.max_queue > 0 else None,
+        drain_grace_s=args.drain_grace_s,
+        stop=stop,
     )
+    if metrics["interrupted"]:
+        logger.info("interrupted: drained with partial completions")
     return 0 if metrics["prompts"] > 0 else 1
 
 

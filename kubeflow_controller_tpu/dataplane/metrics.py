@@ -47,21 +47,38 @@ class ServingStats:
       fraction of the pool's decode capacity that produced real tokens.
       Static run-to-completion batching bleeds this on early-EOS rows;
       continuous batching re-fills them.
+
+    Overload accounting (docs/serving.md "Overload & shutdown
+    semantics"): ``rejected`` counts admission-control refusals (typed
+    ``Rejected`` from ``submit``), ``finish_reasons`` counts every
+    Completion by reason ("eos"/"length" plus the policy retirements
+    "deadline"/"cancelled"/"shed"), ``queue_waits_s`` records
+    submit->admission delay per admitted request, and
+    ``queue_depth_max`` the high-water FIFO depth — together they prove
+    no request was silently dropped: submitted == finished + rejected
+    once the engine is idle.
     """
 
     n_slots: int = 0
     submitted: int = 0
     admitted: int = 0
     finished: int = 0
+    rejected: int = 0
     tokens_out: int = 0
     steps: int = 0
     active_slot_steps: int = 0
+    queue_depth_max: int = 0
     ttfts_s: List[float] = field(default_factory=list)
     tpots_s: List[float] = field(default_factory=list)
+    queue_waits_s: List[float] = field(default_factory=list)
+    finish_reasons: Dict[str, int] = field(default_factory=dict)
 
     def record(self, completion) -> None:
         self.finished += 1
-        self.ttfts_s.append(completion.ttft_s)
+        reason = getattr(completion, "finish_reason", "")
+        self.finish_reasons[reason] = self.finish_reasons.get(reason, 0) + 1
+        if completion.ttft_s is not None:   # no token ever decoded: no TTFT
+            self.ttfts_s.append(completion.ttft_s)
         if len(completion.tokens) > 1:
             self.tpots_s.append(completion.tpot_s)
 
@@ -74,10 +91,18 @@ class ServingStats:
         out = {
             "requests": float(self.finished),
             "tokens_out": float(self.tokens_out),
+            "rejected": float(self.rejected),
+            "shed": float(self.finish_reasons.get("shed", 0)),
+            "cancelled": float(self.finish_reasons.get("cancelled", 0)),
+            "deadline_expired": float(
+                self.finish_reasons.get("deadline", 0)),
             "ttft_p50_ms": percentile(self.ttfts_s, 50) * 1e3,
             "ttft_p95_ms": percentile(self.ttfts_s, 95) * 1e3,
             "tpot_p50_ms": percentile(self.tpots_s, 50) * 1e3,
             "tpot_p95_ms": percentile(self.tpots_s, 95) * 1e3,
+            "queue_wait_p50_ms": percentile(self.queue_waits_s, 50) * 1e3,
+            "queue_wait_p95_ms": percentile(self.queue_waits_s, 95) * 1e3,
+            "queue_depth_max": float(self.queue_depth_max),
             "slot_utilization": self.slot_utilization,
         }
         if wall_s > 0:

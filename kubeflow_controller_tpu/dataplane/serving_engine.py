@@ -38,10 +38,40 @@ same retirement rule the device does (record until EOS/budget), so the
 two views agree deterministically and the only cost of the lag is that
 a freed slot idles one step before readmission. Buffers are donated, so
 the KV pool updates in place rather than copying every step.
+
+Overload robustness (docs/serving.md "Overload & shutdown semantics"):
+the same host-side retirement bookkeeping that books EOS/budget also
+retires requests for *policy* reasons, so the engine degrades gracefully
+instead of building infinite queues:
+
+* **admission control** — ``max_queue`` bounds the FIFO; ``submit`` on a
+  full queue raises a typed :class:`Rejected` (``reason="queue_full"``)
+  instead of growing memory without bound;
+* **deadlines** — ``Request.deadline_s`` (seconds from submit). A queued
+  request whose deadline already passed is *shed* before prefill (no
+  slot time wasted on a reply nobody is waiting for); an in-flight
+  request past its deadline retires with partial tokens
+  (``finish_reason="deadline"``). ``max_queue_delay_s`` sheds on queue
+  wait alone, deadline or not;
+* **cancellation** — ``cancel(rid)`` removes a queued request outright
+  or retires an in-flight one at the next step with the tokens decoded
+  so far (``finish_reason="cancelled"``);
+* **graceful drain** — ``drain(grace_s)`` stops admission, sheds the
+  queue, lets in-flight slots finish within the grace budget, then
+  deadline-retires stragglers — every request comes back as a
+  Completion with a typed finish reason, nothing is silently dropped.
+
+Policy retirement happens host-side BEFORE the next dispatch: the freed
+row's ``active`` bit is cleared so the device stops advancing it, and the
+pending chunk's tokens for that row are discarded by the existing
+snapshot-identity check. All retirement paths are row-local, so greedy
+decode of *unaffected* slots stays bit-equivalent to per-sequence
+``gen.generate`` (pinned by tests/test_serving_engine.py).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -58,38 +88,94 @@ from kubeflow_controller_tpu.models.transformer import (
 )
 
 
+class Rejected(Exception):
+    """Typed admission-control rejection from :meth:`ServingEngine.submit`.
+
+    ``reason`` is ``"queue_full"`` (bounded queue at capacity) or
+    ``"draining"`` (engine is shutting down). Counted in
+    ``ServingStats.rejected`` — an overloaded engine says no loudly
+    instead of queueing without bound.
+    """
+
+    def __init__(self, rid: int, reason: str):
+        self.rid = rid
+        self.reason = reason
+        super().__init__(f"request {rid} rejected: {reason}")
+
+
+class DrainError(RuntimeError):
+    """``run()`` failed to drain within its step budget. The completions
+    that DID finish ride along on ``.completions`` so harnesses can
+    report partial results instead of discarding everything."""
+
+    def __init__(self, msg: str, completions: List["Completion"]):
+        super().__init__(msg)
+        self.completions = completions
+
+
+#: finish reasons a Completion can carry. "eos"/"length" are natural
+#: retirement; the rest are policy retirement (overload robustness).
+FINISH_REASONS = ("eos", "length", "deadline", "cancelled", "shed")
+
+
 @dataclass
 class Request:
     """One generation request. ``prompt`` is a 1-D int32 token-id array;
-    prompts of different lengths mix freely in one engine."""
+    prompts of different lengths mix freely in one engine.
+    ``deadline_s`` is a latency budget in seconds FROM SUBMISSION (engine
+    clock units); past it the request is shed from the queue or retired
+    mid-decode with partial tokens."""
 
     rid: int
     prompt: np.ndarray
     max_new_tokens: int
     eos_id: Optional[int] = None
+    deadline_s: Optional[float] = None
 
 
 @dataclass
 class Completion:
     rid: int
     tokens: List[int]                 # includes the EOS token if emitted
-    finish_reason: str                # "eos" | "length"
+    finish_reason: str                # one of FINISH_REASONS
     submit_t: float
-    first_token_t: float
+    first_token_t: Optional[float]    # None when retired before any token
     done_t: float
+    admit_t: Optional[float] = None   # None when shed/cancelled in queue
 
     @property
-    def ttft_s(self) -> float:
+    def ttft_s(self) -> Optional[float]:
+        """Submit -> first token; None when no token was ever decoded
+        (shed, or cancelled while queued)."""
+        if self.first_token_t is None:
+            return None
         return self.first_token_t - self.submit_t
 
     @property
+    def queue_wait_s(self) -> float:
+        """Time spent in the FIFO: submit -> admission, or submit ->
+        shed/cancel for requests that never reached a slot."""
+        return (self.admit_t if self.admit_t is not None
+                else self.done_t) - self.submit_t
+
+    @property
     def tpot_s(self) -> float:
-        """Mean time per output token AFTER the first (0 for 1-token
+        """Mean time per output token AFTER the first (0 for <=1-token
         completions)."""
         n = len(self.tokens)
-        if n <= 1:
+        if n <= 1 or self.first_token_t is None:
             return 0.0
         return (self.done_t - self.first_token_t) / (n - 1)
+
+
+@dataclass
+class _Queued:
+    """A request waiting in the FIFO, stamped at submission so deadlines
+    and queue-delay caps are enforceable before prefill."""
+
+    req: Request
+    submit_t: float
+    deadline_t: Optional[float]       # absolute, engine clock units
 
 
 @dataclass
@@ -99,6 +185,9 @@ class _Slot:
 
     req: Request
     submit_t: float
+    admit_t: float
+    deadline_t: Optional[float] = None
+    cancelled: bool = False
     first_token_t: Optional[float] = None
     tokens: List[int] = field(default_factory=list)
 
@@ -123,6 +212,8 @@ class ServingEngine:
         rng: Optional[jax.Array] = None,
         clock: Callable[[], float] = time.perf_counter,
         decode_chunk: int = 4,
+        max_queue: Optional[int] = None,
+        max_queue_delay_s: Optional[float] = None,
     ):
         self.cfg = cfg
         self.params = params
@@ -130,6 +221,10 @@ class ServingEngine:
         self.max_seq = int(max_seq or cfg.max_seq)
         self.temperature = temperature
         self.decode_chunk = max(1, int(decode_chunk))
+        # Admission control: bound the FIFO (None = unbounded, the
+        # trusting-harness default) and optionally shed on queue wait.
+        self.max_queue = max_queue
+        self.max_queue_delay_s = max_queue_delay_s
         self._rng = rng if rng is not None else jax.random.key(0)
         self._clock = clock
         self._step_idx = 0
@@ -143,11 +238,17 @@ class ServingEngine:
         self.budget = jnp.zeros((n_slots,), jnp.int32)
         self.emitted = jnp.zeros((n_slots,), jnp.int32)
         self.slots: List[Optional[_Slot]] = [None] * n_slots
-        self.queue: deque[Request] = deque()
+        self.queue: deque[_Queued] = deque()
         self.stats = ServingStats(n_slots=n_slots)
         # One-deep dispatch pipeline: (tokens device array, snapshot of
         # self.slots at dispatch, host-active count at dispatch).
         self._pending = None
+        # rids of queued + in-flight requests (duplicate-rid guard) and
+        # completions produced outside _process_pending (sheds, queued
+        # cancels) awaiting pickup by the next step().
+        self._rids: set = set()
+        self._done_buf: List[Completion] = []
+        self._draining = False
 
         # ONE compiled, fused step for the whole engine lifetime: a
         # chunk of ``decode_chunk`` (sample token from carried logits ->
@@ -211,10 +312,16 @@ class ServingEngine:
         self.stats = ServingStats(n_slots=self.n_slots)
         self._pending = None
         self._step_idx = 0
+        self._rids = set()
+        self._done_buf = []
+        self._draining = False
 
     # -- request intake --------------------------------------------------
 
     def submit(self, req: Request) -> None:
+        """Queue a request. Raises ``ValueError`` on malformed input
+        (caller bug) and :class:`Rejected` on admission control (overload
+        or shutdown — a healthy caller retrying elsewhere)."""
         prompt = np.asarray(req.prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError(f"request {req.rid}: empty prompt")
@@ -223,9 +330,95 @@ class ServingEngine:
                 f"request {req.rid}: prompt {prompt.size} + "
                 f"{req.max_new_tokens} new exceeds max_seq {self.max_seq}"
             )
+        if req.rid in self._rids:
+            # Silent duplicate admission would corrupt any harness keyed
+            # on rid (two streams, one key) — refuse loudly.
+            raise ValueError(f"request {req.rid}: duplicate rid "
+                             "among queued/in-flight requests")
+        if self._draining:
+            self.stats.rejected += 1
+            raise Rejected(req.rid, "draining")
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            self.stats.rejected += 1
+            raise Rejected(req.rid, "queue_full")
         req.prompt = prompt
-        self.queue.append(req)
+        now = self._clock()
+        deadline_t = (None if req.deadline_s is None
+                      else now + req.deadline_s)
+        self.queue.append(_Queued(req=req, submit_t=now,
+                                  deadline_t=deadline_t))
+        self._rids.add(req.rid)
         self.stats.submitted += 1
+        if len(self.queue) > self.stats.queue_depth_max:
+            self.stats.queue_depth_max = len(self.queue)
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a request by rid. A queued request is removed outright
+        (Completion with no tokens at the next :meth:`step`); an
+        in-flight one retires at the next step with the tokens decoded so
+        far. Returns False when the rid is unknown (already finished, or
+        never submitted) — cancellation of finished work is a no-op, not
+        an error."""
+        if rid not in self._rids:
+            return False
+        for q in self.queue:
+            if q.req.rid == rid:
+                self.queue.remove(q)
+                self._rids.discard(rid)
+                now = self._clock()
+                self._finish_completion(Completion(
+                    rid=rid, tokens=[], finish_reason="cancelled",
+                    submit_t=q.submit_t, first_token_t=None, done_t=now,
+                ))
+                return True
+        for slot in self.slots:
+            if slot is not None and slot.req.rid == rid:
+                slot.cancelled = True
+                return True
+        return False                      # retired between bookkeeping
+
+    def _finish_completion(self, comp: Completion) -> None:
+        """Record a policy-retirement completion and buffer it for the
+        next step()'s return."""
+        self.stats.record(comp)
+        self._done_buf.append(comp)
+
+    def _retire_slot(self, i: int, slot: _Slot, reason: str,
+                     now: float) -> Completion:
+        """Host-side policy retirement of an in-flight slot: emit the
+        partial completion, free the slot, and clear the device row's
+        ``active`` bit so the next dispatch stops advancing it. The
+        pending chunk's tokens for this row are dropped by the
+        snapshot-identity check in _process_pending — row-local, so
+        neighbors' greedy streams are untouched."""
+        comp = Completion(
+            rid=slot.req.rid, tokens=slot.tokens, finish_reason=reason,
+            submit_t=slot.submit_t, first_token_t=slot.first_token_t,
+            done_t=now, admit_t=slot.admit_t,
+        )
+        self.slots[i] = None
+        self._rids.discard(slot.req.rid)
+        self.cache = self.cache._replace(
+            active=self.cache.active.at[i].set(False))
+        self.stats.record(comp)
+        return comp
+
+    def _retire_due(self) -> List[Completion]:
+        """Retire in-flight slots whose deadline passed or that were
+        cancelled — BEFORE the next dispatch, so the freed rows do not
+        burn device steps on abandoned work."""
+        out: List[Completion] = []
+        for i, slot in enumerate(self.slots):
+            if slot is None:
+                continue
+            if slot.cancelled:
+                out.append(self._retire_slot(i, slot, "cancelled",
+                                             self._clock()))
+            elif (slot.deadline_t is not None
+                  and self._clock() >= slot.deadline_t):
+                out.append(self._retire_slot(i, slot, "deadline",
+                                             self._clock()))
+        return out
 
     # -- scheduling ------------------------------------------------------
 
@@ -252,16 +445,44 @@ class ServingEngine:
                 admit, donate_argnums=(2, 3, 4, 5, 6))
         return fn
 
+    def _shed_queued(self) -> None:
+        """Shed queued requests that can no longer meet their deadline
+        before prefill, or whose queue wait exceeds the configured cap —
+        an overloaded engine spends zero slot time on replies nobody is
+        waiting for, and the queue's memory stays bounded by live work."""
+        if not self.queue:
+            return
+        if self.max_queue_delay_s is None and all(
+                q.deadline_t is None for q in self.queue):
+            return
+        now = self._clock()
+        keep: deque[_Queued] = deque()
+        for q in self.queue:
+            expired = q.deadline_t is not None and now >= q.deadline_t
+            delayed = (self.max_queue_delay_s is not None
+                       and now - q.submit_t >= self.max_queue_delay_s)
+            if expired or delayed:
+                self._rids.discard(q.req.rid)
+                self._finish_completion(Completion(
+                    rid=q.req.rid, tokens=[], finish_reason="shed",
+                    submit_t=q.submit_t, first_token_t=None, done_t=now,
+                ))
+            else:
+                keep.append(q)
+        self.queue = keep
+
     def _admit_waiting(self) -> None:
         """Fill every free slot from the queue (prefill-on-admit). The
         other slots' cache rows are untouched — they resume decoding in
         the same step."""
+        self._shed_queued()
         while self.queue:
             try:
                 slot = self.slots.index(None)
             except ValueError:
                 return                      # pool full
-            req = self.queue.popleft()
+            q = self.queue.popleft()
+            req = q.req
             admit = self._admit_fn(req.prompt.size)
             (self.cache, self.logits, self.eos, self.budget,
              self.emitted) = admit(
@@ -272,8 +493,13 @@ class ServingEngine:
                     -1 if req.eos_id is None else req.eos_id, jnp.int32),
                 jnp.asarray(req.max_new_tokens, jnp.int32),
             )
-            self.slots[slot] = _Slot(req=req, submit_t=self._clock())
+            now = self._clock()
+            self.slots[slot] = _Slot(
+                req=req, submit_t=q.submit_t, admit_t=now,
+                deadline_t=q.deadline_t,
+            )
             self.stats.admitted += 1
+            self.stats.queue_waits_s.append(now - q.submit_t)
 
     @property
     def n_active(self) -> int:
@@ -282,11 +508,14 @@ class ServingEngine:
     @property
     def idle(self) -> bool:
         return (not self.queue and self.n_active == 0
-                and self._pending is None)
+                and self._pending is None and not self._done_buf)
 
     def step(self) -> List[Completion]:
         """One scheduling quantum, pipelined one dispatch deep:
 
+        0. retire due policy work: flush buffered shed/cancel
+           completions, deadline-retire or cancel-retire in-flight slots
+           (their device rows go inactive before the dispatch below);
         1. dispatch the next fused device chunk (``decode_chunk``
            micro-steps of sample -> decode -> on-device retirement) over
            the current pool;
@@ -303,6 +532,9 @@ class ServingEngine:
         per-token work (device_get, bookkeeping, admission) overlaps
         device compute instead of serializing with it.
         """
+        finished: List[Completion] = list(self._done_buf)
+        self._done_buf.clear()
+        finished.extend(self._retire_due())
         dispatched = None
         n_active = self.n_active
         if n_active > 0:
@@ -316,7 +548,7 @@ class ServingEngine:
                 self.budget, self.emitted, key)
             dispatched = (toks, list(self.slots), n_active)
 
-        finished = self._process_pending()
+        finished.extend(self._process_pending())
         self._pending = dispatched
         self._admit_waiting()
         return finished
@@ -358,21 +590,70 @@ class ServingEngine:
                         finish_reason="eos" if done_eos else "length",
                         submit_t=slot.submit_t,
                         first_token_t=slot.first_token_t, done_t=now,
+                        admit_t=slot.admit_t,
                     ))
                     self.slots[i] = None
+                    self._rids.discard(req.rid)
                     break
 
         for c in finished:
             self.stats.record(c)
         return finished
 
+    def drain(self, grace_s: float = 5.0) -> List[Completion]:
+        """Graceful shutdown: stop admission, shed the queue, let
+        in-flight slots finish within ``grace_s`` wall seconds, then
+        deadline-retire whatever is still decoding. Every outstanding
+        request comes back as a Completion with a typed finish reason —
+        partial output beats discarded output on preemption/SIGTERM.
+
+        The engine stays in draining mode afterwards (``submit`` raises
+        ``Rejected(reason="draining")``) until :meth:`reset`.
+        """
+        self._draining = True
+        out: List[Completion] = list(self._done_buf)
+        self._done_buf.clear()
+        # Queued requests will never be admitted now — shed them up
+        # front rather than stringing callers along through the grace
+        # window.
+        now = self._clock()
+        while self.queue:
+            q = self.queue.popleft()
+            self._rids.discard(q.req.rid)
+            comp = Completion(
+                rid=q.req.rid, tokens=[], finish_reason="shed",
+                submit_t=q.submit_t, first_token_t=None, done_t=now,
+            )
+            self.stats.record(comp)
+            out.append(comp)
+        deadline = now + grace_s
+        while not self.idle and self._clock() < deadline:
+            out.extend(self.step())
+        # Grace exhausted: book the chunk still in flight (those tokens
+        # were decoded — keep them), then force-retire stragglers with
+        # partial output.
+        out.extend(self._process_pending())
+        now = self._clock()
+        for i, slot in enumerate(self.slots):
+            if slot is not None:
+                out.append(self._retire_slot(i, slot, "deadline", now))
+        return out
+
     def run(
         self, requests: Sequence[Request], max_steps: int = 0,
+        stop: Optional["threading.Event"] = None,
+        drain_grace_s: float = 5.0,
     ) -> List[Completion]:
         """Submit ``requests`` and step until everything finishes.
         Results come back in completion order; sort by ``rid`` for
         submission order. ``max_steps`` bounds the drain loop (0 = the
-        worst-case budget derived from the workload)."""
+        worst-case budget derived from the workload).
+
+        ``stop`` (e.g. ``util.signals.setup_signal_handler()``'s event)
+        interrupts the loop: the engine drains within ``drain_grace_s``
+        and the partial completions are returned. A drain-loop overrun
+        raises :class:`DrainError` carrying the completions that DID
+        finish."""
         for r in requests:
             self.submit(r)
         if not max_steps:
@@ -384,12 +665,16 @@ class ServingEngine:
             ) + 2 * len(requests) + 4
         out: List[Completion] = []
         for _ in range(max_steps):
+            if stop is not None and stop.is_set():
+                out.extend(self.drain(drain_grace_s))
+                return out
             out.extend(self.step())
             if self.idle:
                 break
         if not self.idle:
-            raise RuntimeError(
+            raise DrainError(
                 f"engine did not drain in {max_steps} steps "
-                f"({self.n_active} active, {len(self.queue)} queued)"
+                f"({self.n_active} active, {len(self.queue)} queued)",
+                completions=out,
             )
         return out

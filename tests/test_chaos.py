@@ -327,6 +327,109 @@ class TestWireChaos:
             server.stop()
 
 
+class TestServingChaosDrain:
+    """A serving-shaped job under preemption (ISSUE 4 satellite): the
+    controller's job is gang-restarting under backoff, the ENGINE's job
+    is to drain with partial completions instead of hanging or
+    discarding work. Epoch 0 serves until the preemption's stop signal,
+    drains, and exits like a killed container; epoch 1 re-serves to
+    completion."""
+
+    def test_preempted_serving_job_drains_partials_and_restarts(self):
+        import threading
+
+        import jax
+        import numpy as np
+
+        from kubeflow_controller_tpu.dataplane.serving_engine import (
+            Request, ServingEngine,
+        )
+        from kubeflow_controller_tpu.models import generate as gen
+        from kubeflow_controller_tpu.models import transformer as tfm
+
+        cfg = tfm.tiny_config()
+        params = gen.inference_params(
+            cfg, tfm.init_params(cfg, jax.random.key(0)))
+        # One engine reused across epochs (reset() keeps compiled fns);
+        # only gang index 0 drives it, so epochs never overlap on it.
+        engine = ServingEngine(cfg, params, n_slots=2, max_seq=160,
+                               decode_chunk=2)
+        prompts = np.random.default_rng(7).integers(
+            0, cfg.vocab_size, (4, 6)).astype(np.int32)
+
+        stop = threading.Event()      # the preemption's SIGTERM analog
+        decoded = threading.Event()   # epoch 0 really is mid-decode
+        drained = threading.Event()   # epoch 0 released the engine
+        partial: list = []
+        full: list = []
+
+        def run_serving(pod):
+            epoch = pod.metadata.labels[naming.LABEL_EPOCH]
+            if pod.metadata.labels[naming.LABEL_INDEX] != "0":
+                if epoch == "0":
+                    stop.wait(60)
+                    return 137
+                return 0
+            if epoch == "0":
+                engine.reset()
+                # budgets far beyond what epoch 0 gets to finish
+                for i in range(4):
+                    engine.submit(Request(
+                        rid=i, prompt=prompts[i], max_new_tokens=150))
+                while not stop.is_set() and not engine.idle:
+                    partial.extend(engine.step())
+                    if engine.stats.tokens_out > 0:
+                        decoded.set()
+                # zero grace: in-flight slots retire as "deadline"
+                # partials instead of racing the restart to finish
+                partial.extend(engine.drain(grace_s=0.0))
+                drained.set()
+                return 137            # preempted container exit
+            # The restarted pod must wait for the old container's drain
+            # to release the engine — on a real cluster the TPU lease
+            # enforces this handover; here an event does.
+            assert drained.wait(60)
+            engine.reset()
+            full.extend(engine.run([
+                Request(rid=i, prompt=prompts[i], max_new_tokens=8)
+                for i in range(4)
+            ]))
+            return 0
+
+        rt = LocalRuntime(PodRunPolicy(start_delay=1, run_fn=run_serving))
+        rt.cluster.slice_pool.add_pool("v5p-8", 2)
+        rt.controller.opts.restart_backoff_base = 0.2
+        rt.controller.opts.backoff_poll = 0.005
+        rt.submit(worker_job("serve-job"))
+        assert rt.wait_for_phase("default", "serve-job", JobPhase.RUNNING,
+                                 max_steps=20)
+        assert decoded.wait(60), "engine never started decoding"
+
+        job = rt.get_job("default", "serve-job")
+        held = rt.cluster.slice_pool.holdings(job.metadata.uid)[0].name
+        rt.cluster.preempt_slice(held)
+        stop.set()                    # the kubelet's SIGTERM to the pod
+        rt.cluster.slice_pool.restore(held)
+
+        assert rt.wait_for_phase("default", "serve-job",
+                                 JobPhase.SUCCEEDED, max_steps=200)
+        job = rt.get_job("default", "serve-job")
+        assert job.status.restarts >= 1   # gang-restarted under backoff
+
+        # Epoch 0 drained PARTIAL completions — every request came back
+        # with a typed finish reason, none ran to its 150-token budget,
+        # and at least one carried real tokens (it was mid-decode).
+        assert {c.rid for c in partial} == {0, 1, 2, 3}
+        assert all(c.finish_reason in ("deadline", "shed", "length")
+                   for c in partial)
+        assert all(len(c.tokens) < 150 for c in partial)
+        assert any(c.tokens for c in partial)
+        # Epoch 1 served the workload to completion after the restart.
+        assert {c.rid for c in full} == {0, 1, 2, 3}
+        assert all(c.finish_reason == "length" and len(c.tokens) == 8
+                   for c in full)
+
+
 class TestChaosSoak:
     """VERDICT item 6: a seeded random fault schedule — preemptions, pod
     crashes, create failures, admission delays, controller crashes, job

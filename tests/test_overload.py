@@ -1,0 +1,66 @@
+"""Overload benchmark contract tests (ISSUE 4).
+
+The fast test runs ``benchmarks/overload_bench.py`` in smoke
+configuration and pins the JSON contract plus the no-silent-drop
+accounting identity per run. The slow test runs the fuller sweep and
+asserts the headline acceptance: with the robust policy, goodput at
+>=2x offered load stays within 90% of goodput at capacity.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks"))
+
+import overload_bench  # noqa: E402
+
+
+def _run(tmp_path, argv):
+    out = tmp_path / "overload.json"
+    rc = overload_bench.main(argv + ["--json", str(out)])
+    return rc, json.loads(out.read_text())
+
+
+def test_overload_bench_smoke_contract(tmp_path):
+    rc, res = _run(tmp_path, [
+        "--loads", "1,2", "--duration-s", "1.0",
+        "--capacity-requests", "16", "--skip-naive",
+    ])
+    assert res["metric"] == "overload_goodput_ratio_at_2x"
+    assert set(res) >= {"value", "acceptance", "capacity", "deadline_s",
+                        "max_queue", "runs"}
+    assert res["capacity"]["tokens_per_sec"] > 0
+    assert len(res["runs"]) == 2
+    for run in res["runs"]:
+        # every arrival is accounted for: a completion with a typed
+        # finish reason, or a typed queue-full rejection — never silence
+        assert (sum(run["finish_reasons"].values())
+                + run["rejected_queue_full"] == run["arrivals"])
+        assert set(run["finish_reasons"]) <= {
+            "eos", "length", "deadline", "cancelled", "shed"}
+        # bounded queue: the high-water mark respects max_queue
+        assert run["queue_depth_max"] <= res["max_queue"]
+    # exit code mirrors the acceptance bit
+    assert rc == (0 if res["acceptance"] else 1)
+
+
+@pytest.mark.slow
+def test_overload_goodput_holds_at_2x(tmp_path):
+    rc, res = _run(tmp_path, [
+        "--loads", "1,2,3", "--duration-s", "3.0",
+        "--capacity-requests", "32", "--skip-naive",
+    ])
+    assert rc == 0
+    assert res["acceptance"] is True
+    assert res["value"] >= 0.9
+    # overload sheds load instead of queueing it
+    over = [r for r in res["runs"]
+            if r["offered_rps"] >= 2 * res["capacity"]["requests_per_sec"]]
+    assert over and all(
+        r["rejected_queue_full"] + r["finish_reasons"].get("shed", 0) > 0
+        for r in over)

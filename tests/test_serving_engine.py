@@ -20,10 +20,21 @@ import numpy as np
 import pytest
 
 from kubeflow_controller_tpu.dataplane.serving_engine import (
-    Request, ServingEngine,
+    DrainError, Rejected, Request, ServingEngine,
 )
 from kubeflow_controller_tpu.models import generate as gen
 from kubeflow_controller_tpu.models import transformer as tfm
+
+
+class FakeClock:
+    """Deterministic engine clock — tests advance .t explicitly, so
+    deadline/queue-delay retirement is exact, not wall-time flaky."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
 
 
 @pytest.fixture(scope="module")
@@ -221,6 +232,258 @@ def test_submit_validations(cfg, params):
         gen.prefill_into_slot(
             cfg, params, jnp.zeros((2, 4), jnp.int32),
             gen.init_slot_cache(cfg, 2, 16), jnp.asarray(0, jnp.int32))
+
+
+class TestOverloadRobustness:
+    """Admission control, deadlines, cancellation, drain — the policy
+    retirement layer. Everything is host-side and row-local, so greedy
+    outputs of unaffected requests must stay bit-identical to
+    per-sequence generate throughout."""
+
+    def test_queue_full_rejected_typed(self, cfg, params):
+        eng = ServingEngine(cfg, params, n_slots=1, max_seq=32,
+                            max_queue=2)
+        reqs = _mixed_requests(cfg, n=3)
+        eng.submit(reqs[0])
+        eng.submit(reqs[1])
+        with pytest.raises(Rejected) as ei:
+            eng.submit(reqs[2])
+        assert ei.value.reason == "queue_full"
+        assert ei.value.rid == reqs[2].rid
+        assert eng.stats.rejected == 1
+        # the surviving requests still decode bit-exact
+        out = []
+        for _ in range(200):
+            out.extend(eng.step())
+            if eng.idle:
+                break
+        got = {c.rid: c.tokens for c in out}
+        for r in reqs[:2]:
+            assert got[r.rid] == _reference(cfg, params, r, 32)
+        # no silent drops: every submission is accounted for
+        assert eng.stats.submitted == 2
+        assert eng.stats.finished + eng.stats.rejected == 3
+
+    def test_duplicate_rid_rejected(self, cfg, params):
+        eng = ServingEngine(cfg, params, n_slots=1, max_seq=32)
+        r = _mixed_requests(cfg, n=1)[0]
+        eng.submit(r)
+        with pytest.raises(ValueError, match="duplicate rid"):
+            eng.submit(Request(rid=r.rid, prompt=r.prompt,
+                               max_new_tokens=4))
+        eng.step()          # admit: now in-flight, still a duplicate
+        with pytest.raises(ValueError, match="duplicate rid"):
+            eng.submit(Request(rid=r.rid, prompt=r.prompt,
+                               max_new_tokens=4))
+        while not eng.idle:
+            eng.step()
+        # after completion the rid is reusable
+        eng.submit(Request(rid=r.rid, prompt=r.prompt, max_new_tokens=2))
+
+    def test_deadline_expiry_mid_decode_partial_prefix(self, cfg, params):
+        """An in-flight request past its deadline retires with the
+        tokens decoded so far — a bit-exact PREFIX of the per-sequence
+        greedy stream, finish_reason 'deadline'."""
+        clk = FakeClock()
+        req = Request(rid=0,
+                      prompt=_mixed_requests(cfg, n=1)[0].prompt,
+                      max_new_tokens=20, deadline_s=6.5)
+        ref = _reference(cfg, params, req, 32, upto=20)
+        eng = ServingEngine(cfg, params, n_slots=2, max_seq=32,
+                            decode_chunk=1, clock=clk)
+        eng.submit(req)
+        comps = []
+        for _ in range(40):
+            comps.extend(eng.step())
+            clk.t += 1.0
+            if eng.idle:
+                break
+        assert [c.finish_reason for c in comps] == ["deadline"]
+        got = comps[0].tokens
+        assert 0 < len(got) < 20
+        assert got == ref[:len(got)]
+        assert eng.n_active == 0 and eng.idle
+
+    def test_neighbor_deadline_retirement_is_bit_exact(self, cfg, params):
+        """Deadline-retiring one slot must not perturb a single bit of
+        its neighbor's greedy stream, and the freed slot must admit the
+        next queued request, which also decodes bit-exact."""
+        clk = FakeClock()
+        rs = _mixed_requests(cfg, n=3)
+        doomed = Request(rid=0, prompt=rs[0].prompt, max_new_tokens=24,
+                         deadline_s=4.5)
+        survivor = Request(rid=1, prompt=rs[1].prompt, max_new_tokens=12)
+        queued = Request(rid=2, prompt=rs[2].prompt, max_new_tokens=10)
+        eng = ServingEngine(cfg, params, n_slots=2, max_seq=40,
+                            decode_chunk=1, clock=clk)
+        comps = []
+        for r in (doomed, survivor, queued):
+            eng.submit(r)
+        for _ in range(100):
+            comps.extend(eng.step())
+            clk.t += 1.0
+            if eng.idle:
+                break
+        by_rid = {c.rid: c for c in comps}
+        assert by_rid[0].finish_reason == "deadline"
+        assert 0 < len(by_rid[0].tokens) < 24
+        ref0 = _reference(cfg, params, doomed, 40, upto=24)
+        assert by_rid[0].tokens == ref0[:len(by_rid[0].tokens)]
+        # the neighbor and the late admit are untouched, full budget
+        assert by_rid[1].finish_reason == "length"
+        assert by_rid[1].tokens == _reference(cfg, params, survivor, 40)
+        assert by_rid[2].finish_reason == "length"
+        assert by_rid[2].tokens == _reference(cfg, params, queued, 40)
+
+    def test_cancel_queued_vs_inflight(self, cfg, params):
+        rs = _mixed_requests(cfg, n=3)
+        eng = ServingEngine(cfg, params, n_slots=1, max_seq=32,
+                            decode_chunk=1)
+        inflight = Request(rid=0, prompt=rs[0].prompt, max_new_tokens=20)
+        queued = Request(rid=1, prompt=rs[1].prompt, max_new_tokens=6)
+        tail = Request(rid=2, prompt=rs[2].prompt, max_new_tokens=4)
+        for r in (inflight, queued, tail):
+            eng.submit(r)
+        comps = []
+        for _ in range(5):                   # admit rid0 + decode a bit
+            comps.extend(eng.step())
+        assert eng.cancel(1) is True         # still queued
+        assert eng.cancel(0) is True         # mid-decode
+        assert eng.cancel(99) is False       # unknown rid: no-op
+        for _ in range(40):
+            comps.extend(eng.step())
+            if eng.idle:
+                break
+        by_rid = {c.rid: c for c in comps}
+        assert by_rid[1].finish_reason == "cancelled"
+        assert by_rid[1].tokens == []
+        assert by_rid[0].finish_reason == "cancelled"
+        ref0 = _reference(cfg, params, inflight, 32, upto=20)
+        assert 0 < len(by_rid[0].tokens) < 20
+        assert by_rid[0].tokens == ref0[:len(by_rid[0].tokens)]
+        # the freed slot served the tail request bit-exact
+        assert by_rid[2].tokens == _reference(cfg, params, tail, 32)
+        assert eng.stats.finish_reasons["cancelled"] == 2
+
+    def test_shed_at_admission_expired_deadline(self, cfg, params):
+        """A queued request whose deadline passes before a slot frees is
+        shed before prefill — zero slot time spent on it."""
+        clk = FakeClock()
+        rs = _mixed_requests(cfg, n=2)
+        hog = Request(rid=0, prompt=rs[0].prompt, max_new_tokens=16)
+        doomed = Request(rid=1, prompt=rs[1].prompt, max_new_tokens=8,
+                         deadline_s=3.0)
+        eng = ServingEngine(cfg, params, n_slots=1, max_seq=32,
+                            decode_chunk=1, clock=clk)
+        eng.submit(hog)
+        eng.submit(doomed)
+        comps = []
+        for _ in range(60):
+            comps.extend(eng.step())
+            clk.t += 1.0
+            if eng.idle:
+                break
+        by_rid = {c.rid: c for c in comps}
+        assert by_rid[1].finish_reason == "shed"
+        assert by_rid[1].tokens == []
+        assert by_rid[0].tokens == _reference(cfg, params, hog, 32)
+        assert eng.stats.admitted == 1       # the shed one never admitted
+
+    def test_queue_delay_cap_sheds_without_deadline(self, cfg, params):
+        clk = FakeClock()
+        rs = _mixed_requests(cfg, n=2)
+        eng = ServingEngine(cfg, params, n_slots=1, max_seq=32,
+                            decode_chunk=1, clock=clk,
+                            max_queue_delay_s=2.0)
+        eng.submit(Request(rid=0, prompt=rs[0].prompt, max_new_tokens=12))
+        eng.submit(Request(rid=1, prompt=rs[1].prompt, max_new_tokens=4))
+        comps = []
+        for _ in range(40):
+            comps.extend(eng.step())
+            clk.t += 1.0
+            if eng.idle:
+                break
+        by_rid = {c.rid: c for c in comps}
+        assert by_rid[1].finish_reason == "shed"
+        assert by_rid[1].queue_wait_s >= 2.0
+
+    def test_drain_returns_partials_and_blocks_admission(self, cfg, params):
+        rs = _mixed_requests(cfg, n=3)
+        eng = ServingEngine(cfg, params, n_slots=2, max_seq=64,
+                            decode_chunk=2)
+        live = [Request(rid=i, prompt=rs[i].prompt, max_new_tokens=40)
+                for i in range(3)]
+        for r in live:
+            eng.submit(r)
+        pre = []
+        for _ in range(4):                   # some tokens in flight
+            pre.extend(eng.step())
+        comps = pre + eng.drain(grace_s=0.0)
+        assert eng.idle
+        by_rid = {c.rid: c for c in comps}
+        assert set(by_rid) == {0, 1, 2}
+        # two in-flight slots: partial tokens, bit-exact greedy prefixes
+        partials = [c for c in comps if c.finish_reason == "deadline"]
+        assert len(partials) == 2
+        for c in partials:
+            assert 0 < len(c.tokens) < 40
+            ref = _reference(cfg, params, live[c.rid], 64, upto=40)
+            assert c.tokens == ref[:len(c.tokens)]
+        # the queued request was shed, not silently dropped
+        assert by_rid[2].finish_reason == "shed"
+        # draining engines refuse new work until reset
+        with pytest.raises(Rejected) as ei:
+            eng.submit(Request(rid=9, prompt=rs[0].prompt,
+                               max_new_tokens=4))
+        assert ei.value.reason == "draining"
+        eng.reset()
+        eng.submit(Request(rid=9, prompt=rs[0].prompt, max_new_tokens=4))
+
+    def test_drain_with_grace_finishes_inflight(self, cfg, params):
+        """A generous grace budget lets in-flight work finish naturally
+        (reason 'length'), bit-exact."""
+        rs = _mixed_requests(cfg, n=2)
+        eng = ServingEngine(cfg, params, n_slots=2, max_seq=32)
+        live = [Request(rid=i, prompt=rs[i].prompt, max_new_tokens=6)
+                for i in range(2)]
+        for r in live:
+            eng.submit(r)
+        comps = eng.step() + eng.drain(grace_s=30.0)
+        by_rid = {c.rid: c for c in comps}
+        for r in live:
+            assert by_rid[r.rid].finish_reason == "length"
+            assert by_rid[r.rid].tokens == _reference(cfg, params, r, 32)
+
+    def test_run_drain_failure_carries_partials(self, cfg, params):
+        """run() overrunning its step budget must hand back what DID
+        finish instead of discarding it."""
+        rs = _mixed_requests(cfg, n=2)
+        eng = ServingEngine(cfg, params, n_slots=1, max_seq=64,
+                            decode_chunk=1)
+        quick = Request(rid=0, prompt=rs[0].prompt, max_new_tokens=2)
+        slow = Request(rid=1, prompt=rs[1].prompt, max_new_tokens=40)
+        with pytest.raises(DrainError) as ei:
+            eng.run([quick, slow], max_steps=10)
+        done = {c.rid for c in ei.value.completions}
+        assert 0 in done and 1 not in done
+        assert isinstance(ei.value, RuntimeError)   # old handlers still work
+
+    def test_run_stop_event_drains(self, cfg, params):
+        """run(stop=...) — the SIGTERM path: a pre-set stop event makes
+        run return the drained partials instead of decoding on."""
+        import threading
+
+        rs = _mixed_requests(cfg, n=2)
+        eng = ServingEngine(cfg, params, n_slots=2, max_seq=64)
+        stop = threading.Event()
+        stop.set()
+        comps = eng.run(
+            [Request(rid=i, prompt=rs[i].prompt, max_new_tokens=30)
+             for i in range(2)],
+            stop=stop, drain_grace_s=0.0)
+        assert {c.rid for c in comps} == {0, 1}
+        assert all(c.finish_reason in ("shed", "deadline") for c in comps)
+        assert eng.idle
 
 
 def test_metrics_populated(cfg, params):

@@ -49,6 +49,59 @@ def test_double_install_rejected():
     assert sub.stdout.strip() == "rejected"
 
 
+def test_serve_lm_sigterm_drains_partials_and_flushes_metrics(tmp_path):
+    """ISSUE 4 acceptance: SIGTERM mid-decode -> the serve_lm entrypoint
+    (wired to setup_signal_handler's stop event) drains the engine,
+    exits 0, writes PARTIAL completions tagged with finish reasons, and
+    still flushes the metrics JSONL."""
+    import json
+
+    out = tmp_path / "completions.jsonl"
+    logdir = tmp_path / "logs"
+    p = subprocess.Popen(
+        [sys.executable, "-m",
+         "kubeflow_controller_tpu.dataplane.entrypoints.serve_lm",
+         "--config", "tiny", "--batch", "2", "--prompt-len", "4",
+         "--max-new-tokens", "2048", "--output", str(out),
+         "--drain-grace-s", "0.5"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "TPUJOB_LOG_DIR": str(logdir)},
+    )
+    # serve_lm logs this marker once real tokens are decoding — SIGTERM
+    # after it is guaranteed mid-decode, not mid-compile.
+    deadline = time.time() + 120
+    seen = False
+    for line in p.stdout:
+        if "first tokens decoded" in line:
+            seen = True
+            break
+        if time.time() > deadline:
+            break
+    assert seen, "serve_lm never reported decoding"
+    p.send_signal(signal.SIGTERM)
+    try:
+        tail, _ = p.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        pytest.fail("serve_lm did not drain on SIGTERM")
+    assert p.returncode == 0, tail
+
+    # partial completions: present, typed, truncated
+    rows = [json.loads(line) for line in open(out)]
+    assert rows, "no completions flushed"
+    assert all(r["finish_reason"] in
+               ("eos", "length", "deadline", "cancelled", "shed")
+               for r in rows)
+    assert any(0 < len(r["completion"]) < 2048 for r in rows), rows
+    # metrics JSONL flushed into the job log_dir sink
+    mfile = logdir / "metrics-p0.jsonl"
+    assert mfile.exists()
+    rec = json.loads(mfile.read_text().strip().splitlines()[-1])
+    assert rec["interrupted"] == 1.0
+    assert rec["tokens_out"] > 0
+
+
 def test_serve_daemon_sigterm_clean_shutdown(tmp_path):
     p = subprocess.Popen(
         [sys.executable, "-m", "kubeflow_controller_tpu.cli",
