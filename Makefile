@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: all native test test-fast bench bench-cp bench-serve \
-	bench-overload clean stamp
+	bench-overload bench-prefix clean stamp
 
 # Build-stamp analog of the reference's ldflags version injection
 # (/root/reference/Makefile:23-26): export the sha for build_version().
@@ -45,6 +45,16 @@ bench-overload:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/overload_bench.py \
 		--loads 1,2 --duration-s 2.0 --capacity-requests 24 \
 		--json benchmarks/overload_bench_summary.json
+
+# Prefix-cache / bucketed-prefill benchmark: shared-system-prompt TTFT
+# with the radix block pool on vs off (greedy outputs asserted
+# bit-identical before timing; exits nonzero below 2x p50), plus the
+# prefill compile count on random prompt lengths (exact-per-length vs
+# the O(log block_size) bucket bound) — see benchmarks/RESULTS.md and
+# docs/serving.md.
+bench-prefix:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/prefix_bench.py \
+		--json benchmarks/prefix_bench_summary.json
 
 clean:
 	$(MAKE) -C csrc clean

@@ -1,0 +1,214 @@
+"""Prefix-cache / bucketed-prefill benchmark for the serving engine.
+
+Two claims from the block-pool design (docs/serving.md "KV block pool,
+prefix reuse, and prefill bucketing"), each measured on its natural
+workload:
+
+* **shared-prefix TTFT**: N requests share one system prompt and differ
+  only in a short tail — production chat traffic. With the radix prefix
+  cache ON, admission device-copies the shared blocks out of the pool
+  and prefills only the tail, so TTFT p50 must drop >= 2x vs the same
+  bucketed engine with the cache OFF. Greedy outputs are asserted
+  BIT-IDENTICAL between the two paths before any timing is reported
+  (same discipline as serving_bench.py) — the copy-into-slot design
+  makes cached and cold runs execute identical compiled computations on
+  identical bytes, so this is a tripwire, not a tolerance.
+* **compile count**: random prompt lengths in [1, max_len]. Exact-length
+  admission compiles one prefill per DISTINCT length (unbounded);
+  bucketed admission decomposes every prefill into block-grid chunks
+  whose padded widths are powers of two <= block_size, so total prefill
+  compiles are bounded by 1 + log2(block_size) — O(log max_len),
+  independent of length diversity.
+
+Prints one JSON object; with ``--json`` also writes it to a file. Run
+via ``make bench-prefix``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+from typing import Dict, List
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np
+
+
+def shared_prefix_workload(cfg, n_requests: int, shared_len: int,
+                           tail_max: int, max_new: int, seed: int):
+    from kubeflow_controller_tpu.dataplane.serving_engine import Request
+
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(0, cfg.vocab_size, shared_len)
+    reqs = []
+    for i in range(n_requests):
+        tail = rng.integers(0, cfg.vocab_size, 1 + int(rng.integers(tail_max)))
+        reqs.append(Request(
+            rid=i,
+            prompt=np.concatenate([shared, tail]).astype(np.int32),
+            max_new_tokens=max_new,
+        ))
+    return reqs
+
+
+def random_length_workload(cfg, n_requests: int, max_len: int,
+                           max_new: int, seed: int):
+    from kubeflow_controller_tpu.dataplane.serving_engine import Request
+
+    rng = np.random.default_rng(seed)
+    # Sample WITHOUT replacement where possible: maximum length
+    # diversity is the adversarial case for per-length compilation.
+    lens = rng.permutation(np.arange(1, max_len + 1))
+    lens = np.concatenate([lens] * (1 + n_requests // len(lens)))[:n_requests]
+    return [
+        Request(rid=i,
+                prompt=rng.integers(0, cfg.vocab_size, int(l)).astype(
+                    np.int32),
+                max_new_tokens=max_new)
+        for i, l in enumerate(lens)
+    ]
+
+
+def run_engine(cfg, params, requests, repeats: int, **engine_kw):
+    """Median-of-repeats run; returns (outputs, median summary, engine).
+    The engine warms (compile + run) before timing and resets between
+    repeats — the prefix trie is rebuilt inside each timed run, so the
+    reported TTFT includes the cold first-request miss."""
+    from kubeflow_controller_tpu.dataplane.serving_engine import (
+        ServingEngine,
+    )
+
+    engine = ServingEngine(cfg, params, **engine_kw)
+
+    def reqs():
+        return [type(r)(rid=r.rid, prompt=r.prompt,
+                        max_new_tokens=r.max_new_tokens, eos_id=r.eos_id)
+                for r in requests]
+
+    engine.run(reqs())                            # warmup: compile + run
+    runs = []
+    for _ in range(repeats):
+        engine.reset()
+        t0 = time.perf_counter()
+        completions = engine.run(reqs())
+        wall = time.perf_counter() - t0
+        runs.append((wall, completions, engine.stats))
+    runs.sort(key=lambda r: r[0])
+    wall, completions, stats = runs[len(runs) // 2]
+    summary = stats.summary(wall_s=wall)
+    summary["wall_s"] = wall
+    return {c.rid: list(c.tokens) for c in completions}, summary, engine
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="tiny")
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--shared-len", type=int, default=96,
+                   help="shared system-prompt length (tokens)")
+    p.add_argument("--tail-max", type=int, default=8,
+                   help="per-request unique tail length upper bound")
+    p.add_argument("--max-new", type=int, default=8)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--rand-requests", type=int, default=24,
+                   help="random-length workload size (compile-count leg)")
+    p.add_argument("--rand-max-len", type=int, default=48)
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json", default="", help="also write the summary here")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from kubeflow_controller_tpu.dataplane.entrypoints.lm import CONFIGS
+    from kubeflow_controller_tpu.models import generate as gen
+    from kubeflow_controller_tpu.models import transformer as tfm
+
+    cfg = CONFIGS[args.config]()
+    params = gen.inference_params(
+        cfg, tfm.init_params(cfg, jax.random.key(0)))
+
+    # ---- leg 1: shared-prefix TTFT, cache on vs off ---------------------
+    reqs = shared_prefix_workload(
+        cfg, args.requests, args.shared_len, args.tail_max, args.max_new,
+        args.seed)
+    max_seq = args.shared_len + args.tail_max + args.max_new + 1
+    base_kw = dict(n_slots=args.slots, max_seq=max_seq,
+                   prefill_mode="bucketed", block_size=args.block_size)
+    off_out, off_sum, _ = run_engine(
+        cfg, params, reqs, args.repeats, **base_kw)
+    on_out, on_sum, _ = run_engine(
+        cfg, params, reqs, args.repeats, prefix_cache=True, **base_kw)
+
+    # Bit-exactness gate BEFORE any timing is reported: a speedup over
+    # different outputs would be comparing different work.
+    mismatches = [rid for rid in off_out if off_out[rid] != on_out.get(rid)]
+    ttft_speedup = (off_sum["ttft_p50_ms"] / on_sum["ttft_p50_ms"]
+                    if on_sum["ttft_p50_ms"] else float("inf"))
+
+    # ---- leg 2: compile count on random lengths -------------------------
+    rand = random_length_workload(
+        cfg, args.rand_requests, args.rand_max_len, args.max_new,
+        args.seed + 1)
+    rand_seq = args.rand_max_len + args.max_new
+    _, exact_sum, exact_eng = run_engine(
+        cfg, params, rand, 1, n_slots=args.slots, max_seq=rand_seq,
+        prefill_mode="exact")
+    _, buck_sum, buck_eng = run_engine(
+        cfg, params, rand, 1, n_slots=args.slots, max_seq=rand_seq,
+        prefill_mode="bucketed", block_size=args.block_size)
+    compile_bound = 1 + int(math.log2(args.block_size))
+    distinct_lens = len({r.prompt.size for r in rand})
+
+    out = {
+        "metric": "prefix_cache_ttft_p50_speedup",
+        "value": round(ttft_speedup, 2),
+        "unit": "x cache-on vs cache-off TTFT p50, shared-prefix workload",
+        "outputs_match": not mismatches,
+        "shared_prefix": {
+            "requests": args.requests,
+            "shared_len": args.shared_len,
+            "tail_max": args.tail_max,
+            "slots": args.slots,
+            "block_size": args.block_size,
+            "cache_off": off_sum,
+            "cache_on": on_sum,
+        },
+        "compile_count": {
+            "requests": args.rand_requests,
+            "distinct_prompt_lens": distinct_lens,
+            "exact_prefill_compiles": exact_eng.stats.prefill_compiles,
+            "bucketed_prefill_compiles": buck_eng.stats.prefill_compiles,
+            "bucketed_bound": compile_bound,
+            "exact_tokens_per_sec": exact_sum.get("tokens_per_sec", 0.0),
+            "bucketed_tokens_per_sec": buck_sum.get("tokens_per_sec", 0.0),
+        },
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+    if mismatches:
+        print(f"OUTPUT MISMATCH for rids {mismatches[:8]}...")
+        return 1
+    if buck_eng.stats.prefill_compiles > compile_bound:
+        print(f"COMPILE BOUND EXCEEDED: {buck_eng.stats.prefill_compiles}"
+              f" > {compile_bound}")
+        return 1
+    if ttft_speedup < 2.0:
+        print(f"TTFT SPEEDUP BELOW TARGET: {ttft_speedup:.2f}x < 2x")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

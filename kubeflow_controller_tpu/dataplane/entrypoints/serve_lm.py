@@ -131,6 +131,10 @@ def serve(
     deadline_s: Optional[float] = None,
     max_queue: Optional[int] = None,
     drain_grace_s: float = 2.0,
+    prefill_mode: str = "exact",
+    prefix_cache: bool = False,
+    block_size: int = 16,
+    kv_pool_mb: Optional[float] = None,
     stop=None,
 ) -> Dict[str, float]:
     """``stop`` is a ``threading.Event`` (e.g. from
@@ -180,6 +184,9 @@ def serve(
         engine = ServingEngine(
             cfg, params, n_slots=n_slots, max_seq=s + max_new_tokens,
             temperature=temperature, rng=rng, max_queue=max_queue,
+            prefill_mode=("bucketed" if prefix_cache else prefill_mode),
+            prefix_cache=prefix_cache, block_size=block_size,
+            kv_hbm_budget_mb=kv_pool_mb,
         )
         prompts_np = np.asarray(prompts)
         completions = []
@@ -221,6 +228,53 @@ def serve(
         tok_rows = [c.tokens for c in completions]
         dt = time.perf_counter() - t0
         serving = engine.stats.summary(wall_s=dt)
+    elif prefix_cache:
+        # Multi-turn through the ENGINE with the radix prefix cache:
+        # every turn submits the FULL conversation so far as a fresh
+        # request. Turn N's retirement registered its prompt AND reply
+        # blocks in the trie, so turn N+1's admission device-copies all
+        # of them and prefills only the new follow-up — the block-pool
+        # version of the shared-cache session below, with the engine's
+        # scheduling, overload policies, and stats along for the ride.
+        n_slots = min(slots, b) if slots > 0 else b
+        engine = ServingEngine(
+            cfg, params, n_slots=n_slots,
+            max_seq=turns * (s + max_new_tokens),
+            temperature=temperature, rng=rng, max_queue=max_queue,
+            prefill_mode="bucketed", prefix_cache=True,
+            block_size=block_size, kv_hbm_budget_mb=kv_pool_mb,
+        )
+        prompts_np = np.asarray(prompts)
+        history = [list(map(int, prompts_np[i])) for i in range(b)]
+        tok_rows = [[] for _ in range(b)]
+        finish_reasons = ["length"] * b
+        for turn in range(turns):
+            if turn:
+                follow_up = np.random.default_rng(seed + turn).integers(
+                    0, cfg.vocab_size, (b, s))
+                for i in range(b):
+                    history[i].extend(map(int, follow_up[i]))
+            comps = engine.run([
+                Request(
+                    rid=turn * b + i,
+                    prompt=np.asarray(history[i], np.int32),
+                    max_new_tokens=max_new_tokens, eos_id=eos_id,
+                ) for i in range(b)
+            ])
+            comps.sort(key=lambda c: c.rid)
+            for i, c in enumerate(comps):
+                history[i].extend(c.tokens)
+                tok_rows[i].extend(c.tokens)
+                finish_reasons[i] = c.finish_reason
+        dt = time.perf_counter() - t0
+        serving = engine.stats.summary(wall_s=dt)
+        logger.info(
+            "multi-turn prefix reuse: hit rate %.2f (%d/%d prompt "
+            "tokens from cached blocks)",
+            engine.stats.prefix_hit_rate,
+            engine.stats.prefix_hit_tokens,
+            engine.stats.prefix_lookup_tokens,
+        )
     else:
         # Multi-turn chat shape: the first turn block-prefills a fresh
         # cache; every later turn extends it with prefill_continue (ONE
@@ -342,6 +396,21 @@ def main(argv=None) -> int:
                    help="wall seconds the SIGTERM drain lets in-flight "
                         "slots finish before retiring them with partial "
                         "output")
+    p.add_argument("--prefill-mode", default="exact",
+                   choices=["exact", "bucketed"],
+                   help="exact = one compiled prefill per prompt length;"
+                        " bucketed = block-grid chunked prefill, O(log)"
+                        " compiles (required for --prefix-cache)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="radix-trie prefix reuse over a shared KV block "
+                        "pool (implies bucketed prefill); with --turns, "
+                        "each turn reuses the previous turn's blocks")
+    p.add_argument("--block-size", type=int, default=16,
+                   help="KV page size in tokens (power of two) for the "
+                        "block pool and prefill chunking")
+    p.add_argument("--kv-pool-mb", type=float, default=0.0,
+                   help="HBM budget for the prefix-cache block pool in "
+                        "MiB (0 = one full context per slot)")
     args = p.parse_args(argv)
     ctx = initialize_from_env()
     # Two-strike SIGTERM/SIGINT drain (util/signals.py, signals.go:26-40
@@ -370,6 +439,10 @@ def main(argv=None) -> int:
         deadline_s=args.deadline_s if args.deadline_s > 0 else None,
         max_queue=args.max_queue if args.max_queue > 0 else None,
         drain_grace_s=args.drain_grace_s,
+        prefill_mode=args.prefill_mode,
+        prefix_cache=args.prefix_cache,
+        block_size=args.block_size,
+        kv_pool_mb=args.kv_pool_mb if args.kv_pool_mb > 0 else None,
         stop=stop,
     )
     if metrics["interrupted"]:

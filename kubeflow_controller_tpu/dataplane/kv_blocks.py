@@ -1,0 +1,329 @@
+"""Block-pooled KV cache with radix prefix reuse for the serving engine.
+
+Production LM traffic is dominated by shared prefixes — system prompts,
+few-shot templates, multi-turn sessions — yet the slot engine (PR 3/4)
+prefilled every admitted prompt from token zero. This module brings the
+two standard remedies to the slot pool:
+
+* **Block pool** (vLLM's PagedAttention granularity, Kwon et al. 2023):
+  KV for cached prefixes lives in fixed ``block_size``-token pages of a
+  shared device pool ``[L, n_blocks, block_size, KVH, D]``, managed by a
+  host-side free-list allocator with per-block refcounts. The pool is
+  sized from an HBM budget (:func:`blocks_for_budget`), so prefix
+  caching can never grow past the memory an operator granted it.
+* **Radix trie** (SGLang's RadixAttention, Zheng et al. 2024):
+  :class:`RadixCache` keys a trie over *block-granular* token-id chunks.
+  Admission walks the trie with the request's prompt, takes the longest
+  chain of fully-matching blocks, and device-copies those pages into the
+  slot's KV row — only the uncached suffix is prefilled. Completed
+  prefills insert their prompt's full blocks back into the trie.
+
+Ownership model (the part the property tests pin):
+
+* allocating a block hands it to the trie with refcount 1 — the trie's
+  own structural hold;
+* every live request that matched through (or inserted) a node holds
+  one additional pin from admission to retirement — eos, length,
+  deadline, cancel, and drain all release through the same path;
+* eviction (LRU over leaf nodes) may only reclaim nodes with zero
+  request pins, and dropping the trie's hold is what returns the block
+  to the free list — each block's refcount hits zero exactly once per
+  tenancy, enforced loudly by :meth:`BlockPool.unref`.
+
+The engine COPIES matched pages into the slot row rather than attending
+to them in place: the decode path keeps its contiguous per-slot layout
+(and with it every bit-exactness invariant in tests/test_serving_engine),
+while eviction stays trivially safe — a pool page is never aliased by a
+live slot, only snapshotted into it. Device copy/gather helpers live in
+``models/generate.py`` (``copy_blocks_into_slot`` /
+``copy_row_into_blocks``); this module is pure host bookkeeping plus the
+:class:`PrefixStore` facade that owns the device pool arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def blocks_for_budget(cfg, block_size: int, budget_bytes: int) -> int:
+    """How many KV pages fit in ``budget_bytes`` of HBM for this model.
+
+    One page holds k AND v for ``block_size`` tokens across all layers:
+    ``2 * L * block_size * KVH * D * itemsize`` bytes.
+    """
+    import jax.numpy as jnp
+
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    per_block = (
+        2 * cfg.n_layers * block_size * cfg.n_kv_heads * cfg.head_dim
+        * itemsize
+    )
+    return max(0, int(budget_bytes) // per_block)
+
+
+class BlockPool:
+    """Free-list allocator over ``n_blocks`` page ids with refcounts.
+
+    Pure host state — no device arrays. ``alloc`` hands out a page at
+    refcount 1; ``ref``/``unref`` adjust pins; the unref that reaches
+    zero returns the page to the free list. Double-free (unref past
+    zero, or unref of a never-allocated page) raises — an allocator
+    that silently recycles an aliased page would corrupt cached
+    prefixes undetectably.
+    """
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 0:
+            raise ValueError(f"n_blocks must be >= 0 (got {n_blocks})")
+        self.n_blocks = n_blocks
+        # LIFO free list: recently-freed pages are re-used first, which
+        # keeps the working set of pool pages dense.
+        self._free: List[int] = list(range(n_blocks - 1, -1, -1))
+        self._refs: List[int] = [0] * n_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.n_blocks - len(self._free)
+
+    def refcount(self, bid: int) -> int:
+        return self._refs[bid]
+
+    def alloc(self) -> Optional[int]:
+        """Pop a free page at refcount 1, or None when exhausted (the
+        caller decides whether to evict or to skip caching)."""
+        if not self._free:
+            return None
+        bid = self._free.pop()
+        assert self._refs[bid] == 0, f"free-list page {bid} had refs"
+        self._refs[bid] = 1
+        return bid
+
+    def ref(self, bid: int) -> None:
+        if self._refs[bid] <= 0:
+            raise RuntimeError(f"ref of dead page {bid}")
+        self._refs[bid] += 1
+
+    def unref(self, bid: int) -> None:
+        if self._refs[bid] <= 0:
+            raise RuntimeError(f"double free of page {bid}")
+        self._refs[bid] -= 1
+        if self._refs[bid] == 0:
+            self._free.append(bid)
+
+
+@dataclass
+class RadixNode:
+    """One trie edge = one full block of ``block_size`` token ids.
+
+    ``refs`` counts live-request pins (the trie's own hold on the pool
+    page is tracked in the BlockPool refcount, not here)."""
+
+    key: Tuple[int, ...]
+    block: int
+    parent: Optional["RadixNode"]
+    children: Dict[Tuple[int, ...], "RadixNode"] = field(default_factory=dict)
+    refs: int = 0
+    last_use: int = 0
+
+
+class RadixCache:
+    """Radix/prefix trie over block-granular token chunks.
+
+    Every node below the root owns exactly one pool page holding the KV
+    of its ``block_size`` tokens *in the context of its ancestors* —
+    matching is therefore exact-prefix by construction. Eviction is LRU
+    over unpinned leaves; interior nodes become evictable once their
+    subtree is gone, so a cold chain drains from the tail.
+    """
+
+    def __init__(self, pool: BlockPool, block_size: int):
+        if block_size <= 0:
+            raise ValueError(f"block_size must be > 0 (got {block_size})")
+        self.pool = pool
+        self.block_size = block_size
+        self.root = RadixNode(key=(), block=-1, parent=None)
+        self._tick = 0
+
+    # -- internals -------------------------------------------------------
+
+    def _touch(self, node: RadixNode) -> None:
+        self._tick += 1
+        node.last_use = self._tick
+
+    def _evictable(self) -> List[RadixNode]:
+        """Unpinned leaves, the only safely removable nodes: an interior
+        node's page encodes context its descendants were computed in."""
+        out = []
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            if not n.children and n.refs == 0:
+                out.append(n)
+            stack.extend(n.children.values())
+        return out
+
+    def evict_one(self) -> Optional[int]:
+        """Drop the least-recently-used unpinned leaf, returning its
+        freed page id (None when nothing is evictable)."""
+        victims = self._evictable()
+        if not victims:
+            return None
+        victim = min(victims, key=lambda n: n.last_use)
+        del victim.parent.children[victim.key]
+        bid = victim.block
+        self.pool.unref(bid)        # the trie's own hold -> free list
+        return bid
+
+    # -- queries ---------------------------------------------------------
+
+    def match(self, tokens: Sequence[int]) -> List[RadixNode]:
+        """Longest chain of fully-cached blocks prefixing ``tokens``.
+        Returns the node path root-exclusive (possibly empty)."""
+        bs = self.block_size
+        path: List[RadixNode] = []
+        node = self.root
+        toks = [int(t) for t in tokens]
+        for i in range(0, len(toks) - bs + 1, bs):
+            key = tuple(toks[i:i + bs])
+            child = node.children.get(key)
+            if child is None:
+                break
+            self._touch(child)
+            path.append(child)
+            node = child
+        return path
+
+    def insert(
+        self, tokens: Sequence[int],
+        known_path: Sequence[RadixNode] = (),
+    ) -> Tuple[List[RadixNode], List[Tuple[RadixNode, int]]]:
+        """Ensure every full block of ``tokens`` has a trie node.
+
+        Walks/extends the chain; for blocks not yet present, allocates a
+        pool page (evicting LRU leaves when the pool is exhausted) and
+        creates the node. Returns ``(path, new)`` where ``path`` is the
+        full chain that now exists and ``new`` lists ``(node,
+        token_offset)`` pairs whose KV the caller must device-copy into
+        the pool. Best-effort: when no page can be found even after
+        eviction, the chain simply stops there (a shorter cached prefix,
+        never an error). ``known_path`` is a chain already matched (and
+        pinned, so it cannot have been evicted) for this exact prefix —
+        the walk resumes after it instead of re-hashing those blocks.
+        """
+        bs = self.block_size
+        toks = [int(t) for t in tokens]
+        node = known_path[-1] if known_path else self.root
+        path: List[RadixNode] = list(known_path)
+        new: List[Tuple[RadixNode, int]] = []
+        for i in range(len(known_path) * bs, len(toks) - bs + 1, bs):
+            key = tuple(toks[i:i + bs])
+            child = node.children.get(key)
+            if child is None:
+                bid = self.pool.alloc()
+                while bid is None:
+                    if self.evict_one() is None:
+                        return path, new          # pool fully pinned
+                    bid = self.pool.alloc()
+                child = RadixNode(key=key, block=bid, parent=node)
+                node.children[key] = child
+                new.append((child, i))
+            self._touch(child)
+            path.append(child)
+            node = child
+        return path, new
+
+    def acquire(self, path: Sequence[RadixNode]) -> None:
+        """Pin a chain on behalf of a live request (refcount +1 per node,
+        page and trie node both)."""
+        for n in path:
+            n.refs += 1
+            self.pool.ref(n.block)
+
+    def release(self, path: Sequence[RadixNode]) -> None:
+        """Drop a live request's pins — called on EVERY retirement path
+        (eos/length/deadline/cancel/drain)."""
+        for n in path:
+            if n.refs <= 0:
+                raise RuntimeError("release of unpinned radix node")
+            n.refs -= 1
+            self.pool.unref(n.block)
+
+    def n_nodes(self) -> int:
+        count = 0
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            count += 1
+            stack.extend(n.children.values())
+        return count
+
+
+class PrefixStore:
+    """Device pool arrays + trie + allocator, the unit the engine owns.
+
+    ``match_for_admission`` caps the usable match one block short of a
+    fully-cached prompt: admission needs the last prompt position's
+    logits, which only a real prefill of >= 1 token produces (the same
+    recompute-the-tail rule vLLM applies).
+    """
+
+    def __init__(self, cfg, block_size: int, n_blocks: int):
+        from kubeflow_controller_tpu.models import generate as gen
+
+        self.cfg = cfg
+        self.block_size = block_size
+        self.pool = BlockPool(n_blocks)
+        self.trie = RadixCache(self.pool, block_size)
+        self.k, self.v = gen.init_block_pool(cfg, max(1, n_blocks),
+                                             block_size)
+
+    @property
+    def n_blocks(self) -> int:
+        return self.pool.n_blocks
+
+    def match_for_admission(
+        self, tokens: Sequence[int],
+    ) -> Tuple[List[RadixNode], int]:
+        """(pinned path, matched token count) for a prompt about to be
+        admitted. The path arrives ALREADY acquired — the caller owns a
+        release, whatever retirement path the request takes."""
+        path = self.trie.match(tokens)
+        while path and len(path) * self.block_size >= len(tokens):
+            path.pop()                    # leave >= 1 token to prefill
+        self.trie.acquire(path)
+        return path, len(path) * self.block_size
+
+    def insert_from_row(
+        self, tokens: Sequence[int], cache_k, cache_v, row: int,
+        known_path: Sequence[RadixNode] = (),
+    ) -> List[RadixNode]:
+        """Register ``tokens``' full blocks, copying KV for newly-created
+        nodes out of row ``row`` of a slot-cache/KV-cache pair (layout
+        ``[L, B, S, KVH, D]``). Returns the chain, NOT acquired — pin it
+        with ``trie.acquire`` if the caller's tenant should hold it."""
+        from kubeflow_controller_tpu.models import generate as gen
+
+        path, new = self.trie.insert(tokens, known_path=known_path)
+        if new:
+            ids = [n.block for n, _ in new]
+            starts = [off for _, off in new]
+            self.k, self.v = gen.copy_row_into_blocks(
+                self.k, self.v, cache_k, cache_v, row, ids, starts,
+                self.block_size,
+            )
+        return path
+
+    def release(self, path: Sequence[RadixNode]) -> None:
+        self.trie.release(path)
+
+    def clear(self) -> None:
+        """Drop every cached prefix (host bookkeeping only — device
+        pages hold stale bytes until the next insert overwrites them,
+        and nothing can reference a page the trie no longer names)."""
+        self.pool = BlockPool(self.pool.n_blocks)
+        self.trie = RadixCache(self.pool, self.block_size)

@@ -72,6 +72,21 @@ class ServingStats:
     tpots_s: List[float] = field(default_factory=list)
     queue_waits_s: List[float] = field(default_factory=list)
     finish_reasons: Dict[str, int] = field(default_factory=dict)
+    # Prefix-cache / prefill accounting (docs/serving.md "KV block
+    # pool, prefix reuse, and prefill bucketing"): hit tokens are prompt
+    # tokens whose KV came out of the block pool instead of a prefill;
+    # lookup tokens are all prompt tokens that went through admission
+    # with a prefix store attached (the hit-rate denominator).
+    prefix_hit_tokens: int = 0
+    prefix_lookup_tokens: int = 0
+    prefill_chunks: int = 0
+    # Gauges the engine refreshes every step: cumulative prefill
+    # compiles (exact lengths + bucket widths), live entries in the
+    # LRU-bounded exact-length admit memo, and block-pool occupancy.
+    prefill_compiles: int = 0
+    admit_cache_size: int = 0
+    pool_blocks_total: int = 0
+    pool_blocks_in_use: int = 0
 
     def record(self, completion) -> None:
         self.finished += 1
@@ -86,6 +101,14 @@ class ServingStats:
     def slot_utilization(self) -> float:
         denom = self.steps * self.n_slots
         return self.active_slot_steps / denom if denom else 0.0
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fraction of admitted prompt tokens served from cached blocks
+        (0.0 with no prefix store or before any admission)."""
+        if not self.prefix_lookup_tokens:
+            return 0.0
+        return self.prefix_hit_tokens / self.prefix_lookup_tokens
 
     def summary(self, wall_s: float = 0.0) -> Dict[str, float]:
         out = {
@@ -104,6 +127,13 @@ class ServingStats:
             "queue_wait_p95_ms": percentile(self.queue_waits_s, 95) * 1e3,
             "queue_depth_max": float(self.queue_depth_max),
             "slot_utilization": self.slot_utilization,
+            "prefix_hit_tokens": float(self.prefix_hit_tokens),
+            "prefix_hit_rate": self.prefix_hit_rate,
+            "prefill_compiles": float(self.prefill_compiles),
+            "prefill_chunks": float(self.prefill_chunks),
+            "admit_cache_size": float(self.admit_cache_size),
+            "pool_blocks_total": float(self.pool_blocks_total),
+            "pool_blocks_in_use": float(self.pool_blocks_in_use),
         }
         if wall_s > 0:
             out["tokens_per_sec"] = self.tokens_out / wall_s

@@ -67,13 +67,30 @@ pending chunk's tokens for that row are discarded by the existing
 snapshot-identity check. All retirement paths are row-local, so greedy
 decode of *unaffected* slots stays bit-equivalent to per-sequence
 ``gen.generate`` (pinned by tests/test_serving_engine.py).
+
+Prefix reuse & prefill bucketing (docs/serving.md "KV block pool,
+prefix reuse, and prefill bucketing"): with ``prefill_mode="bucketed"``
+every prefill is decomposed on the absolute ``block_size`` grid into
+full-block chunks plus a pow2-padded tail, run one chunk per step
+interleaved with decode (Sarathi-style), bounding total prefill
+compiles at ``1 + log2(block_size)`` regardless of prompt-length
+diversity. ``prefix_cache=True`` adds a refcounted block pool + radix
+trie (:mod:`~kubeflow_controller_tpu.dataplane.kv_blocks`): admission
+walks the trie over the prompt's token chunks, device-copies the
+longest cached prefix's pages into the slot, and prefills only the
+uncached suffix; retirement registers prompt+decoded tokens back into
+the trie so later requests (and later conversation turns, via
+``register_prefix``) reuse them. Because chunk boundaries sit on the
+absolute block grid, cached and cold runs execute identical compiled
+functions on identical bytes — greedy outputs are bit-equal with the
+cache on or off BY CONSTRUCTION (pinned by tests/test_kv_blocks.py).
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -81,6 +98,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubeflow_controller_tpu.dataplane import kv_blocks
 from kubeflow_controller_tpu.dataplane.metrics import ServingStats
 from kubeflow_controller_tpu.models import generate as gen
 from kubeflow_controller_tpu.models.transformer import (
@@ -179,6 +197,24 @@ class _Queued:
 
 
 @dataclass
+class _Prefill:
+    """Chunked-prefill progress for a slot still mid-admission
+    (``prefill_mode="bucketed"``): the prompt decomposes into
+    ``block_size``-token chunks on the ABSOLUTE block grid (the last,
+    partial chunk pads to a power-of-two bucket), and the engine advances
+    one chunk per scheduling step, interleaved with the pool's decode
+    dispatches (Sarathi-style) so a long prompt no longer head-of-line
+    blocks TPOT for in-flight slots. ``next_off`` starts at the
+    prefix-cache match length — the cached blocks were device-copied
+    into the row at admission, so only the suffix runs."""
+
+    tokens: np.ndarray
+    next_off: int
+    eos_val: int
+    budget_val: int
+
+
+@dataclass
 class _Slot:
     """Host bookkeeping for one live slot (device truth lives in the
     SlotKVCache row)."""
@@ -190,6 +226,16 @@ class _Slot:
     cancelled: bool = False
     first_token_t: Optional[float] = None
     tokens: List[int] = field(default_factory=list)
+    # Radix-trie nodes this request pins (prefix-cache mode). Acquired
+    # at admission (the matched prefix) and extended when the finished
+    # prefill registers the full prompt; released on EVERY retirement
+    # path — eos, length, deadline, cancel, and drain all funnel through
+    # _release_pins.
+    path: List["kv_blocks.RadixNode"] = field(default_factory=list)
+    # Non-None while the slot is mid-chunked-prefill (device row
+    # INACTIVE: decode dispatches skip it and its chunk tokens are never
+    # booked).
+    prefill: Optional[_Prefill] = None
 
 
 class ServingEngine:
@@ -214,6 +260,12 @@ class ServingEngine:
         decode_chunk: int = 4,
         max_queue: Optional[int] = None,
         max_queue_delay_s: Optional[float] = None,
+        prefill_mode: str = "exact",
+        prefix_cache: bool = False,
+        block_size: int = 16,
+        kv_pool_blocks: Optional[int] = None,
+        kv_hbm_budget_mb: Optional[float] = None,
+        admit_cache_cap: int = 64,
     ):
         self.cfg = cfg
         self.params = params
@@ -225,6 +277,54 @@ class ServingEngine:
         # trusting-harness default) and optionally shed on queue wait.
         self.max_queue = max_queue
         self.max_queue_delay_s = max_queue_delay_s
+        # Prefill strategy. "exact" compiles one prefill per distinct
+        # prompt length (lowest per-admission work once warm; memo
+        # LRU-bounded by admit_cache_cap). "bucketed" decomposes every
+        # prefill into block_size-token chunks on the absolute block
+        # grid, the tail padded to a power-of-two bucket — O(log
+        # block_size) compiles TOTAL, chunks interleaved with decode
+        # steps, and the layout prefix caching requires: a cached run
+        # and a cold run execute the identical compiled computation on
+        # identical bytes, so greedy outputs agree bit-for-bit by
+        # construction.
+        if prefill_mode not in ("exact", "bucketed"):
+            raise ValueError(
+                f"prefill_mode must be 'exact' or 'bucketed' "
+                f"(got {prefill_mode!r})"
+            )
+        if prefix_cache and prefill_mode != "bucketed":
+            raise ValueError(
+                "prefix_cache requires prefill_mode='bucketed' (exact-"
+                "length prefill does not land on the block grid)"
+            )
+        if block_size < 1 or (block_size & (block_size - 1)) != 0:
+            raise ValueError(
+                f"block_size must be a power of two >= 1 "
+                f"(got {block_size})"
+            )
+        if prefill_mode == "bucketed" and block_size > self.max_seq:
+            # Exact mode never touches the block grid, so a default
+            # block_size larger than a small max_seq must not reject it.
+            raise ValueError(
+                f"block_size {block_size} exceeds max_seq {self.max_seq}"
+            )
+        self.prefill_mode = prefill_mode
+        self.block_size = int(block_size)
+        self.admit_cache_cap = max(1, int(admit_cache_cap))
+        self._max_blocks = self.max_seq // self.block_size
+        self._prefix_store: Optional[kv_blocks.PrefixStore] = None
+        if prefix_cache:
+            if kv_pool_blocks is None:
+                if kv_hbm_budget_mb is not None:
+                    kv_pool_blocks = kv_blocks.blocks_for_budget(
+                        cfg, self.block_size,
+                        int(kv_hbm_budget_mb * (1 << 20)))
+                else:
+                    # Default pool: one full context per slot — enough
+                    # to cache every live prompt plus a retired tail.
+                    kv_pool_blocks = n_slots * self._max_blocks
+            self._prefix_store = kv_blocks.PrefixStore(
+                cfg, self.block_size, int(kv_pool_blocks))
         self._rng = rng if rng is not None else jax.random.key(0)
         self._clock = clock
         self._step_idx = 0
@@ -295,7 +395,22 @@ class ServingEngine:
         # the KV pool in place instead of copying it every step (~30%
         # off the per-step dispatch on CPU tiny config).
         self._step_fn = jax.jit(_step, donate_argnums=(1, 2, 5))
-        self._admits: Dict[int, Callable] = {}
+        # Exact-mode per-length admission memo, LRU-bounded (satellite of
+        # the compile-explosion fix: even the fallback path cannot grow
+        # without limit).
+        self._admits: "OrderedDict[int, Callable]" = OrderedDict()
+        # Bucketed-mode per-width chunk memo: widths are {block_size} u
+        # {powers of two < block_size}, so this holds O(log block_size)
+        # entries for the engine's lifetime — no cap needed.
+        self._chunks: Dict[int, Callable] = {}
+        # Cumulative prefill compiles since engine construction (exact
+        # lengths + bucket widths); survives reset() because the
+        # compiled functions do too.
+        self._prefill_compiles = 0
+        # One compiled pool->slot page copy (ids padded to the row's
+        # full page capacity, so ONE shape forever).
+        self._copy_fn = jax.jit(
+            gen.copy_blocks_into_slot, donate_argnums=(0,))
 
     def reset(self) -> None:
         """Drop all queued/in-flight state and zero the pool, KEEPING the
@@ -315,6 +430,33 @@ class ServingEngine:
         self._rids = set()
         self._done_buf = []
         self._draining = False
+        if self._prefix_store is not None:
+            self._prefix_store.clear()
+
+    def register_prefix(self, tokens, cache, row: int = 0) -> int:
+        """Seed the prefix trie from an EXTERNAL KV cache — the
+        multi-turn path. A ``generate_from_cache(..., return_state=True)``
+        session's accumulated KV (prompt + generated turns) registers
+        here so turn N+1's engine admission reuses turn N's blocks
+        instead of re-prefilling the whole conversation.
+
+        ``tokens`` are the token ids the cache rows actually hold (in
+        order from position 0); ``cache`` is any ``[L, B, S, KVH, D]``
+        k/v pair container (:class:`~generate.KVCache` or
+        :class:`~generate.SlotKVCache`), ``row`` the batch row to
+        snapshot. Only full ``block_size`` blocks register. Returns the
+        number of tokens now cached for this prefix (0 when the engine
+        has no prefix store)."""
+        if self._prefix_store is None:
+            return 0
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = int(tokens.size)
+        if n > cache.k.shape[2]:
+            raise ValueError(
+                f"{n} tokens exceed cache capacity {cache.k.shape[2]}")
+        path = self._prefix_store.insert_from_row(
+            tokens, cache.k, cache.v, row)
+        return len(path) * self.block_size
 
     # -- request intake --------------------------------------------------
 
@@ -383,14 +525,27 @@ class ServingEngine:
         self.stats.record(comp)
         self._done_buf.append(comp)
 
+    def _release_pins(self, slot: _Slot) -> None:
+        """Drop the slot's radix-trie pins (prefix-cache mode). Called
+        on EVERY retirement path — natural (eos/length) and policy
+        (deadline/cancel/drain) — so a block's refcount hits zero
+        exactly once per tenancy no matter how the request ends."""
+        if self._prefix_store is not None and slot.path:
+            self._prefix_store.release(slot.path)
+            slot.path = []
+
     def _retire_slot(self, i: int, slot: _Slot, reason: str,
                      now: float) -> Completion:
         """Host-side policy retirement of an in-flight slot: emit the
-        partial completion, free the slot, and clear the device row's
-        ``active`` bit so the next dispatch stops advancing it. The
-        pending chunk's tokens for this row are dropped by the
-        snapshot-identity check in _process_pending — row-local, so
-        neighbors' greedy streams are untouched."""
+        partial completion, free the slot, release its prefix-cache
+        pins, and clear the device row's ``active`` bit so the next
+        dispatch stops advancing it. The pending chunk's tokens for this
+        row are dropped by the snapshot-identity check in
+        _process_pending — row-local, so neighbors' greedy streams are
+        untouched. A slot still mid-chunked-prefill retires the same
+        way: its row was never activated, and the next tenant's
+        copy/chunk writes land at absolute positions."""
+        self._release_pins(slot)
         comp = Completion(
             rid=slot.req.rid, tokens=slot.tokens, finish_reason=reason,
             submit_t=slot.submit_t, first_token_t=slot.first_token_t,
@@ -424,25 +579,63 @@ class ServingEngine:
 
     def _admit_fn(self, s: int) -> Callable:
         """Jitted (prefill prompt -> slot, install logits row) for prompt
-        length ``s``."""
+        length ``s``. The memo is LRU-bounded at ``admit_cache_cap``
+        entries: adversarial length diversity evicts the coldest
+        compiled prefill (it recompiles on next use) instead of growing
+        host memory without limit."""
         fn = self._admits.get(s)
-        if fn is None:
-            cfg = self.cfg
+        if fn is not None:
+            self._admits.move_to_end(s)
+            return fn
+        cfg = self.cfg
 
-            def admit(params, prompt, cache, logits_buf, eos, budget,
-                      emitted, slot, eos_val, budget_val):
-                row_logits, cache = gen.prefill_into_slot(
-                    cfg, params, prompt, cache, slot)
-                logits_buf = jax.lax.dynamic_update_slice(
-                    logits_buf, row_logits.astype(logits_buf.dtype),
-                    (slot, 0))
-                eos = eos.at[slot].set(eos_val)
-                budget = budget.at[slot].set(budget_val)
-                emitted = emitted.at[slot].set(0)
-                return cache, logits_buf, eos, budget, emitted
+        def admit(params, prompt, cache, logits_buf, eos, budget,
+                  emitted, slot, eos_val, budget_val):
+            row_logits, cache = gen.prefill_into_slot(
+                cfg, params, prompt, cache, slot)
+            logits_buf = jax.lax.dynamic_update_slice(
+                logits_buf, row_logits.astype(logits_buf.dtype),
+                (slot, 0))
+            eos = eos.at[slot].set(eos_val)
+            budget = budget.at[slot].set(budget_val)
+            emitted = emitted.at[slot].set(0)
+            return cache, logits_buf, eos, budget, emitted
 
-            fn = self._admits[s] = jax.jit(
-                admit, donate_argnums=(2, 3, 4, 5, 6))
+        fn = self._admits[s] = jax.jit(
+            admit, donate_argnums=(2, 3, 4, 5, 6))
+        self._prefill_compiles += 1
+        while len(self._admits) > self.admit_cache_cap:
+            self._admits.popitem(last=False)
+        return fn
+
+    def _chunk_fn(self, w: int) -> Callable:
+        """Jitted (one prefill chunk -> slot row) for padded chunk width
+        ``w`` — a power of two <= block_size, so the whole memo holds
+        O(log block_size) entries ever. Installs the chunk's logits row
+        and the slot's retirement rule; ``activate`` flips the row live
+        on the final chunk only."""
+        fn = self._chunks.get(w)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+
+        def chunk(params, toks, cache, logits_buf, eos, budget, emitted,
+                  slot, offset, n_real, eos_val, budget_val, activate):
+            row_logits, cache = gen.prefill_chunk_into_slot(
+                cfg, params, toks, cache, slot, offset, n_real)
+            logits_buf = jax.lax.dynamic_update_slice(
+                logits_buf, row_logits.astype(logits_buf.dtype),
+                (slot, 0))
+            eos = eos.at[slot].set(eos_val)
+            budget = budget.at[slot].set(budget_val)
+            emitted = emitted.at[slot].set(0)
+            cache = cache._replace(
+                active=cache.active.at[slot].set(activate))
+            return cache, logits_buf, eos, budget, emitted
+
+        fn = self._chunks[w] = jax.jit(
+            chunk, donate_argnums=(2, 3, 4, 5, 6))
+        self._prefill_compiles += 1
         return fn
 
     def _shed_queued(self) -> None:
@@ -472,9 +665,15 @@ class ServingEngine:
         self.queue = keep
 
     def _admit_waiting(self) -> None:
-        """Fill every free slot from the queue (prefill-on-admit). The
-        other slots' cache rows are untouched — they resume decoding in
-        the same step."""
+        """Fill every free slot from the queue. The other slots' cache
+        rows are untouched — they resume decoding in the same step.
+
+        ``exact`` mode prefills the whole prompt on admit (one compiled
+        fn per length). ``bucketed`` mode walks the prefix trie,
+        device-copies the longest cached prefix's pool pages into the
+        row, and leaves a :class:`_Prefill` cursor at the match point —
+        :meth:`_advance_prefills` runs the uncached suffix one chunk per
+        step, interleaved with decode."""
         self._shed_queued()
         while self.queue:
             try:
@@ -483,23 +682,107 @@ class ServingEngine:
                 return                      # pool full
             q = self.queue.popleft()
             req = q.req
-            admit = self._admit_fn(req.prompt.size)
-            (self.cache, self.logits, self.eos, self.budget,
-             self.emitted) = admit(
-                self.params, jnp.asarray(req.prompt[None]), self.cache,
-                self.logits, self.eos, self.budget, self.emitted,
-                jnp.asarray(slot, jnp.int32),
-                jnp.asarray(
-                    -1 if req.eos_id is None else req.eos_id, jnp.int32),
-                jnp.asarray(req.max_new_tokens, jnp.int32),
-            )
             now = self._clock()
-            self.slots[slot] = _Slot(
-                req=req, submit_t=q.submit_t, admit_t=now,
-                deadline_t=q.deadline_t,
-            )
+            if self.prefill_mode == "exact":
+                admit = self._admit_fn(req.prompt.size)
+                (self.cache, self.logits, self.eos, self.budget,
+                 self.emitted) = admit(
+                    self.params, jnp.asarray(req.prompt[None]),
+                    self.cache, self.logits, self.eos, self.budget,
+                    self.emitted,
+                    jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(
+                        -1 if req.eos_id is None else req.eos_id,
+                        jnp.int32),
+                    jnp.asarray(req.max_new_tokens, jnp.int32),
+                )
+                self.slots[slot] = _Slot(
+                    req=req, submit_t=q.submit_t, admit_t=now,
+                    deadline_t=q.deadline_t,
+                )
+            else:
+                path: List[kv_blocks.RadixNode] = []
+                matched = 0
+                if self._prefix_store is not None:
+                    path, matched = (
+                        self._prefix_store.match_for_admission(
+                            req.prompt))
+                    self.stats.prefix_lookup_tokens += req.prompt.size
+                    self.stats.prefix_hit_tokens += matched
+                    if matched:
+                        ids = np.zeros((self._max_blocks,), np.int32)
+                        ids[:len(path)] = [n.block for n in path]
+                        self.cache = self._copy_fn(
+                            self.cache, self._prefix_store.k,
+                            self._prefix_store.v, jnp.asarray(ids),
+                            jnp.asarray(matched, jnp.int32),
+                            jnp.asarray(slot, jnp.int32),
+                        )
+                self.slots[slot] = _Slot(
+                    req=req, submit_t=q.submit_t, admit_t=now,
+                    deadline_t=q.deadline_t, path=path,
+                    prefill=_Prefill(
+                        tokens=req.prompt, next_off=matched,
+                        eos_val=(-1 if req.eos_id is None
+                                 else req.eos_id),
+                        budget_val=req.max_new_tokens,
+                    ),
+                )
             self.stats.admitted += 1
             self.stats.queue_waits_s.append(now - q.submit_t)
+
+    def _advance_prefills(self) -> None:
+        """Run ONE prefill chunk for every slot mid-admission (Sarathi-
+        style chunked prefill: bounded prefill work per step, so decode
+        TPOT for in-flight slots stays bounded no matter how long a
+        newly-admitted prompt is). Chunks sit on the absolute
+        ``block_size`` grid; the final (possibly partial) chunk pads to
+        a power-of-two bucket, installs the last real position's logits,
+        activates the row, and registers the prompt's full blocks in the
+        prefix trie."""
+        bs = self.block_size
+        for i, slot in enumerate(self.slots):
+            if slot is None or slot.prefill is None:
+                continue
+            p = slot.prefill
+            tokens = p.tokens
+            off = p.next_off
+            w_real = min(bs, tokens.size - off)
+            w = bs
+            if w_real < bs:
+                w = 1
+                while w < w_real:
+                    w *= 2
+            final = off + w_real >= tokens.size
+            buf = np.zeros((1, w), np.int32)
+            buf[0, :w_real] = tokens[off:off + w_real]
+            fn = self._chunk_fn(w)
+            (self.cache, self.logits, self.eos, self.budget,
+             self.emitted) = fn(
+                self.params, jnp.asarray(buf), self.cache, self.logits,
+                self.eos, self.budget, self.emitted,
+                jnp.asarray(i, jnp.int32),
+                jnp.asarray(off, jnp.int32),
+                jnp.asarray(w_real, jnp.int32),
+                jnp.asarray(p.eos_val, jnp.int32),
+                jnp.asarray(p.budget_val, jnp.int32),
+                jnp.asarray(final),
+            )
+            self.stats.prefill_chunks += 1
+            p.next_off = off + w_real
+            if final:
+                if self._prefix_store is not None:
+                    # Register the prompt's full blocks: copy KV for
+                    # blocks the trie didn't already hold out of this
+                    # row, then extend this request's pin to the whole
+                    # chain (released at retirement).
+                    full = self._prefix_store.insert_from_row(
+                        tokens, self.cache.k, self.cache.v, i,
+                        known_path=slot.path)
+                    ext = full[len(slot.path):]
+                    self._prefix_store.trie.acquire(ext)
+                    slot.path = slot.path + ext
+                slot.prefill = None
 
     @property
     def n_active(self) -> int:
@@ -536,8 +819,15 @@ class ServingEngine:
         self._done_buf.clear()
         finished.extend(self._retire_due())
         dispatched = None
-        n_active = self.n_active
-        if n_active > 0:
+        # Only slots past prefill decode; a mid-prefill slot's device
+        # row is inactive, and snapshotting it as None keeps its chunk
+        # garbage out of the books.
+        snapshot: List[Optional[_Slot]] = [
+            s if (s is not None and s.prefill is None) else None
+            for s in self.slots
+        ]
+        n_decoding = sum(s is not None for s in snapshot)
+        if n_decoding > 0:
             if self.temperature <= 0.0:
                 key = None
             else:
@@ -546,12 +836,24 @@ class ServingEngine:
             toks, self.logits, self.cache, self.emitted = self._step_fn(
                 self.params, self.logits, self.cache, self.eos,
                 self.budget, self.emitted, key)
-            dispatched = (toks, list(self.slots), n_active)
+            dispatched = (toks, snapshot, n_decoding)
 
         finished.extend(self._process_pending())
         self._pending = dispatched
         self._admit_waiting()
+        self._advance_prefills()
+        self._sync_stats()
         return finished
+
+    def _sync_stats(self) -> None:
+        """Refresh the gauges ServingStats carries alongside its
+        counters: compile-cache sizes and block-pool occupancy."""
+        self.stats.prefill_compiles = self._prefill_compiles
+        self.stats.admit_cache_size = len(self._admits)
+        if self._prefix_store is not None:
+            self.stats.pool_blocks_total = self._prefix_store.n_blocks
+            self.stats.pool_blocks_in_use = (
+                self._prefix_store.pool.used_blocks)
 
     def _process_pending(self) -> List[Completion]:
         """Book the token chunk of the previous dispatch (if any):
@@ -585,6 +887,21 @@ class ServingEngine:
                 self.stats.active_slot_steps += 1
                 done_eos = req.eos_id is not None and tok == req.eos_id
                 if done_eos or len(slot.tokens) >= req.max_new_tokens:
+                    if self._prefix_store is not None:
+                        # RadixAttention semantics: the finished row's
+                        # DECODED tokens join the trie too (their KV is
+                        # already in the row — every emitted token was
+                        # fed through decode before the row went
+                        # inactive), so a follow-up turn whose prompt
+                        # extends this conversation reuses reply blocks,
+                        # not just prompt blocks.
+                        full = np.concatenate([
+                            req.prompt,
+                            np.asarray(slot.tokens, np.int32)])
+                        self._prefix_store.insert_from_row(
+                            full, self.cache.k, self.cache.v, i,
+                            known_path=slot.path)
+                    self._release_pins(slot)
                     finished.append(Completion(
                         rid=req.rid, tokens=slot.tokens,
                         finish_reason="eos" if done_eos else "length",
@@ -659,9 +976,12 @@ class ServingEngine:
         if not max_steps:
             # Every processed step emits >= 1 token while anything is
             # active; budget total + admission/pipeline lag (~2 steps
-            # per request) bounds the drain.
+            # per request) + chunked-prefill steps (one block per step
+            # in bucketed mode) bounds the drain.
             max_steps = sum(
-                r.max_new_tokens for r in requests
+                r.max_new_tokens
+                + -(-int(np.asarray(r.prompt).size) // self.block_size)
+                for r in requests
             ) + 2 * len(requests) + 4
         out: List[Completion] = []
         for _ in range(max_steps):

@@ -19,6 +19,7 @@ from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from kubeflow_controller_tpu.models import transformer as tfm
@@ -532,6 +533,222 @@ def prefill_into_slot(
         k=k, v=v,
         length=cache.length.at[slot].set(prompt.shape[1]),
         active=cache.active.at[slot].set(True),
+    )
+
+
+def init_block_pool(
+    cfg: TransformerConfig, n_blocks: int, block_size: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Shared KV page pool for prefix caching (dataplane/kv_blocks.py):
+    ``n_blocks`` pages of ``block_size`` tokens each, all layers in one
+    array so a whole page moves in one gather/scatter."""
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads,
+             cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def copy_blocks_into_slot(
+    cache: SlotKVCache,
+    pool_k: jax.Array,          # [L, n_blocks, bs, KVH, D]
+    pool_v: jax.Array,
+    block_ids: jax.Array,       # [max_blocks] int32 — PADDED to capacity
+    n_tokens: jax.Array,        # [] int32 — real cached-prefix length
+    slot: jax.Array,            # [] int32
+) -> SlotKVCache:
+    """Install a cached prefix: gather ``block_ids``' pages and write
+    them contiguously from column 0 of slot ``slot``'s row.
+
+    ``block_ids`` is padded to the slot's full page capacity (pad value:
+    any valid id) so the copy compiles ONCE — the pad pages land as
+    garbage beyond ``n_tokens``, unreachable by the row's
+    ``arange <= pos`` mask and overwritten in order by the suffix
+    prefill/decode, the same discipline stale-tenant KV already obeys.
+    ``length[slot] = n_tokens``; the slot stays INACTIVE — it is
+    mid-admission until the suffix prefill completes.
+    """
+    L, _, bs, kvh, d = pool_k.shape
+    mb = block_ids.shape[0]
+    span = mb * bs
+    if span > cache.k.shape[2]:
+        raise ValueError(
+            f"{mb} pages x {bs} tokens exceeds slot capacity "
+            f"{cache.k.shape[2]}"
+        )
+    pk = pool_k[:, block_ids].reshape(L, 1, span, kvh, d)
+    pv = pool_v[:, block_ids].reshape(L, 1, span, kvh, d)
+    k = lax.dynamic_update_slice(
+        cache.k, pk.astype(cache.k.dtype), (0, slot, 0, 0, 0))
+    v = lax.dynamic_update_slice(
+        cache.v, pv.astype(cache.v.dtype), (0, slot, 0, 0, 0))
+    return SlotKVCache(
+        k=k, v=v,
+        length=cache.length.at[slot].set(n_tokens),
+        active=cache.active.at[slot].set(False),
+    )
+
+
+@jax.jit
+def _copy_row_into_blocks(pool_k, pool_v, cache_k, cache_v, row, ids,
+                          starts, cols):
+    rk = cache_k[:, row]                         # [L, S, KVH, D]
+    rv = cache_v[:, row]
+    bk = rk[:, cols]                             # [L, m, bs, KVH, D]
+    bv = rv[:, cols]
+    pool_k = pool_k.at[:, ids].set(bk.astype(pool_k.dtype), mode="drop")
+    pool_v = pool_v.at[:, ids].set(bv.astype(pool_v.dtype), mode="drop")
+    return pool_k, pool_v
+
+
+def copy_row_into_blocks(
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    cache_k: jax.Array,         # [L, B, S, KVH, D] — slot cache OR KVCache
+    cache_v: jax.Array,
+    row: int,
+    ids,                        # page ids, one per new block
+    starts,                     # token offset of each block in the row
+    block_size: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Snapshot full blocks OUT of a cache row into pool pages (the
+    insert path after a prefill completes, or external registration of a
+    ``generate_from_cache`` session). The id/start lists are padded to
+    the next power of two with an out-of-range page id, which the
+    ``mode="drop"`` scatter discards — compile count stays O(log) in the
+    number of pages per insert, not linear."""
+    m = 1
+    while m < len(ids):
+        m *= 2
+    sentinel = pool_k.shape[1]                   # OOB -> dropped
+    ids_arr = np.full((m,), sentinel, np.int32)
+    ids_arr[:len(ids)] = ids
+    starts_arr = np.zeros((m,), np.int32)
+    starts_arr[:len(starts)] = starts
+    cols = (starts_arr[:, None]
+            + np.arange(block_size, dtype=np.int32)[None, :])
+    return _copy_row_into_blocks(
+        pool_k, pool_v, cache_k, cache_v, jnp.asarray(row, jnp.int32),
+        jnp.asarray(ids_arr), jnp.asarray(starts_arr), jnp.asarray(cols),
+    )
+
+
+def prefill_chunk_into_slot(
+    cfg: TransformerConfig,
+    params: Params,
+    toks: jax.Array,            # [1, W] int32 — chunk, PADDED to W
+    cache: SlotKVCache,
+    slot: jax.Array,            # [] int32
+    offset: jax.Array,          # [] int32 — absolute start position
+    n_real: jax.Array,          # [] int32 — real (un-padded) chunk length
+) -> Tuple[jax.Array, SlotKVCache]:
+    """Chunked prefill-from-offset: run ONE chunk of a prompt through a
+    block forward against slot ``slot``'s existing row.
+
+    Positions ``offset .. offset+W-1`` attend to the row's cached
+    columns ``< offset`` (a cached-prefix copy, or this prompt's earlier
+    chunks) plus intra-chunk causal — ``prefill_continue``'s math on a
+    single slot of a :class:`SlotKVCache`. Returns logits at the LAST
+    REAL position (``offset + n_real - 1``) and the cache with the
+    chunk's k/v scattered at columns ``offset + [0, W)`` (``mode="drop"``
+    past capacity) and ``length[slot] = offset + n_real``.
+
+    The chunk is padded to a power-of-two bucket W, so admission
+    compiles O(log block_size) variants TOTAL instead of one per prompt
+    length. Pad tokens sit at positions past every real token: causal
+    masking keeps real queries from ever attending to them, their k/v
+    land beyond ``length`` (decode overwrites them in order), and the
+    returned logits are dynamically sliced at the real tail — the pad
+    never changes a bit of observable output. Because chunk boundaries
+    are ABSOLUTE (multiples of the engine's block size), a prompt
+    prefilled in chunks executes the identical compiled computation on
+    identical bytes whether its prefix came from the block pool or from
+    its own earlier chunks — greedy bit-exactness of prefix caching
+    holds by construction, not by numeric luck.
+    """
+    if toks.shape[0] != 1:
+        raise ValueError(
+            f"prefill_chunk_into_slot admits one request (got batch "
+            f"{toks.shape[0]})"
+        )
+    b, w = toks.shape
+    dt = cfg.dtype
+    hd = cfg.head_dim
+    max_seq = cache.k.shape[2]
+    rep = cfg.n_heads // cfg.n_kv_heads
+    kc_row = cache.k[:, slot]                    # [L, max_seq, KVH, D]
+    vc_row = cache.v[:, slot]
+
+    x = params["embed"].astype(dt)[toks]         # [1, W, D]
+    positions = offset + jnp.broadcast_to(
+        jnp.arange(w, dtype=jnp.int32), (b, w))
+    if cfg.moe_experts:
+        moe_cfg = cfg.replace(
+            moe_capacity_factor=float(cfg.moe_experts) / cfg.moe_top_k
+        )
+    cache_cols = jnp.arange(max_seq, dtype=jnp.int32)
+    causal = (
+        jnp.arange(w, dtype=jnp.int32)[:, None]
+        >= jnp.arange(w, dtype=jnp.int32)[None, :]
+    )                                            # [W, W]
+
+    def body(x, layer_in):
+        lp, kc, vc = layer_in                    # kc [max_seq, KVH, D]
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ _w(lp, "wq", dt)).reshape(b, w, cfg.n_heads, hd)
+        k = (h @ _w(lp, "wk", dt)).reshape(b, w, cfg.n_kv_heads, hd)
+        v = (h @ _w(lp, "wv", dt)).reshape(b, w, cfg.n_kv_heads, hd)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        qg = q.reshape(b, w, cfg.n_kv_heads, rep, hd)
+        scale = hd ** -0.5
+        s_cache = jnp.einsum(
+            "bqgrd,kgd->bgrqk", qg, kc,
+            preferred_element_type=jnp.float32,
+        ) * scale                                # [1,G,rep,W,max_seq]
+        s_cache = jnp.where(
+            (cache_cols < offset)[None, None, None, None, :],
+            s_cache, -1e30,
+        )
+        s_new = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, k,
+            preferred_element_type=jnp.float32,
+        ) * scale                                # [1,G,rep,W,W]
+        s_new = jnp.where(causal[None, None, None], s_new, -1e30)
+        p = jax.nn.softmax(
+            jnp.concatenate([s_cache, s_new], axis=-1), axis=-1
+        ).astype(dt)
+        attn = (
+            jnp.einsum("bgrqk,kgd->bqgrd", p[..., :max_seq], vc)
+            + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., max_seq:], v)
+        ).reshape(b, w, -1)
+        x = x + attn @ _w(lp, "wo", dt)
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        if cfg.moe_experts:
+            down, _aux = tfm._moe_ffn(moe_cfg, _dense_lp(lp, dt), h2)
+            x = x + down
+        else:
+            gate = jax.nn.silu(h2 @ _w(lp, "w_gate", dt))
+            up = h2 @ _w(lp, "w_up", dt)
+            x = x + (gate * up) @ _w(lp, "w_down", dt)
+        return x, (k[0], v[0])                   # [W, KVH, D]
+
+    x, (k_new, v_new) = lax.scan(
+        body, x, (params["layers"], kc_row, vc_row))
+    # Scatter the chunk's k/v at absolute columns offset + [0, W);
+    # "drop" discards pad columns past capacity instead of clamping
+    # them onto live ones.
+    wcols = offset + jnp.arange(w, dtype=jnp.int32)
+    k = cache.k.at[:, slot, wcols].set(
+        k_new.astype(cache.k.dtype), mode="drop")
+    v = cache.v.at[:, slot, wcols].set(
+        v_new.astype(cache.v.dtype), mode="drop")
+    x_last = lax.dynamic_slice(
+        x, (0, n_real - 1, 0), (1, 1, x.shape[-1]))[:, 0]
+    logits = _head_logits(
+        cfg, params, rmsnorm(x_last, params["final_norm"], cfg.norm_eps))
+    return logits, SlotKVCache(
+        k=k, v=v,
+        length=cache.length.at[slot].set(offset + n_real),
+        active=cache.active,
     )
 
 

@@ -1,0 +1,383 @@
+"""Block-pool / radix prefix-cache invariants.
+
+Two layers, matching the design split in ``dataplane/kv_blocks.py``:
+
+1. **Host allocator + trie properties** (no device work): pages are
+   never aliased across live chains, refcounts hit zero exactly once
+   per tenancy (double-free raises), eviction only reclaims unpinned
+   leaves in LRU order, and a randomized op soup preserves the
+   refcount-accounting invariant ``pool.refcount(block) == 1 +
+   request pins`` for every live node.
+
+2. **Engine integration**: with the prefix cache ON, greedy outputs are
+   BIT-IDENTICAL to the cache-off bucketed engine under slot churn and
+   under pool-eviction pressure (the copy-into-slot design makes this
+   hold by construction — these tests are the tripwire); every
+   retirement path (eos, length, cancel, deadline, drain) releases its
+   block pins; the multi-turn ``register_prefix`` path makes turn N+1
+   reuse turn N's session KV; and the exact-mode admit memo stays
+   LRU-bounded.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_controller_tpu.dataplane.kv_blocks import (
+    BlockPool, PrefixStore, RadixCache,
+)
+from kubeflow_controller_tpu.dataplane.serving_engine import (
+    Request, ServingEngine,
+)
+from kubeflow_controller_tpu.models import generate as gen
+from kubeflow_controller_tpu.models import transformer as tfm
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tfm.tiny_config()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return gen.inference_params(cfg, tfm.init_params(cfg, jax.random.key(0)))
+
+
+# -- BlockPool ------------------------------------------------------------
+
+
+def test_pool_alloc_unique_until_exhausted():
+    pool = BlockPool(8)
+    ids = [pool.alloc() for _ in range(8)]
+    assert sorted(ids) == list(range(8))      # every page exactly once
+    assert pool.alloc() is None               # exhausted, not an error
+    assert pool.free_blocks == 0 and pool.used_blocks == 8
+    pool.unref(ids[3])
+    assert pool.free_blocks == 1
+    assert pool.alloc() == ids[3]             # LIFO reuse
+
+
+def test_pool_refcount_zero_exactly_once():
+    pool = BlockPool(2)
+    bid = pool.alloc()
+    pool.ref(bid)                             # 2 holders
+    pool.unref(bid)
+    assert pool.refcount(bid) == 1
+    pool.unref(bid)                           # last holder frees
+    assert pool.refcount(bid) == 0
+    with pytest.raises(RuntimeError):
+        pool.unref(bid)                       # double free is loud
+    with pytest.raises(RuntimeError):
+        pool.ref(bid)                         # resurrecting a dead page too
+
+
+# -- RadixCache -----------------------------------------------------------
+
+
+def _toks(seq):
+    return np.asarray(seq, np.int32)
+
+
+def test_trie_match_is_block_granular():
+    trie = RadixCache(BlockPool(16), block_size=4)
+    path, new = trie.insert(_toks(range(10)))   # blocks [0:4), [4:8)
+    assert len(path) == 2 and len(new) == 2     # tail [8:10) is partial
+    assert len(trie.match(_toks(range(10)))) == 2
+    assert len(trie.match(_toks(range(4)))) == 1
+    assert len(trie.match(_toks(range(3)))) == 0          # < one block
+    assert len(trie.match(_toks([9, 9, 9, 9]))) == 0      # miss
+
+
+def test_trie_shared_prefix_shares_nodes_not_tails():
+    trie = RadixCache(BlockPool(16), block_size=4)
+    a = list(range(8)) + [50, 51, 52, 53]
+    b = list(range(8)) + [60, 61, 62, 63]
+    pa, _ = trie.insert(_toks(a))
+    pb, _ = trie.insert(_toks(b))
+    assert pa[0] is pb[0] and pa[1] is pb[1]    # shared prefix: same nodes
+    assert pa[2] is not pb[2]
+    assert pa[2].block != pb[2].block           # divergent tails: no alias
+    assert trie.n_nodes() == 4
+
+
+def test_trie_eviction_lru_and_pinned_survive():
+    pool = BlockPool(3)
+    trie = RadixCache(pool, block_size=2)
+    pa, _ = trie.insert(_toks([1, 1]))
+    pb, _ = trie.insert(_toks([2, 2]))
+    pc, _ = trie.insert(_toks([3, 3]))
+    assert pool.free_blocks == 0
+    trie.acquire(pb)                            # pin b
+    trie.match(_toks([1, 1]))                   # a is now most recent
+    pd, _ = trie.insert(_toks([4, 4]))          # must evict c (LRU unpinned)
+    assert len(pd) == 1
+    assert len(trie.match(_toks([3, 3]))) == 0  # c gone
+    assert len(trie.match(_toks([2, 2]))) == 1  # pinned b survived
+    assert len(trie.match(_toks([1, 1]))) == 1  # recent a survived
+    trie.release(pb)
+
+
+def test_trie_interior_nodes_not_evicted_before_children():
+    pool = BlockPool(2)
+    trie = RadixCache(pool, block_size=2)
+    path, _ = trie.insert(_toks([1, 1, 2, 2]))  # chain of 2 nodes
+    assert pool.free_blocks == 0
+    # Only the leaf is evictable; two evictions drain the chain from the
+    # tail, never orphaning a child whose context block vanished.
+    assert trie.evict_one() == path[1].block
+    assert trie.evict_one() == path[0].block
+    assert trie.evict_one() is None
+
+
+def test_trie_release_unpinned_raises():
+    trie = RadixCache(BlockPool(4), block_size=2)
+    path, _ = trie.insert(_toks([1, 1]))
+    trie.acquire(path)
+    trie.release(path)
+    with pytest.raises(RuntimeError):
+        trie.release(path)
+
+
+def test_trie_random_ops_preserve_refcount_invariant():
+    """Property-style soup: random inserts, acquires, releases, and
+    evictions. After every op, each live node's pool refcount must be
+    exactly 1 (trie hold) + its request pins, and no two live nodes may
+    share a page."""
+    rng = np.random.default_rng(0)
+    pool = BlockPool(12)
+    trie = RadixCache(pool, block_size=2)
+    held = []                                   # acquired paths
+    for _ in range(300):
+        op = rng.integers(0, 4)
+        if op == 0:
+            toks = rng.integers(0, 4, size=rng.integers(2, 9))
+            path, _ = trie.insert(_toks(toks))
+            if path and rng.integers(0, 2):
+                trie.acquire(path)
+                held.append(path)
+        elif op == 1 and held:
+            trie.release(held.pop(rng.integers(0, len(held))))
+        elif op == 2:
+            trie.evict_one()
+        else:
+            toks = rng.integers(0, 4, size=rng.integers(2, 9))
+            trie.match(_toks(toks))
+        # Invariant sweep.
+        seen_pages = set()
+        stack = list(trie.root.children.values())
+        n_live = 0
+        while stack:
+            n = stack.pop()
+            n_live += 1
+            assert n.block not in seen_pages, "page aliased across nodes"
+            seen_pages.add(n.block)
+            assert pool.refcount(n.block) == 1 + n.refs
+            stack.extend(n.children.values())
+        assert pool.used_blocks == n_live
+    for path in held:
+        trie.release(path)
+
+
+# -- engine integration ---------------------------------------------------
+
+
+def _shared_prefix_requests(cfg, n, shared_len=12, tail_max=5, seed=3,
+                            max_new=5):
+    """The production shape: one system prompt, per-request tails."""
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(0, cfg.vocab_size, shared_len)
+    out = []
+    for i in range(n):
+        tail = rng.integers(0, cfg.vocab_size, 1 + i % tail_max)
+        out.append(Request(
+            rid=i,
+            prompt=np.concatenate([shared, tail]).astype(np.int32),
+            max_new_tokens=max_new + i % 3,
+        ))
+    return out
+
+
+def _run(cfg, params, reqs, **kw):
+    eng = ServingEngine(cfg, params, **kw)
+    comps = eng.run(list(reqs))
+    return {c.rid: list(c.tokens) for c in comps}, eng
+
+
+def test_bucketed_engine_matches_per_sequence_generate(cfg, params):
+    """Chunked/bucketed prefill is a different compiled computation than
+    exact-length prefill — pin (empirically, on this backend) that its
+    greedy streams still agree with per-sequence gen.generate."""
+    max_seq = 32
+    reqs = _shared_prefix_requests(cfg, 6)
+    got, _ = _run(cfg, params, reqs, n_slots=3, max_seq=max_seq,
+                  prefill_mode="bucketed", block_size=4)
+    for r in reqs:
+        ref = gen.generate(cfg, params, jnp.asarray(r.prompt[None]),
+                           r.max_new_tokens, max_seq=max_seq)
+        assert got[r.rid] == [int(t) for t in np.asarray(ref)[0]], (
+            f"rid {r.rid} diverged from per-sequence generate")
+
+
+def test_prefix_cache_bit_exact_under_churn(cfg, params):
+    """THE acceptance invariant: cache-on greedy streams are bitwise
+    identical to cache-off through slot churn (8 requests, 3 slots),
+    and the cache actually hit."""
+    kw = dict(n_slots=3, max_seq=32, prefill_mode="bucketed",
+              block_size=4)
+    reqs = _shared_prefix_requests(cfg, 8)
+    off, _ = _run(cfg, params, reqs, **kw)
+    on, eng = _run(cfg, params, reqs, prefix_cache=True, **kw)
+    assert on == off
+    assert eng.stats.prefix_hit_tokens > 0
+    assert 0.0 < eng.stats.prefix_hit_rate < 1.0
+    assert eng.stats.pool_blocks_in_use > 0
+
+
+def test_prefix_cache_bit_exact_under_eviction_pressure(cfg, params):
+    """A pool far too small for the workload forces constant LRU
+    eviction; outputs must STILL be bit-identical — eviction can only
+    lower the hit rate, never corrupt a stream (pool pages are copied
+    into slots, never aliased by them)."""
+    kw = dict(n_slots=3, max_seq=32, prefill_mode="bucketed",
+              block_size=4)
+    reqs = _shared_prefix_requests(cfg, 8)
+    off, _ = _run(cfg, params, reqs, **kw)
+    on, eng = _run(cfg, params, reqs, prefix_cache=True,
+                   kv_pool_blocks=4, **kw)
+    assert on == off
+    assert eng.stats.pool_blocks_total == 4
+    assert eng.stats.pool_blocks_in_use <= 4
+
+
+def _assert_no_leaked_pins(eng):
+    store = eng._prefix_store
+    stack = list(store.trie.root.children.values())
+    n_live = 0
+    while stack:
+        n = stack.pop()
+        n_live += 1
+        assert n.refs == 0, "request pin leaked past retirement"
+        assert store.pool.refcount(n.block) == 1   # trie's own hold only
+        stack.extend(n.children.values())
+    assert store.pool.used_blocks == n_live
+
+
+def test_cancel_deadline_drain_release_blocks(cfg, params):
+    """Every policy retirement path — queued cancel, in-flight cancel,
+    deadline, drain — must release its trie pins: after the dust
+    settles, no node carries a request pin and every page's refcount is
+    exactly the trie's own hold."""
+    clock_t = [0.0]
+    eng = ServingEngine(cfg, params, n_slots=2, max_seq=40,
+                        prefill_mode="bucketed", block_size=4,
+                        prefix_cache=True, clock=lambda: clock_t[0])
+    reqs = _shared_prefix_requests(cfg, 6, max_new=20)
+    reqs[3].deadline_s = 0.5
+    for r in reqs:
+        eng.submit(r)
+    comps = []
+    for _ in range(6):
+        comps.extend(eng.step())
+    eng.cancel(4)                   # likely in flight
+    eng.cancel(5)                   # likely still queued
+    for _ in range(3):
+        comps.extend(eng.step())
+    clock_t[0] = 1.0                # rid 3's deadline passes
+    comps.extend(eng.step())
+    comps.extend(eng.drain(grace_s=0.0))   # force-retire the rest
+    assert {c.rid for c in comps} == {r.rid for r in reqs}
+    _assert_no_leaked_pins(eng)
+
+
+def test_register_prefix_multiturn_session_reuse(cfg, params):
+    """Satellite: a generate_from_cache(return_state=True) session
+    registers its accumulated KV so the engine's next turn reuses it.
+    Turn 2 = session tokens + follow-up must (a) hit the trie for every
+    full session block and (b) produce the same stream as a cold
+    cache-off engine."""
+    max_seq = 64
+    bs = 4
+    prompt = np.random.default_rng(9).integers(
+        0, cfg.vocab_size, 12).astype(np.int32)
+    # Turn 1 as a standalone session (the serve_lm --turns shape).
+    cache = gen.init_kv_cache(cfg, 1, max_seq)
+    logits, cache = gen.prefill(cfg, params, jnp.asarray(prompt[None]),
+                                cache)
+    toks, logits, cache = gen.generate_from_cache(
+        cfg, params, logits, cache, 8, return_state=True)
+    reply = [int(t) for t in np.asarray(toks)[0]]
+    session = np.concatenate([prompt, np.asarray(reply, np.int32)])
+
+    eng = ServingEngine(cfg, params, n_slots=2, max_seq=max_seq,
+                        prefill_mode="bucketed", block_size=bs,
+                        prefix_cache=True)
+    registered = eng.register_prefix(session, cache, row=0)
+    assert registered == (session.size // bs) * bs
+
+    follow = np.random.default_rng(10).integers(
+        0, cfg.vocab_size, 6).astype(np.int32)
+    turn2 = Request(rid=0, prompt=np.concatenate([session, follow]),
+                    max_new_tokens=6)
+    got = {c.rid: list(c.tokens) for c in eng.run([turn2])}
+    assert eng.stats.prefix_hit_tokens >= registered - bs  # tail rule
+    cold, _ = _run(cfg, params,
+                   [Request(rid=0, prompt=turn2.prompt, max_new_tokens=6)],
+                   n_slots=2, max_seq=max_seq,
+                   prefill_mode="bucketed", block_size=bs)
+    assert got == cold
+
+
+def test_admit_memo_lru_bounded(cfg, params):
+    """Satellite: the exact-mode per-length prefill memo cannot grow
+    past admit_cache_cap, whatever length diversity arrives — and
+    eviction must not corrupt outputs (a recompile is just slower)."""
+    eng = ServingEngine(cfg, params, n_slots=2, max_seq=32,
+                        admit_cache_cap=3)
+    rng = np.random.default_rng(11)
+    reqs = [Request(rid=i, prompt=rng.integers(
+                0, cfg.vocab_size, 3 + i).astype(np.int32),
+                max_new_tokens=3)
+            for i in range(8)]                 # 8 distinct lengths
+    got = {c.rid: list(c.tokens) for c in eng.run(reqs)}
+    assert len(eng._admits) <= 3
+    assert eng.stats.admit_cache_size <= 3
+    assert eng.stats.prefill_compiles == 8    # every length compiled once
+    for r in reqs:
+        ref = gen.generate(cfg, params, jnp.asarray(r.prompt[None]),
+                           r.max_new_tokens, max_seq=32)
+        assert got[r.rid] == [int(t) for t in np.asarray(ref)[0]]
+
+
+@pytest.mark.slow
+def test_prefix_sweep_block_sizes_bit_exact(cfg, params):
+    """Long sweep (kept out of tier-1 by the slow marker): cache-on ==
+    cache-off bitwise across block sizes, slot counts, and a longer
+    shared prefix — the full parameter grid the benchmark samples one
+    point of."""
+    reqs = _shared_prefix_requests(cfg, 10, shared_len=24, tail_max=6)
+    for bs in (2, 4, 8, 16):
+        for n_slots in (2, 4):
+            kw = dict(n_slots=n_slots, max_seq=48,
+                      prefill_mode="bucketed", block_size=bs)
+            off, _ = _run(cfg, params, reqs, **kw)
+            on, eng = _run(cfg, params, reqs, prefix_cache=True, **kw)
+            assert on == off, f"divergence at block_size={bs}, " \
+                              f"n_slots={n_slots}"
+            assert eng.stats.prefix_hit_tokens > 0
+
+
+def test_prefill_compiles_log_bounded_in_bucketed_mode(cfg, params):
+    """Random prompt lengths in [1, 24]: exact mode compiles one prefill
+    per distinct length; bucketed mode is bounded by the bucket count
+    1 + log2(block_size), independent of length diversity."""
+    rng = np.random.default_rng(12)
+    reqs = [Request(rid=i, prompt=rng.integers(
+                0, cfg.vocab_size, int(l)).astype(np.int32),
+                max_new_tokens=2)
+            for i, l in enumerate(rng.choice(
+                np.arange(1, 25), size=12, replace=False))]
+    _, eng = _run(cfg, params, reqs, n_slots=3, max_seq=32,
+                  prefill_mode="bucketed", block_size=8)
+    assert eng.stats.prefill_compiles <= 4    # widths ⊆ {8, 4, 2, 1}
+    assert eng.stats.admit_cache_size == 0    # exact path never used
