@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: all native test test-fast bench bench-cp bench-serve \
-	bench-overload bench-prefix clean stamp
+	bench-overload bench-prefix bench-fleet clean stamp
 
 # Build-stamp analog of the reference's ldflags version injection
 # (/root/reference/Makefile:23-26): export the sha for build_version().
@@ -55,6 +55,16 @@ bench-overload:
 bench-prefix:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/prefix_bench.py \
 		--json benchmarks/prefix_bench_summary.json
+
+# Fleet benchmark: reconciled engine replicas behind the prefix-affinity
+# router, chaos-killed through the controller path mid-stream plus a
+# rolling restart; gates on request conservation, at-most-once delivery,
+# >=0.8 goodput retention, >=1.5x affinity hit-rate, and zero rollout
+# drops — see benchmarks/RESULTS.md and docs/lmservice.md. --smoke keeps
+# it tier-1 sized; drop it for the full sweep.
+bench-fleet:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_bench.py --smoke \
+		--json benchmarks/fleet_bench_summary.json
 
 clean:
 	$(MAKE) -C csrc clean

@@ -1,0 +1,442 @@
+"""Fleet benchmark: prefix-affinity routing + chaos-proof serving.
+
+Extends the open-loop Poisson harness (``overload_bench.py``) from one
+engine to an LMService-shaped replica fleet behind
+:class:`~kubeflow_controller_tpu.dataplane.router.FleetRouter`. Three
+legs, each with a hard acceptance gate:
+
+* **affinity** — the same shared-system-prompt workload through an
+  affinity router and a random-dispatch router over identical replica
+  pools: fleet ``prefix_hit_rate`` must be >= 1.5x the random baseline.
+  Random spreading smears each system prompt's blocks across every
+  replica's trie; affinity converges them, so the cache pays.
+* **chaos** — Poisson arrivals at a fixed fraction of fleet capacity
+  through the FULL stack (LMService -> controller-reconciled pods ->
+  ``sync_fleet_from_pods``), with one replica SIGKILLed per interval
+  (``FakeCluster.crash_pod``; the controller recreates the pod, the
+  sync re-admits a fresh engine). Gates: completions + rejections ==
+  arrivals (nothing silently dropped), at-most-once completion per rid,
+  and deadline-met goodput >= 0.8x the no-chaos run on the SAME
+  arrival schedule.
+* **rollout** — mid-traffic ``rolling_restart`` of every replica
+  (cordon -> drain -> re-dispatch sheds -> replace): ZERO dropped
+  requests — every arrival completes, none rejected, none lost.
+
+Prints one JSON object; ``--json`` also writes it to a file. Run via
+``make bench-fleet`` (smoke config) — full numbers live in
+benchmarks/RESULTS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_fleet_requests(cfg, n: int, n_prompts: int, shared_len: int,
+                        tail_max: int, budgets, seed: int,
+                        deadline_s: Optional[float], rid0: int = 0):
+    """Shared-system-prompt traffic: each request draws one of
+    ``n_prompts`` system prompts plus a short unique tail — the shape
+    prefix caching (and therefore affinity routing) exists for."""
+    import numpy as np
+
+    from kubeflow_controller_tpu.dataplane.serving_engine import Request
+
+    rng = np.random.default_rng(seed)
+    systems = [rng.integers(0, cfg.vocab_size, shared_len)
+               for _ in range(n_prompts)]
+    out = []
+    for i in range(n):
+        sysp = systems[int(rng.integers(0, n_prompts))]
+        tail = rng.integers(0, cfg.vocab_size,
+                            1 + int(rng.integers(0, tail_max)))
+        out.append(Request(
+            rid=rid0 + i,
+            prompt=np.concatenate([sysp, tail]).astype(np.int32),
+            max_new_tokens=int(rng.choice(budgets)),
+            deadline_s=deadline_s,
+        ))
+    return out
+
+
+def poisson_arrivals(rate_rps: float, duration_s: float,
+                     seed: int) -> List[float]:
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    out, t = [], 0.0
+    while True:
+        t += float(rng.exponential(1.0 / rate_rps))
+        if t >= duration_s:
+            break
+        out.append(t)
+    return out
+
+
+class EnginePool:
+    """Warm engine recycler. A fresh ServingEngine pays trace+compile on
+    first use; the fleet replaces engines constantly (chaos kills,
+    rollouts), so the factory hands back a reset() spare — compiled
+    functions survive reset — instead of recompiling mid-benchmark."""
+
+    def __init__(self, mk: Callable[[], object], warm_reqs):
+        self._mk = mk
+        self._warm_reqs = warm_reqs
+        self.engines: List[object] = []
+
+    def _new(self):
+        import copy
+
+        eng = self._mk()
+        eng.run([copy.deepcopy(r) for r in self._warm_reqs])
+        eng.reset()
+        self.engines.append(eng)
+        return eng
+
+    def prewarm(self, n: int) -> None:
+        for _ in range(n):
+            self._new()
+
+    def factory(self, router) -> Callable[[str], object]:
+        def make(name: str):
+            attached = {id(h.engine) for h in router.replicas}
+            for eng in self.engines:
+                if id(eng) not in attached:
+                    eng.reset()
+                    return eng
+            return self._new()
+        return make
+
+
+def drive_open_loop(
+    router, reqs, arrivals,
+    on_tick: Optional[Callable[[float], None]] = None,
+    chaos: Optional[List] = None,          # [(t, fn), ...] sorted
+    max_wall_s: float = 120.0,
+) -> float:
+    """Wall-clock open loop: release arrivals on schedule, fire chaos
+    events on schedule, step the fleet until every request has an
+    outcome. Returns the wall time from first arrival to fleet idle."""
+    i, ci = 0, 0
+    t0 = time.perf_counter()
+    while i < len(reqs) or not router.idle:
+        now = time.perf_counter() - t0
+        if now > max_wall_s:
+            raise RuntimeError(
+                f"fleet did not drain in {max_wall_s}s "
+                f"({router.pending} pending)")
+        while chaos and ci < len(chaos) and now >= chaos[ci][0]:
+            chaos[ci][1]()
+            ci += 1
+        while i < len(arrivals) and arrivals[i] <= now:
+            router.submit(reqs[i])
+            i += 1
+        if on_tick is not None:
+            on_tick(now)
+        if not router.idle:
+            router.step()
+        elif i < len(arrivals):
+            time.sleep(max(0.0, min(arrivals[i] - now, 1e-3)))
+    return time.perf_counter() - t0
+
+
+def goodput_tps(router, deadline_s: float, wall_s: float) -> float:
+    good = 0
+    for c in router.completions:
+        if (c.finish_reason in ("eos", "length")
+                and c.done_t - c.submit_t <= deadline_s):
+            good += len(c.tokens)
+    return good / wall_s if wall_s > 0 else 0.0
+
+
+def assert_conserved(router, arrivals_n: int, leg: str) -> None:
+    counts = router.outcome_counts
+    total = counts["completed"] + counts["rejected"] + counts["cancelled"]
+    assert total == arrivals_n and router.pending == 0, (
+        f"[{leg}] silent drop: {arrivals_n} arrivals, {counts} "
+        f"({router.pending} pending)")
+    rids = [c.rid for c in router.completions]
+    assert len(rids) == len(set(rids)), (
+        f"[{leg}] duplicate completion rid surfaced")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="tiny")
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--slots", type=int, default=2)
+    p.add_argument("--block-size", type=int, default=4)
+    p.add_argument("--n-prompts", type=int, default=4,
+                   help="distinct system prompts in the workload")
+    p.add_argument("--shared-len", type=int, default=16)
+    p.add_argument("--tail-max", type=int, default=4)
+    p.add_argument("--budgets", default="8,12,16")
+    p.add_argument("--affinity-requests", type=int, default=48)
+    p.add_argument("--capacity-requests", type=int, default=24)
+    p.add_argument("--load", type=float, default=0.7,
+                   help="offered load as a fraction of fleet capacity")
+    p.add_argument("--duration-s", type=float, default=4.0)
+    p.add_argument("--kills", type=int, default=1,
+                   help="chaos kills, evenly spaced over the window")
+    p.add_argument("--deadline-factor", type=float, default=6.0)
+    p.add_argument("--max-queue", type=int, default=8)
+    p.add_argument("--grace-s", type=float, default=10.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--smoke", action="store_true",
+                   help="small fast config for CI")
+    p.add_argument("--json", default="", help="also write the summary here")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.affinity_requests = 24
+        args.capacity_requests = 12
+        args.duration_s = 2.0
+
+    import jax
+    import numpy as np
+
+    from kubeflow_controller_tpu.api import types
+    from kubeflow_controller_tpu.api.core import ObjectMeta
+    from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
+    from kubeflow_controller_tpu.dataplane.entrypoints.lm import CONFIGS
+    from kubeflow_controller_tpu.dataplane.router import (
+        FleetRouter, sync_fleet_from_pods,
+    )
+    from kubeflow_controller_tpu.dataplane.serving_engine import ServingEngine
+    from kubeflow_controller_tpu.models import generate as gen
+    from kubeflow_controller_tpu.models import transformer as tfm
+    from kubeflow_controller_tpu.runtime import LocalRuntime
+    from kubeflow_controller_tpu.tpu import naming
+
+    cfg = CONFIGS[args.config]()
+    params = gen.inference_params(
+        cfg, tfm.init_params(cfg, jax.random.key(0)))
+    budgets = [int(x) for x in args.budgets.split(",")]
+    max_seq = args.shared_len + args.tail_max + max(budgets) + args.block_size
+
+    def mk_engine():
+        return ServingEngine(
+            cfg, params, n_slots=args.slots, max_seq=max_seq,
+            prefill_mode="bucketed", block_size=args.block_size,
+            prefix_cache=True, max_queue=args.max_queue,
+        )
+
+    warm = make_fleet_requests(
+        cfg, 3, 1, args.shared_len, args.tail_max, budgets,
+        seed=999, deadline_s=None, rid0=10_000_000)
+    pool = EnginePool(mk_engine, warm)
+    pool.prewarm(args.replicas + 1)
+
+    # -- capacity probe (single engine, closed loop) ----------------------
+    probe = pool.engines[0]
+    cap_reqs = make_fleet_requests(
+        cfg, args.capacity_requests, args.n_prompts, args.shared_len,
+        args.tail_max, budgets, seed=args.seed, deadline_s=None)
+    probe.max_queue = None
+    t0 = time.perf_counter()
+    comps = probe.run(cap_reqs)
+    cap_wall = time.perf_counter() - t0
+    tokens = sum(len(c.tokens) for c in comps)
+    mean_budget = float(np.mean([len(c.tokens) for c in comps]))
+    engine_rps = (tokens / cap_wall) / mean_budget
+    fleet_rps = engine_rps * args.replicas
+    mean_service_s = mean_budget / ((tokens / cap_wall) / args.slots)
+    deadline_s = args.deadline_factor * mean_service_s
+    probe.reset()
+    probe.max_queue = args.max_queue
+
+    # -- leg 1: affinity vs random-dispatch hit rate ----------------------
+    def run_affinity_leg(affinity: bool) -> Dict[str, float]:
+        router = FleetRouter(clock=time.perf_counter,
+                             block_size=args.block_size,
+                             affinity=affinity)
+        factory = pool.factory(router)
+        for r in range(args.replicas):
+            router.add_replica(f"replica-{r}", factory(f"replica-{r}"))
+        reqs = make_fleet_requests(
+            cfg, args.affinity_requests, args.n_prompts,
+            args.shared_len, args.tail_max, budgets, seed=args.seed + 1,
+            deadline_s=None)
+        for h in router.replicas:
+            h.engine.max_queue = None      # closed loop: no shedding
+        for r in reqs:
+            router.submit(r)
+        router.run_until_idle()
+        assert_conserved(router, len(reqs),
+                         "affinity" if affinity else "random")
+        for h in router.replicas:
+            h.engine.max_queue = args.max_queue
+        return {"prefix_hit_rate": router.prefix_hit_rate,
+                "affinity_hits": float(router.affinity_hits)}
+
+    aff = run_affinity_leg(affinity=True)
+    rnd = run_affinity_leg(affinity=False)
+    hit_ratio = (aff["prefix_hit_rate"] / rnd["prefix_hit_rate"]
+                 if rnd["prefix_hit_rate"] > 0 else float("inf"))
+
+    # -- legs 2+3 share the controller-reconciled fleet -------------------
+    ns = "default"
+
+    def fresh_runtime():
+        rt = LocalRuntime(default_policy=PodRunPolicy(
+            start_delay=0.2, run_duration=1e9))
+        svc = types.LMService(
+            metadata=ObjectMeta(name="fleet", namespace=ns),
+            spec=types.LMServiceSpec(
+                model=args.config, replicas=args.replicas,
+                max_queue=args.max_queue,
+                slo=types.SLOSpec(deadline_s=deadline_s)))
+        rt.submit_lmservice(svc)
+        rt.run_until(lambda: (
+            (s := rt.get_lmservice(ns, "fleet")) is not None
+            and s.status.ready_replicas == args.replicas), dt=0.5)
+        return rt
+
+    def pods_of(rt):
+        svc = rt.get_lmservice(ns, "fleet")
+        return rt.client.list_pods(
+            ns, {naming.LABEL_LMSERVICE: svc.metadata.name})
+
+    def run_traffic(chaos_kills: int, seed: int):
+        rt = fresh_runtime()
+        router = FleetRouter(clock=time.perf_counter,
+                             block_size=args.block_size)
+        factory = pool.factory(router)
+        sync_fleet_from_pods(router, pods_of(rt), factory)
+        assert len(router.replicas) == args.replicas
+
+        rate = args.load * fleet_rps
+        arrivals = poisson_arrivals(rate, args.duration_s, seed)
+        reqs = make_fleet_requests(
+            cfg, len(arrivals), args.n_prompts, args.shared_len,
+            args.tail_max, budgets, seed=seed + 1,
+            deadline_s=deadline_s)
+
+        last_sync = [0.0]
+
+        def on_tick(now: float) -> None:
+            # Advance the control plane on the wall cadence: reconcile,
+            # tick sim time (pod restarts ride on it), re-sync engines
+            # onto the current pod set.
+            if now - last_sync[0] < 0.05:
+                return
+            rt.controller.drain()
+            rt.cluster.tick(now - last_sync[0])
+            rt.controller.drain()
+            sync_fleet_from_pods(router, pods_of(rt), factory)
+            last_sync[0] = now
+
+        def kill_one():
+            live = [h.name for h in router.replicas]
+            if not live:
+                return
+            victim = live[0]
+            rt.cluster.crash_pod(ns, victim)
+            # SIGKILL is immediate: reconcile + re-sync right now, so
+            # the router re-dispatches the victim's in-flight work
+            # without waiting for the next tick.
+            rt.controller.drain()
+            sync_fleet_from_pods(router, pods_of(rt), factory)
+
+        chaos = [((k + 1) * args.duration_s / (chaos_kills + 1), kill_one)
+                 for k in range(chaos_kills)]
+        wall = drive_open_loop(router, reqs, arrivals,
+                               on_tick=on_tick, chaos=chaos)
+        assert_conserved(router, len(arrivals),
+                         f"chaos-{chaos_kills}" if chaos_kills else
+                         "baseline")
+        counts = router.outcome_counts
+        rt.stop()
+        return {
+            "arrivals": len(arrivals),
+            "offered_rps": round(rate, 2),
+            "wall_s": round(wall, 3),
+            "goodput_tps": round(goodput_tps(router, deadline_s, wall), 1),
+            "completed": counts["completed"],
+            "rejected": counts["rejected"],
+            "redispatched": router.redispatched,
+            "duplicate_completions": router.duplicate_completions,
+            "prefix_hit_rate": round(router.prefix_hit_rate, 3),
+        }
+
+    baseline = run_traffic(chaos_kills=0, seed=args.seed + 10)
+    chaos_run = run_traffic(chaos_kills=args.kills, seed=args.seed + 10)
+    retention = (chaos_run["goodput_tps"] / baseline["goodput_tps"]
+                 if baseline["goodput_tps"] > 0 else 0.0)
+
+    # -- leg 4: rolling restart, zero drops -------------------------------
+    router = FleetRouter(clock=time.perf_counter,
+                         block_size=args.block_size)
+    factory = pool.factory(router)
+    for r in range(args.replicas):
+        router.add_replica(f"replica-{r}", factory(f"replica-{r}"))
+    rate = 0.5 * fleet_rps
+    arrivals = poisson_arrivals(rate, args.duration_s, args.seed + 20)
+    reqs = make_fleet_requests(
+        cfg, len(arrivals), args.n_prompts, args.shared_len,
+        args.tail_max, budgets, seed=args.seed + 21, deadline_s=None)
+    restart = [(args.duration_s / 2,
+                lambda: router.rolling_restart(factory, args.grace_s))]
+    drive_open_loop(router, reqs, arrivals, chaos=restart)
+    assert_conserved(router, len(arrivals), "rollout")
+    rollout_counts = router.outcome_counts
+    rollout_zero_drop = (
+        rollout_counts["completed"] == len(arrivals)
+        and rollout_counts["rejected"] == 0
+        and all(c.finish_reason in ("eos", "length")
+                for c in router.completions))
+
+    gates = {
+        "hit_ratio_ge_1_5": hit_ratio >= 1.5,
+        "retention_ge_0_8": retention >= 0.8,
+        "chaos_conserved": True,     # assert_conserved already enforced
+        "at_most_once": chaos_run["duplicate_completions"] == 0,
+        "rollout_zero_drop": rollout_zero_drop,
+    }
+    out = {
+        "metric": "fleet_chaos_goodput_retention",
+        "value": round(retention, 3),
+        "unit": "goodput(chaos) / goodput(no chaos), same arrivals",
+        "acceptance": all(gates.values()),
+        "gates": gates,
+        "capacity": {
+            "engine_rps": round(engine_rps, 2),
+            "fleet_rps": round(fleet_rps, 2),
+            "deadline_s": round(deadline_s, 3),
+        },
+        "affinity": {
+            "hit_rate": round(aff["prefix_hit_rate"], 3),
+            "random_hit_rate": round(rnd["prefix_hit_rate"], 3),
+            "ratio": round(hit_ratio, 2),
+        },
+        "baseline": baseline,
+        "chaos": chaos_run,
+        "rollout": rollout_counts,
+        "workload": {
+            "replicas": args.replicas, "slots": args.slots,
+            "block_size": args.block_size,
+            "n_prompts": args.n_prompts,
+            "shared_len": args.shared_len,
+            "budgets": budgets, "load": args.load,
+            "duration_s": args.duration_s, "kills": args.kills,
+        },
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+    return 0 if out["acceptance"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

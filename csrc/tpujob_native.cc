@@ -32,6 +32,27 @@ double now_s() {
       .count();
 }
 
+// Capped exponential backoff with deterministic jitter — bit-identical to
+// controller/workqueue.py::backoff_delay (IEEE doubles, same operation
+// order; parity pinned by tests/test_native.py). The jitter scales the
+// capped delay into [0.75, 1.0) via an FNV-1a hash of "<key>|<failures>",
+// desynchronizing keys that started failing together without RNG state.
+constexpr int kBackoffMaxExp = 32;
+
+double BackoffDelay(double base_delay, double max_delay, const char* key,
+                    int failures) {
+  int exp = failures < kBackoffMaxExp ? failures : kBackoffMaxExp;
+  double raw = base_delay * std::pow(2.0, exp);
+  if (raw > max_delay) raw = max_delay;
+  std::string s = std::string(key) + "|" + std::to_string(failures);
+  uint32_t h = 2166136261u;
+  for (unsigned char c : s) {
+    h = (h ^ c) * 16777619u;
+  }
+  double frac = h / 4294967296.0;
+  return raw * (0.75 + 0.25 * frac);
+}
+
 struct DelayedItem {
   double due;
   uint64_t seq;
@@ -81,8 +102,7 @@ class WorkQueue {
     {
       std::lock_guard<std::mutex> g(mu_);
       int failures = failures_[key]++;
-      delay = base_delay_ * std::pow(2.0, failures);
-      if (delay > max_delay_) delay = max_delay_;
+      delay = BackoffDelay(base_delay_, max_delay_, key.c_str(), failures);
     }
     AddAfter(key, delay);
   }
@@ -306,6 +326,12 @@ extern "C" {
 
 void* wq_new(double base_delay, double max_delay) {
   return new WorkQueue(base_delay, max_delay);
+}
+// Pure backoff computation, exposed so the Python<->C++ parity contract is
+// testable directly (tests/test_native.py) without timing a live queue.
+double wq_backoff_delay(double base_delay, double max_delay, const char* key,
+                        int failures) {
+  return BackoffDelay(base_delay, max_delay, key, failures);
 }
 void wq_free(void* h) { delete static_cast<WorkQueue*>(h); }
 void wq_add(void* h, const char* key) {

@@ -449,3 +449,151 @@ class TPUJob(Sealable):
 
     def is_done(self) -> bool:
         return self.status.phase in (JobPhase.SUCCEEDED, JobPhase.FAILED)
+
+
+# ---------------------------------------------------------------------------
+# LMService — a declarative serving fleet.
+#
+# Where TPUJob describes a finite training run, LMService describes a
+# long-running pool of continuous-batching engine replicas
+# (dataplane/serving_engine.py) the controller keeps at spec.replicas.
+# Replica pods are claimed through the same owner-ref machinery as job pods;
+# the request-side semantics (prefix-affinity dispatch, retries, shedding)
+# live in dataplane/router.py.
+# ---------------------------------------------------------------------------
+
+KIND_LMSERVICE = "LMService"
+
+
+class LMServicePhase(str, enum.Enum):
+    NONE = ""
+    PENDING = "Pending"
+    # All spec.replicas pods are Running.
+    READY = "Ready"
+    # Some but not all replicas are Running (rollout, crash recovery).
+    DEGRADED = "Degraded"
+
+
+@dataclass
+class SLOSpec(Sealable):
+    """Service-level objectives the router and autoscaling signals key off.
+    Zero disables the corresponding objective."""
+
+    # TTFT p99 target; breaching it marks a replica unhealthy for dispatch.
+    ttft_p99_ms: float = 0.0
+    # Per-request completion deadline stamped onto admitted requests.
+    deadline_s: float = 0.0
+
+    def deepcopy(self) -> "SLOSpec":
+        return SLOSpec(self.ttft_p99_ms, self.deadline_s)
+
+    def __deepcopy__(self, memo) -> "SLOSpec":
+        return self.deepcopy()
+
+    def freeze(self) -> "SLOSpec":
+        if not self._sealed:
+            self._seal()
+        return self
+
+
+@dataclass
+class LMServiceSpec(Sealable):
+    # Model preset name (models/config.py CONFIGS key) each replica loads.
+    model: str = "tiny"
+    replicas: int = 1
+    slo: SLOSpec = field(default_factory=SLOSpec)
+    # Per-replica bounded admission queue depth (engine.max_queue).
+    max_queue: int = 8
+    # Stamped once at first reconcile, immutable after — same contract as
+    # TPUJobSpec.runtime_id.
+    runtime_id: str = ""
+
+    def deepcopy(self) -> "LMServiceSpec":
+        return LMServiceSpec(
+            model=self.model,
+            replicas=self.replicas,
+            slo=self.slo.deepcopy(),
+            max_queue=self.max_queue,
+            runtime_id=self.runtime_id,
+        )
+
+    def __deepcopy__(self, memo) -> "LMServiceSpec":
+        return self.deepcopy()
+
+    def freeze(self) -> "LMServiceSpec":
+        if self._sealed:
+            return self
+        self.slo.freeze()
+        self._seal()
+        return self
+
+
+@dataclass
+class LMServiceStatus(Sealable):
+    phase: LMServicePhase = LMServicePhase.NONE
+    reason: str = ""
+    # Replica pods currently Running.
+    ready_replicas: int = 0
+    conditions: List[Condition] = field(default_factory=list)
+    observed_generation: int = 0
+
+    def deepcopy(self) -> "LMServiceStatus":
+        return LMServiceStatus(
+            phase=self.phase,
+            reason=self.reason,
+            ready_replicas=self.ready_replicas,
+            conditions=[c.deepcopy() for c in self.conditions],
+            observed_generation=self.observed_generation,
+        )
+
+    def __deepcopy__(self, memo) -> "LMServiceStatus":
+        return self.deepcopy()
+
+    def freeze(self) -> "LMServiceStatus":
+        if self._sealed:
+            return self
+        self.conditions = _FrozenList(c.freeze() for c in self.conditions)
+        self._seal()
+        return self
+
+    # Same upsert semantics as TPUJobStatus.set_condition (shared helper
+    # would need a mixin through Sealable; duplication keeps both statuses
+    # flat dataclasses).
+    set_condition = TPUJobStatus.set_condition
+    get_condition = TPUJobStatus.get_condition
+
+
+@dataclass
+class LMService(Sealable):
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LMServiceSpec = field(default_factory=LMServiceSpec)
+    status: LMServiceStatus = field(default_factory=LMServiceStatus)
+
+    kind: str = KIND_LMSERVICE
+    api_version: str = f"{API_GROUP}/{API_VERSION}"
+
+    def deepcopy(self) -> "LMService":
+        _note_deepcopy()
+        return LMService(
+            metadata=self.metadata.deepcopy(),
+            spec=self.spec.deepcopy(),
+            status=self.status.deepcopy(),
+            kind=self.kind,
+            api_version=self.api_version,
+        )
+
+    def __deepcopy__(self, memo) -> "LMService":
+        return self.deepcopy()
+
+    def freeze(self) -> "LMService":
+        if self._sealed:
+            return self
+        self.metadata.freeze()
+        self.spec.freeze()
+        self.status.freeze()
+        self._seal()
+        return self
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"

@@ -85,6 +85,34 @@ def validate_job(job: types.TPUJob) -> None:
         raise ValidationError(errs)
 
 
+def validate_lmservice(svc: types.LMService) -> None:
+    """Raise ValidationError listing every problem (not just the first).
+
+    Same collect-all contract as validate_job: the LMService reconcile core
+    only ever sees well-formed services. Model-name resolution is left to the
+    data plane (the control plane must not import jax to validate a spec)."""
+    errs: List[str] = []
+
+    if not svc.metadata.name and not svc.metadata.generate_name:
+        errs.append("metadata.name is required")
+    if not svc.metadata.namespace:
+        errs.append("metadata.namespace is required")
+
+    if not svc.spec.model:
+        errs.append("spec.model is required")
+    if type(svc.spec.replicas) is not int or svc.spec.replicas < 1:
+        errs.append("spec.replicas must be an integer >= 1")
+    if type(svc.spec.max_queue) is not int or svc.spec.max_queue < 1:
+        errs.append("spec.maxQueue must be an integer >= 1")
+    if svc.spec.slo.ttft_p99_ms < 0:
+        errs.append("spec.slo.ttftP99Ms must be >= 0")
+    if svc.spec.slo.deadline_s < 0:
+        errs.append("spec.slo.deadlineS must be >= 0")
+
+    if errs:
+        raise ValidationError(errs)
+
+
 def expected_worker_pods(rs: types.ReplicaSpec) -> int:
     """Number of pods (=host processes) a Worker replica spec implies.
 

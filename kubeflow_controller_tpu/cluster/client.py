@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Protocol
 
 from kubeflow_controller_tpu.api.core import Pod, Service, thaw
-from kubeflow_controller_tpu.api.types import TPUJob
+from kubeflow_controller_tpu.api.types import LMService, TPUJob
 from kubeflow_controller_tpu.cluster.cluster import FakeCluster
 
 
@@ -44,6 +44,15 @@ class ClusterClient(Protocol):
     # so frozen (shared) spec/metadata are legal there.
     def update_job_status(self, job: TPUJob) -> TPUJob: ...
     def delete_job(self, namespace: str, name: str) -> None: ...
+
+    # LMService mirrors the job read/write surface (same snapshot/thaw and
+    # status-subresource contracts).
+    def get_lmservice(self, namespace: str, name: str) -> Optional[LMService]: ...
+    def get_lmservice_snapshot(
+        self, namespace: str, name: str) -> Optional[LMService]: ...
+    def update_lmservice(self, svc: LMService) -> LMService: ...
+    def update_lmservice_status(self, svc: LMService) -> LMService: ...
+    def delete_lmservice(self, namespace: str, name: str) -> None: ...
 
     # namespace: the involved object's namespace (a real apiserver rejects
     # Events whose namespace differs from involvedObject.namespace);
@@ -137,6 +146,27 @@ class FakeClusterClient:
         self.cluster.jobs.delete(namespace, name)
         self.record_event("TPUJob", name, "SuccessfulDelete",
                           f"deleted job {name}", namespace=namespace)
+
+    # -- lmservices ---------------------------------------------------------
+
+    def get_lmservice(self, namespace: str, name: str) -> Optional[LMService]:
+        return thaw(self.cluster.lmservices.try_get(namespace, name))
+
+    def get_lmservice_snapshot(
+        self, namespace: str, name: str
+    ) -> Optional[LMService]:
+        return self.cluster.lmservices.try_get(namespace, name)
+
+    def update_lmservice(self, svc: LMService) -> LMService:
+        return self.cluster.lmservices.update(svc)
+
+    def update_lmservice_status(self, svc: LMService) -> LMService:
+        return self.cluster.lmservices.update_status(svc)
+
+    def delete_lmservice(self, namespace: str, name: str) -> None:
+        self.cluster.lmservices.delete(namespace, name)
+        self.record_event("LMService", name, "SuccessfulDelete",
+                          f"deleted lmservice {name}", namespace=namespace)
 
     # -- misc ---------------------------------------------------------------
 

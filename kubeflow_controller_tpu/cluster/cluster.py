@@ -120,6 +120,8 @@ class FakeCluster:
             copy_on_read=False)
         self.jobs = ObjectStore(
             "TPUJob", now_fn=lambda: self.now, copy_on_read=False)
+        self.lmservices = ObjectStore(
+            "LMService", now_fn=lambda: self.now, copy_on_read=False)
         # Scheduler/kubelet work queues: every tick touches only pods that
         # can actually change state — unbound Pending pods (scheduler) and
         # live pods (kubelet) — instead of scanning the whole store.
@@ -240,6 +242,7 @@ class FakeCluster:
             self.pods.flush()
             self.services.flush()
             self.jobs.flush()
+            self.lmservices.flush()
             self._schedule_pending()
             self._advance_pods()
 

@@ -25,14 +25,28 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
-from kubeflow_controller_tpu.api.core import Pod, Service, is_frozen
+from kubeflow_controller_tpu.api.core import (
+    Container,
+    OwnerReference,
+    Pod,
+    PodPhase,
+    PodSpec,
+    Service,
+    is_frozen,
+)
 from kubeflow_controller_tpu.api.types import (
     ConditionStatus,
     ConditionType,
     JobPhase,
+    LMService,
+    LMServicePhase,
     TPUJob,
 )
-from kubeflow_controller_tpu.api.validation import ValidationError, validate_job
+from kubeflow_controller_tpu.api.validation import (
+    ValidationError,
+    validate_job,
+    validate_lmservice,
+)
 from kubeflow_controller_tpu.checker import assess_health
 from kubeflow_controller_tpu.cluster.client import ClusterClient
 from kubeflow_controller_tpu.cluster.events import EventType, WatchEvent
@@ -48,6 +62,11 @@ from kubeflow_controller_tpu.updater import compute_status
 logger = logging.getLogger("tpujob.controller")
 
 _RUNTIME_ID_ALPHABET = string.ascii_lowercase + string.digits
+
+# LMService keys share the TPUJob workqueue; the prefix keeps the two key
+# spaces disjoint so rate-limit/expectation state never collides with a
+# same-named job.
+LMSVC_KEY_PREFIX = "lmsvc:"
 
 
 def generate_runtime_id(rng: Optional[random.Random] = None) -> str:
@@ -95,11 +114,13 @@ class Controller:
         pod_informer: Informer,
         service_informer: Informer,
         options: Optional[ControllerOptions] = None,
+        lmservice_informer: Optional[Informer] = None,
     ):
         self.client = client
         self.jobs = job_informer
         self.pods = pod_informer
         self.services = service_informer
+        self.lmservices = lmservice_informer
         self.opts = options or ControllerOptions()
         # Hot-path structures come from the C++ core when it is loadable
         # (csrc/tpujob_native.cc); the pure-Python implementations are the
@@ -131,6 +152,8 @@ class Controller:
         job_informer.add_handler(self._on_job_event)
         pod_informer.add_handler(self._on_resource_event)
         service_informer.add_handler(self._on_resource_event)
+        if lmservice_informer is not None:
+            lmservice_informer.add_handler(self._on_lmservice_event)
 
     # -- event handlers (informer side) -------------------------------------
 
@@ -143,18 +166,39 @@ class Controller:
                 self._last_sync_fp.pop(key, None)
         self.queue.add(key)
 
+    def _on_lmservice_event(self, ev: WatchEvent) -> None:
+        key = (f"{LMSVC_KEY_PREFIX}"
+               f"{ev.obj.metadata.namespace}/{ev.obj.metadata.name}")
+        if ev.type == EventType.DELETED:
+            self.expectations.delete_expectations(key)
+        self.queue.add(key)
+
+    @staticmethod
+    def _owner_key(namespace: str, ref) -> Optional[str]:
+        """Workqueue key for a resource's controlling owner (TPUJob or
+        LMService), or None for foreign owners."""
+        if ref is None:
+            return None
+        if ref.kind == "TPUJob":
+            return f"{namespace}/{ref.name}"
+        if ref.kind == "LMService":
+            return f"{LMSVC_KEY_PREFIX}{namespace}/{ref.name}"
+        return None
+
     def _on_resource_event(self, ev: WatchEvent) -> None:
         """Pod/Service watch events: resolve the owning job, settle
         expectations, enqueue (reference addPod/updatePod/… controller.go:430-590)."""
         obj = ev.obj
-        ref = obj.metadata.controller_ref()
         keys = set()
-        if ref is not None and ref.kind == "TPUJob":
-            keys.add(f"{obj.metadata.namespace}/{ref.name}")
+        key = self._owner_key(obj.metadata.namespace,
+                              obj.metadata.controller_ref())
+        if key is not None:
+            keys.add(key)
         if ev.type == EventType.MODIFIED and ev.old_obj is not None:
-            old_ref = ev.old_obj.metadata.controller_ref()
-            if old_ref is not None and old_ref.kind == "TPUJob":
-                keys.add(f"{obj.metadata.namespace}/{old_ref.name}")
+            old_key = self._owner_key(obj.metadata.namespace,
+                                      ev.old_obj.metadata.controller_ref())
+            if old_key is not None:
+                keys.add(old_key)
         for key in keys:
             if ev.type == EventType.ADDED:
                 self.expectations.creation_observed(key)
@@ -169,6 +213,8 @@ class Controller:
         self.jobs.start()
         self.pods.start()
         self.services.start()
+        if self.lmservices is not None:
+            self.lmservices.start()
 
     def run(self, workers: Optional[int] = None) -> None:
         """Spawn worker threads (reference Run, controller.go:158-182)."""
@@ -188,6 +234,8 @@ class Controller:
         self.jobs.stop()
         self.pods.stop()
         self.services.stop()
+        if self.lmservices is not None:
+            self.lmservices.stop()
 
     def _worker_loop(self) -> None:
         while not self._stop.is_set():
@@ -220,7 +268,9 @@ class Controller:
         """Quiesce the async watch pipeline: every event from a completed
         store write is delivered before this returns (no-op for watch
         sources without a flush hook, e.g. wire watches)."""
-        for inf in (self.jobs, self.pods, self.services):
+        for inf in (self.jobs, self.pods, self.services, self.lmservices):
+            if inf is None:
+                continue
             flush = getattr(inf, "flush", None)
             if flush is not None:
                 flush()
@@ -256,6 +306,9 @@ class Controller:
 
     def sync(self, key: str, trace: Optional[SyncTrace] = None) -> None:
         trace = trace or SyncTrace(key=key, start=self.opts.now_fn())
+        if key.startswith(LMSVC_KEY_PREFIX):
+            self._sync_lmservice(key, trace)
+            return
         namespace, name = key.split("/", 1)
         satisfied = self.expectations.satisfied(key)
         job = self.jobs.get(namespace, name)
@@ -663,3 +716,199 @@ class Controller:
                     pass
         for uid in uids:
             self.client.release_slices(uid)
+
+    # -- LMService reconcile -------------------------------------------------
+    #
+    # The fleet analog of the job sync: drive N long-running serving-replica
+    # pods toward spec.replicas through the same claim/expectations
+    # machinery. Replica pods are index-named (lmservice_pod_name), so a
+    # crashed replica is deleted this sync and recreated (same name, new
+    # uid) on the next — level-triggered crash recovery with no extra state.
+    # Request-side behavior (routing, drain, failover) lives in
+    # dataplane/router.py; the controller only manages pod existence.
+
+    def _sync_lmservice(self, key: str, trace: SyncTrace) -> None:
+        namespace, name = key[len(LMSVC_KEY_PREFIX):].split("/", 1)
+        satisfied = self.expectations.satisfied(key)
+        svc = None
+        if self.lmservices is not None:
+            svc = self.lmservices.get(namespace, name)
+        if svc is None:
+            self._cleanup_deleted_lmservice(key, namespace, name)
+            trace.outcome = "deleted-cleanup"
+            return
+        deleting = svc.metadata.deletion_timestamp is not None
+
+        try:
+            validate_lmservice(svc)
+        except ValidationError as e:
+            self.client.record_event("LMService", name, "InvalidSpec", str(e),
+                                     namespace=namespace)
+            trace.outcome = "invalid"
+            return
+
+        if not svc.spec.runtime_id:
+            rid = generate_runtime_id(self.opts.rng)
+            cur = self.client.get_lmservice(namespace, name)
+            if cur is None:
+                return
+            if not cur.spec.runtime_id:
+                cur.spec.runtime_id = rid
+                try:
+                    svc = self.client.update_lmservice(cur)
+                except Conflict:
+                    self.queue.add(key)
+                    return
+            else:
+                svc = cur
+
+        selector = naming.lmservice_selector(svc)
+        pods = claim_objects(
+            svc, selector,
+            self.client.list_pods(namespace, {naming.LABEL_LMSERVICE: name}),
+            self.client.update_pod,
+        )
+
+        desired = {
+            naming.lmservice_pod_name(svc, i): i
+            for i in range(svc.spec.replicas)
+        }
+        existing = {p.metadata.name: p for p in pods}
+        terminal = (PodPhase.SUCCEEDED, PodPhase.FAILED)
+        to_delete = sorted(
+            n for n, p in existing.items()
+            if n not in desired or p.status.phase in terminal
+        )
+        to_create = sorted(
+            i for n, i in desired.items() if n not in existing
+        )
+
+        executed = False
+        if satisfied and not deleting:
+            if to_delete:
+                self.expectations.expect_deletions(key, len(to_delete))
+                for pod_name in to_delete:
+                    try:
+                        self.client.delete_pod(namespace, pod_name)
+                    except NotFound:
+                        self.expectations.deletion_observed(key)
+                executed = True
+            if to_create:
+                self.expectations.expect_creations(key, len(to_create))
+                for j, i in enumerate(to_create):
+                    pod = self._lmservice_pod(svc, i)
+                    try:
+                        self.client.create_pod(pod)
+                    except AlreadyExists:
+                        self.expectations.creation_observed(key)
+                    except Exception:
+                        # Same un-expect accounting as the job batch: no
+                        # watch events will come for the unattempted rest.
+                        for _ in range(len(to_create) - j):
+                            self.expectations.creation_observed(key)
+                        raise
+                self.client.record_event(
+                    "LMService", name, "ScaleReplicas",
+                    f"created {len(to_create)} replica pods",
+                    namespace=namespace)
+                executed = True
+        elif not satisfied:
+            trace.outcome = "expectations-pending"
+
+        ready = sum(
+            1 for n, p in existing.items()
+            if n in desired and p.status.phase == PodPhase.RUNNING
+            and p.metadata.deletion_timestamp is None
+        )
+        self._update_lmservice_status(namespace, name, ready)
+        if trace.outcome == "":
+            trace.outcome = "executed" if executed else "steady"
+
+    def _lmservice_pod(self, svc: LMService, index: int) -> Pod:
+        """One fully-specified serving-replica pod. No scheduling_group:
+        replicas bind individually (no gang) — losing one must not affect
+        the others."""
+        pod = Pod()
+        pod.metadata.name = naming.lmservice_pod_name(svc, index)
+        pod.metadata.namespace = svc.metadata.namespace
+        pod.metadata.labels = naming.lmservice_pod_labels(svc, index)
+        pod.metadata.owner_references = [OwnerReference(
+            api_version=svc.api_version,
+            kind=svc.kind,
+            name=svc.metadata.name,
+            uid=svc.metadata.uid,
+        )]
+        env = {
+            "LMSERVICE_NAME": svc.metadata.name,
+            "LMSERVICE_REPLICA_INDEX": str(index),
+            "LMSERVICE_MAX_QUEUE": str(svc.spec.max_queue),
+        }
+        if svc.spec.slo.deadline_s > 0:
+            env["LMSERVICE_DEADLINE_S"] = str(svc.spec.slo.deadline_s)
+        pod.spec = PodSpec(
+            containers=[Container(
+                name="engine",
+                image="tpujob/serve:latest",
+                command=["python", "-m",
+                         "kubeflow_controller_tpu.dataplane.entrypoints.serve_lm"],
+                args=["--config", svc.spec.model],
+                env=env,
+            )],
+            restart_policy="Always",
+        )
+        return pod
+
+    def _update_lmservice_status(
+        self, ns: str, name: str, ready: int
+    ) -> bool:
+        for _ in range(10):
+            snap = self.client.get_lmservice_snapshot(ns, name)
+            if snap is None:
+                return False
+            replicas = snap.spec.replicas
+            if ready >= replicas:
+                phase = LMServicePhase.READY
+            elif ready > 0:
+                phase = LMServicePhase.DEGRADED
+            else:
+                phase = LMServicePhase.PENDING
+            if (
+                snap.status.ready_replicas == ready
+                and snap.status.phase == phase
+                and snap.status.observed_generation == snap.metadata.generation
+            ):
+                return False
+            if is_frozen(snap):
+                svc = dataclasses.replace(snap, status=snap.status.deepcopy())
+            else:
+                svc = snap
+            svc.status.ready_replicas = ready
+            svc.status.phase = phase
+            svc.status.observed_generation = snap.metadata.generation
+            svc.status.set_condition(
+                ConditionType.READY,
+                ConditionStatus.TRUE if phase == LMServicePhase.READY
+                else ConditionStatus.FALSE,
+                "ReplicasReady", f"{ready}/{replicas} replicas ready",
+                now=self.opts.now_fn())
+            try:
+                self.client.update_lmservice_status(svc)
+                return True
+            except Conflict:
+                continue
+        return True
+
+    def _cleanup_deleted_lmservice(
+        self, key: str, namespace: str, name: str
+    ) -> None:
+        """LMService object is gone: delete its replica pods."""
+        self.expectations.delete_expectations(key)
+        for pod in self.client.list_pods(
+            namespace, {naming.LABEL_LMSERVICE: name}
+        ):
+            ref = pod.metadata.controller_ref()
+            if ref is not None and ref.kind == "LMService" and ref.name == name:
+                try:
+                    self.client.delete_pod(namespace, pod.metadata.name)
+                except NotFound:
+                    pass

@@ -20,6 +20,36 @@ import threading
 import time
 from typing import Dict, Hashable, List, Optional, Set, Tuple
 
+# Exponent clamp for the rate-limit backoff: past this the uncapped delay
+# exceeds any sane max_delay anyway, and 2**failures must never materialize
+# a huge int for a persistently failing item.
+_BACKOFF_MAX_EXP = 32
+
+
+def backoff_delay(
+    base_delay: float, max_delay: float, item: Hashable, failures: int
+) -> float:
+    """Per-item rate-limit delay: capped exponential with deterministic
+    jitter.
+
+    ``min(base * 2^failures, max)`` scaled into ``[0.75, 1.0)`` by an FNV-1a
+    hash of (item, failures). The jitter desynchronizes items that started
+    failing together (a controller restart re-enqueues every bad key at
+    once) so their retries don't thundering-herd on the same beat, while
+    staying deterministic — no RNG state, and the C++ core
+    (``csrc/tpujob_native.cc::BackoffDelay``) computes the identical double
+    for the identical inputs (tests/test_native.py parity).
+    """
+    exp = failures if failures < _BACKOFF_MAX_EXP else _BACKOFF_MAX_EXP
+    raw = base_delay * float(2 ** exp)
+    if raw > max_delay:
+        raw = max_delay
+    h = 2166136261
+    for b in f"{item}|{failures}".encode():
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    frac = h / 4294967296.0
+    return raw * (0.75 + 0.25 * frac)
+
 
 class RateLimitingQueue:
     def __init__(
@@ -100,8 +130,10 @@ class RateLimitingQueue:
         with self._cond:
             failures = self._failures.get(item, 0)
             self._failures[item] = failures + 1
-        delay = min(self._base_delay * (2 ** failures), self._max_delay)
-        self.add_after(item, delay)
+        self.add_after(
+            item,
+            backoff_delay(self._base_delay, self._max_delay, item, failures),
+        )
 
     def forget(self, item: Hashable) -> None:
         with self._cond:

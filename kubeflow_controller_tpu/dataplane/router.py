@@ -1,0 +1,508 @@
+"""Fleet router: prefix-affinity dispatch over N serving-engine replicas.
+
+One :class:`~serving_engine.ServingEngine` is a single pod's decode pool.
+An :class:`~kubeflow_controller_tpu.api.types.LMService` runs N of them,
+and something has to decide which replica each request lands on. Random
+spreading is load-fair but cache-hostile: the radix prefix cache
+(docs/serving.md) only pays when requests sharing a system prompt land
+on the replica that already holds those blocks. This router makes that
+placement decision and owns the fleet-level robustness contract:
+
+* **prefix affinity** — the prompt's longest block-aligned prefix is
+  looked up in an LRU owner map (prefix bytes -> replica). A hit routes
+  to the owning replica, so same-system-prompt traffic converges on the
+  replica whose trie holds those pages; a cold prefix falls back to the
+  least-loaded routable replica and RECORDS ownership for every prefix
+  length of the prompt, so the next request sharing any of them sticks.
+* **retry with capped jittered backoff** — a replica-level
+  :class:`~serving_engine.Rejected` (queue full, draining) retries on a
+  DIFFERENT replica immediately; when every routable replica refuses,
+  the request parks and retries after
+  :func:`~kubeflow_controller_tpu.controller.workqueue.backoff_delay`
+  (the same capped-exponential + deterministic-jitter curve the
+  controller workqueue uses). After ``max_retries`` parks the fleet
+  itself sheds the request — a typed rejection, not an infinite queue.
+* **accounting** — every submitted request ends in EXACTLY ONE of
+  {completed, rejected, cancelled} (``outcome(rid)``), at most once per
+  rid: a late duplicate completion (a re-dispatched request whose first
+  replica somehow finished it too) is counted and dropped, never
+  surfaced twice. Nothing is silently dropped — the conservation law
+  ``submitted == completed + rejected + cancelled`` holds whenever the
+  fleet is idle, and benchmarks assert it under chaos.
+* **health** — per-replica eject/re-admit hysteresis driven by the
+  engine's own metrics (queue depth, recent TTFT tail vs the service
+  SLO). An ejected replica takes no new work but keeps stepping so its
+  in-flight requests finish; it re-admits once the signals clear.
+* **chaos kill** — :meth:`kill` models a replica dying WITHOUT drain
+  (SIGKILL, preemption): every rid assigned there that has no outcome
+  yet re-dispatches to a surviving replica. Its stats fold into the
+  fleet aggregate so prefix-hit accounting survives the body.
+* **rolling restart** — :meth:`rolling_restart` cordons ONE replica
+  (no new dispatches), ``drain(grace_s)``s it (in-flight requests
+  finish inside the grace budget; queued ones come back ``"shed"``),
+  re-dispatches the sheds to the rest of the fleet, and only then
+  swaps in the replacement engine and uncordons. Zero dropped requests
+  across a full-fleet rollout is the acceptance test, not a hope.
+
+The router is deliberately single-threaded and clock-driven (share
+``clock`` with the engines for simulated time): `step()` is the only
+place completions surface and retries fire, which is what makes the
+accounting assertions exact.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
+
+import numpy as np
+
+from kubeflow_controller_tpu.controller.workqueue import backoff_delay
+from kubeflow_controller_tpu.dataplane.metrics import percentile
+from kubeflow_controller_tpu.dataplane.serving_engine import (
+    Completion, Rejected, Request, ServingEngine,
+)
+
+#: terminal outcome kinds — every submitted rid ends in exactly one.
+OUTCOMES = ("completed", "rejected", "cancelled")
+
+
+def _fnv(data: bytes) -> int:
+    h = 2166136261
+    for b in data:
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+@dataclass
+class ReplicaHandle:
+    """One replica as the router sees it. ``healthy``/``cordoned`` gate
+    NEW dispatches only — an unhealthy or cordoned replica still steps,
+    so its in-flight work finishes rather than being abandoned."""
+
+    name: str
+    engine: ServingEngine
+    healthy: bool = True
+    cordoned: bool = False
+    strikes: int = 0        # consecutive bad health checks
+    clears: int = 0         # consecutive good checks while ejected
+    ttft_seen: int = 0      # stats.ttfts_s high-water (windowed checks)
+
+    @property
+    def routable(self) -> bool:
+        return self.healthy and not self.cordoned
+
+    @property
+    def load(self) -> int:
+        return len(self.engine.queue) + self.engine.n_active
+
+
+@dataclass
+class _Parked:
+    due_t: float
+    rid: int
+    attempt: int
+
+
+class FleetRouter:
+    def __init__(
+        self,
+        clock: Callable[[], float],
+        block_size: int = 4,
+        affinity: bool = True,
+        max_retries: int = 4,
+        retry_base_s: float = 0.05,
+        retry_max_s: float = 2.0,
+        owner_map_cap: int = 4096,
+        eject_queue_depth: Optional[int] = None,
+        ttft_slo_ms: Optional[float] = None,
+        eject_after: int = 2,
+        readmit_after: int = 2,
+        ttft_window: int = 16,
+    ):
+        self._clock = clock
+        self.block_size = int(block_size)
+        # affinity=False is the random-dispatch baseline the benchmark
+        # compares against: deterministic pseudo-random by rid, no owner
+        # map — same code path, placement policy isolated.
+        self.affinity = affinity
+        self.max_retries = max_retries
+        self.retry_base_s = retry_base_s
+        self.retry_max_s = retry_max_s
+        self.eject_queue_depth = eject_queue_depth
+        self.ttft_slo_ms = ttft_slo_ms
+        self.eject_after = eject_after
+        self.readmit_after = readmit_after
+        self.ttft_window = ttft_window
+
+        self._replicas: "OrderedDict[str, ReplicaHandle]" = OrderedDict()
+        # prefix bytes -> owning replica name, LRU-bounded. Entries may
+        # go stale (owner killed); _route checks routability and falls
+        # back, and the fallback re-records ownership.
+        self._owners: "OrderedDict[bytes, str]" = OrderedDict()
+        self._owner_map_cap = owner_map_cap
+        self._requests: Dict[int, Request] = {}     # live (no outcome yet)
+        self._assigned: Dict[int, str] = {}         # rid -> replica name
+        self._outcomes: Dict[int, Tuple[str, object]] = {}
+        self._parked: List[_Parked] = []
+        self.completions: List[Completion] = []
+
+        # Fleet counters (see docstring accounting contract).
+        self.submitted = 0
+        self.retries = 0
+        self.redispatched = 0
+        self.duplicate_completions = 0
+        self.ejections = 0
+        self.readmissions = 0
+        self.affinity_hits = 0
+        # Prefix accounting folded in from killed/replaced engines so
+        # fleet hit-rate survives chaos.
+        self._retired_hit_tokens = 0
+        self._retired_lookup_tokens = 0
+
+    # -- fleet membership --------------------------------------------------
+
+    @property
+    def replicas(self) -> List[ReplicaHandle]:
+        return list(self._replicas.values())
+
+    def get_replica(self, name: str) -> Optional[ReplicaHandle]:
+        return self._replicas.get(name)
+
+    def add_replica(self, name: str, engine: ServingEngine) -> ReplicaHandle:
+        if name in self._replicas:
+            raise ValueError(f"replica {name!r} already registered")
+        h = ReplicaHandle(name=name, engine=engine)
+        self._replicas[name] = h
+        return h
+
+    def kill(self, name: str) -> List[int]:
+        """Chaos: the replica dies with NO drain (SIGKILL/preemption).
+        Every rid assigned to it without an outcome re-dispatches to the
+        surviving fleet (the decoded-so-far tokens are lost with the
+        pod — the request restarts; at-most-once on COMPLETION is the
+        contract, not exactly-once on decode work). Returns the
+        re-dispatched rids."""
+        h = self._replicas.pop(name, None)
+        if h is None:
+            return []
+        self._fold_stats(h.engine)
+        victims = sorted(
+            rid for rid, n in self._assigned.items() if n == name)
+        moved = []
+        for rid in victims:
+            del self._assigned[rid]
+            if rid in self._outcomes:
+                continue
+            self.redispatched += 1
+            self._dispatch(rid, attempt=0, exclude=frozenset((name,)))
+            moved.append(rid)
+        return moved
+
+    def rolling_restart(
+        self,
+        engine_factory: Callable[[str], ServingEngine],
+        grace_s: float = 5.0,
+    ) -> None:
+        """Replace every replica's engine, one at a time, dropping
+        nothing: cordon (new traffic routes around it), drain within
+        ``grace_s`` (in-flight finishes; queued comes back ``"shed"``),
+        re-dispatch the sheds to the rest of the fleet, then install the
+        factory's fresh engine and uncordon. One replica is out at any
+        moment — the fleet serves throughout."""
+        for name in list(self._replicas):
+            h = self._replicas[name]
+            h.cordoned = True
+            comps = h.engine.drain(grace_s)
+            for c in comps:
+                if c.rid in self._outcomes:
+                    self.duplicate_completions += 1
+                    continue
+                self._assigned.pop(c.rid, None)
+                if c.finish_reason == "shed":
+                    # Never reached a slot here — another replica can
+                    # still serve it in full.
+                    self.redispatched += 1
+                    self._dispatch(c.rid, attempt=0,
+                                   exclude=frozenset((name,)))
+                else:
+                    self._complete(c)
+            self._fold_stats(h.engine)
+            h.engine = engine_factory(name)
+            h.cordoned = False
+            h.healthy = True
+            h.strikes = h.clears = h.ttft_seen = 0
+
+    # -- request intake ----------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        """Accept a request into the fleet. From here the router owns it
+        until it reaches a terminal outcome — including across replica
+        rejections, kills, and restarts."""
+        if req.rid in self._requests or req.rid in self._outcomes:
+            raise ValueError(f"request {req.rid}: duplicate rid")
+        self._requests[req.rid] = req
+        self.submitted += 1
+        self._dispatch(req.rid, attempt=0, exclude=frozenset())
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel wherever the request currently lives: parked retries
+        resolve immediately; queued/in-flight ones cancel inside their
+        replica and surface at the next step. False if already
+        terminal."""
+        if rid in self._outcomes or rid not in self._requests:
+            return False
+        name = self._assigned.get(rid)
+        if name is not None:
+            return self._replicas[name].engine.cancel(rid)
+        self._parked = [p for p in self._parked if p.rid != rid]
+        self._finish(rid, "cancelled", None)
+        return True
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _prefix_keys(self, prompt: np.ndarray) -> List[bytes]:
+        """Block-aligned prefixes, shortest -> longest, as hashable
+        bytes. Matches the radix trie's block granularity so "owns the
+        prefix" and "holds the blocks" agree."""
+        toks = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        bs = self.block_size
+        n = (toks.size // bs) * bs
+        return [toks[:end].tobytes() for end in range(bs, n + 1, bs)]
+
+    def _route(self, req: Request,
+               excluded: FrozenSet[str]) -> Optional[ReplicaHandle]:
+        usable = [h for h in self._replicas.values()
+                  if h.routable and h.name not in excluded]
+        if not usable:
+            return None
+        if not self.affinity:
+            return usable[_fnv(str(req.rid).encode()) % len(usable)]
+        for key in reversed(self._prefix_keys(req.prompt)):
+            owner = self._owners.get(key)
+            if owner is None:
+                continue
+            self._owners.move_to_end(key)
+            h = self._replicas.get(owner)
+            if h is not None and h.routable and owner not in excluded:
+                self.affinity_hits += 1
+                return h
+        return min(usable, key=lambda h: (h.load, h.name))
+
+    def _record_owner(self, req: Request, name: str) -> None:
+        if not self.affinity:
+            return
+        for key in self._prefix_keys(req.prompt):
+            self._owners[key] = name
+            self._owners.move_to_end(key)
+        while len(self._owners) > self._owner_map_cap:
+            self._owners.popitem(last=False)
+
+    def _dispatch(self, rid: int, attempt: int,
+                  exclude: FrozenSet[str]) -> None:
+        req = self._requests.get(rid)
+        if req is None or rid in self._outcomes:
+            return
+        tried = set(exclude)
+        while True:
+            h = self._route(req, frozenset(tried))
+            if h is None:
+                self._park_or_shed(rid, attempt)
+                return
+            try:
+                h.engine.submit(req)
+            except Rejected:
+                # This replica said no (full/draining) — try the rest
+                # of the fleet before parking.
+                tried.add(h.name)
+                continue
+            self._assigned[rid] = h.name
+            self._record_owner(req, h.name)
+            return
+
+    def _park_or_shed(self, rid: int, attempt: int) -> None:
+        """No replica would take it right now. Park with the workqueue's
+        capped-jittered backoff curve and retry; past ``max_retries``
+        the FLEET sheds — a typed rejection the caller can act on,
+        instead of an unbounded secret queue in the router."""
+        if attempt >= self.max_retries:
+            self._finish(rid, "rejected", "fleet_saturated")
+            return
+        self.retries += 1
+        delay = backoff_delay(
+            self.retry_base_s, self.retry_max_s, rid, attempt)
+        self._parked.append(_Parked(
+            due_t=self._clock() + delay, rid=rid, attempt=attempt + 1))
+
+    # -- outcomes ----------------------------------------------------------
+
+    def _finish(self, rid: int, kind: str, payload) -> None:
+        if rid in self._outcomes:
+            self.duplicate_completions += 1
+            return
+        self._outcomes[rid] = (kind, payload)
+        self._requests.pop(rid, None)
+        self._assigned.pop(rid, None)
+
+    def _complete(self, comp: Completion) -> None:
+        kind = ("cancelled" if comp.finish_reason == "cancelled"
+                else "completed")
+        if comp.rid in self._outcomes:
+            self.duplicate_completions += 1
+            return
+        self._finish(comp.rid, kind, comp)
+        self.completions.append(comp)
+
+    def outcome(self, rid: int) -> Optional[Tuple[str, object]]:
+        return self._outcomes.get(rid)
+
+    @property
+    def outcome_counts(self) -> Dict[str, int]:
+        out = {k: 0 for k in OUTCOMES}
+        for kind, _ in self._outcomes.values():
+            out[kind] += 1
+        return out
+
+    @property
+    def pending(self) -> int:
+        """Requests the router still owes an outcome."""
+        return len(self._requests)
+
+    @property
+    def idle(self) -> bool:
+        return (not self._requests and not self._parked
+                and all(h.engine.idle for h in self._replicas.values()))
+
+    # -- drive -------------------------------------------------------------
+
+    def step(self) -> List[Completion]:
+        """One fleet quantum: fire due parked retries, step every
+        replica (ejected and cordoned ones included — their in-flight
+        work must finish), book completions, refresh health."""
+        now = self._clock()
+        due = [p for p in self._parked if p.due_t <= now]
+        if due:
+            self._parked = [p for p in self._parked if p.due_t > now]
+            for p in due:
+                self._dispatch(p.rid, attempt=p.attempt,
+                               exclude=frozenset())
+        out: List[Completion] = []
+        for h in list(self._replicas.values()):
+            for c in h.engine.step():
+                self._complete(c)
+                out.append(c)
+        self._update_health()
+        return out
+
+    def run_until_idle(self, max_steps: int = 10000) -> None:
+        for _ in range(max_steps):
+            if self.idle:
+                return
+            self.step()
+        raise RuntimeError(
+            f"fleet did not go idle in {max_steps} steps "
+            f"({self.pending} pending, {len(self._parked)} parked)")
+
+    # -- health ------------------------------------------------------------
+
+    def _unhealthy_signal(self, h: ReplicaHandle) -> bool:
+        depth = len(h.engine.queue)
+        cap = self.eject_queue_depth
+        if cap is None and h.engine.max_queue is not None:
+            cap = h.engine.max_queue
+        if cap is not None and depth >= cap:
+            return True
+        if self.ttft_slo_ms is not None:
+            # Only TTFTs recorded since the last check: an ejected
+            # replica must be judged on what it does now, not on the
+            # backlog that got it ejected.
+            ttfts = h.engine.stats.ttfts_s[h.ttft_seen:]
+            h.ttft_seen = len(h.engine.stats.ttfts_s)
+            if ttfts:
+                window = ttfts[-self.ttft_window:]
+                if percentile(window, 99) * 1e3 > self.ttft_slo_ms:
+                    return True
+        return False
+
+    def _update_health(self) -> None:
+        for h in self._replicas.values():
+            if self._unhealthy_signal(h):
+                h.strikes += 1
+                h.clears = 0
+            else:
+                h.clears += 1
+            if h.healthy and h.strikes >= self.eject_after:
+                h.healthy = False
+                self.ejections += 1
+            elif not h.healthy and h.clears >= self.readmit_after:
+                h.healthy = True
+                h.strikes = 0
+                self.readmissions += 1
+
+    # -- stats -------------------------------------------------------------
+
+    def _fold_stats(self, engine: ServingEngine) -> None:
+        self._retired_hit_tokens += engine.stats.prefix_hit_tokens
+        self._retired_lookup_tokens += engine.stats.prefix_lookup_tokens
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fleet-level hit rate across live AND retired engines — the
+        number the affinity policy is judged by."""
+        hit = self._retired_hit_tokens + sum(
+            h.engine.stats.prefix_hit_tokens
+            for h in self._replicas.values())
+        lookup = self._retired_lookup_tokens + sum(
+            h.engine.stats.prefix_lookup_tokens
+            for h in self._replicas.values())
+        return hit / lookup if lookup else 0.0
+
+    def fleet_summary(self) -> Dict[str, float]:
+        counts = self.outcome_counts
+        return {
+            "replicas": float(len(self._replicas)),
+            "submitted": float(self.submitted),
+            "completed": float(counts["completed"]),
+            "rejected": float(counts["rejected"]),
+            "cancelled": float(counts["cancelled"]),
+            "pending": float(self.pending),
+            "retries": float(self.retries),
+            "redispatched": float(self.redispatched),
+            "duplicate_completions": float(self.duplicate_completions),
+            "ejections": float(self.ejections),
+            "readmissions": float(self.readmissions),
+            "affinity_hits": float(self.affinity_hits),
+            "prefix_hit_rate": self.prefix_hit_rate,
+        }
+
+
+def sync_fleet_from_pods(
+    router: FleetRouter,
+    pods,
+    engine_factory: Callable[[str], ServingEngine],
+) -> Tuple[List[str], List[str]]:
+    """Converge router membership onto the control plane's view: one
+    replica per RUNNING, non-deleting pod. A pod the controller
+    recreated after a crash joins with a fresh engine; a pod that
+    vanished (chaos, scale-down) is treated as killed — its in-flight
+    requests re-dispatch. Returns (added, removed) replica names.
+
+    This is the dataplane half of the LMService reconcile loop: the
+    controller converges pods onto spec.replicas, and this converges
+    engines onto pods — both level-triggered, so calling it repeatedly
+    is idempotent."""
+    running = set()
+    for pod in pods:
+        phase = getattr(pod.status, "phase", None)
+        if (getattr(phase, "value", phase) == "Running"
+                and pod.metadata.deletion_timestamp is None):
+            running.add(pod.metadata.name)
+    added, removed = [], []
+    for name in sorted(set(router._replicas) - running):
+        router.kill(name)
+        removed.append(name)
+    for name in sorted(running - set(router._replicas)):
+        router.add_replica(name, engine_factory(name))
+        added.append(name)
+    return added, removed

@@ -99,7 +99,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_controller_tpu.dataplane import kv_blocks
-from kubeflow_controller_tpu.dataplane.metrics import ServingStats
+from kubeflow_controller_tpu.dataplane.metrics import MetricsLogger, ServingStats
 from kubeflow_controller_tpu.models import generate as gen
 from kubeflow_controller_tpu.models.transformer import (
     Params, TransformerConfig,
@@ -266,6 +266,7 @@ class ServingEngine:
         kv_pool_blocks: Optional[int] = None,
         kv_hbm_budget_mb: Optional[float] = None,
         admit_cache_cap: int = 64,
+        metrics_path: Optional[str] = None,
     ):
         self.cfg = cfg
         self.params = params
@@ -328,6 +329,11 @@ class ServingEngine:
         self._rng = rng if rng is not None else jax.random.key(0)
         self._clock = clock
         self._step_idx = 0
+        # Optional JSONL sink: drain() writes the final ServingStats
+        # summary here (and closes the file) before returning, so a
+        # SIGTERM'd replica's metrics survive the process — the fleet
+        # aggregates them from disk after the pod is gone.
+        self._metrics = MetricsLogger(metrics_path) if metrics_path else None
 
         self.cache = gen.init_slot_cache(cfg, n_slots, self.max_seq)
         self.logits = jnp.zeros((n_slots, cfg.vocab_size), jnp.float32)
@@ -954,6 +960,20 @@ class ServingEngine:
         for i, slot in enumerate(self.slots):
             if slot is not None:
                 out.append(self._retire_slot(i, slot, "deadline", now))
+        # Every retirement path above funnels through _release_pins, so
+        # by here no request holds a trie pin — the block pool's only
+        # remaining refs are the trie's own (leak-checked by
+        # tests/test_kv_blocks.py). Flush the final stats snapshot and
+        # close the JSONL sink BEFORE returning: drain is the last thing
+        # a replica does before the pod dies, and a buffered line lost
+        # to SIGKILL is a request the fleet can't account for.
+        self._sync_stats()
+        if self._metrics is not None:
+            scalars = self.stats.summary()
+            scalars["drained"] = 1.0
+            self._metrics.write(self.stats.steps, scalars)
+            self._metrics.close()
+            self._metrics = None
         return out
 
     def run(

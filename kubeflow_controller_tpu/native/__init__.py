@@ -49,6 +49,8 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.wq_len.argtypes = [c_void_p]
     lib.wq_empty_and_idle.restype = c_int
     lib.wq_empty_and_idle.argtypes = [c_void_p]
+    lib.wq_backoff_delay.restype = c_double
+    lib.wq_backoff_delay.argtypes = [c_double, c_double, c_char_p, c_int]
 
     lib.exp_new.restype = c_void_p
     lib.exp_new.argtypes = [c_double]

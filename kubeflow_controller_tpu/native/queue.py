@@ -114,6 +114,17 @@ class NativeControllerExpectations:
         return (adds.value, dels.value)
 
 
+def native_backoff_delay(
+    base_delay: float, max_delay: float, item: Hashable, failures: int
+) -> float:
+    """The C++ core's backoff computation (parity-tested against
+    ``controller.workqueue.backoff_delay``)."""
+    lib = native.load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return lib.wq_backoff_delay(base_delay, max_delay, _b(item), failures)
+
+
 def make_queue(base_delay: float = 0.005, max_delay: float = 60.0):
     """Best queue available: C++ when loadable, else the Python one."""
     if native.available():

@@ -17,8 +17,8 @@ from typing import Callable, Optional, Union
 
 from kubeflow_controller_tpu.api.core import thaw
 from kubeflow_controller_tpu.api.serialization import load_job_yaml
-from kubeflow_controller_tpu.api.types import JobPhase, TPUJob
-from kubeflow_controller_tpu.api.validation import validate_job
+from kubeflow_controller_tpu.api.types import JobPhase, LMService, TPUJob
+from kubeflow_controller_tpu.api.validation import validate_job, validate_lmservice
 from kubeflow_controller_tpu.cluster.client import FakeClusterClient
 from kubeflow_controller_tpu.cluster.cluster import FakeCluster, PodRunPolicy
 from kubeflow_controller_tpu.controller.controller import Controller, ControllerOptions
@@ -125,12 +125,15 @@ class LocalRuntime:
         self.job_informer = Informer(self.cluster.jobs, self._opts.resync_period)
         self.pod_informer = Informer(self.cluster.pods, self._opts.resync_period)
         self.service_informer = Informer(self.cluster.services, self._opts.resync_period)
+        self.lmservice_informer = Informer(
+            self.cluster.lmservices, self._opts.resync_period)
         self.controller = Controller(
             self.client,
             self.job_informer,
             self.pod_informer,
             self.service_informer,
             self._opts,
+            lmservice_informer=self.lmservice_informer,
         )
         self.controller.start()
 
@@ -151,6 +154,18 @@ class LocalRuntime:
 
     def delete_job(self, namespace: str, name: str) -> None:
         self.cluster.jobs.delete(namespace, name)
+
+    # -- lmservice API -------------------------------------------------------
+
+    def submit_lmservice(self, svc: LMService) -> LMService:
+        validate_lmservice(svc)
+        return self.cluster.lmservices.create(svc)
+
+    def get_lmservice(self, namespace: str, name: str) -> Optional[LMService]:
+        return thaw(self.cluster.lmservices.try_get(namespace, name))
+
+    def delete_lmservice(self, namespace: str, name: str) -> None:
+        self.cluster.lmservices.delete(namespace, name)
 
     # -- deterministic drive -------------------------------------------------
 
@@ -194,7 +209,8 @@ class LocalRuntime:
         the reference's expectations race comment describes
         (``pkg/controller/controller.go:259-262``)."""
         was_threaded = len(self.controller._threads)
-        for inf in (self.job_informer, self.pod_informer, self.service_informer):
+        for inf in (self.job_informer, self.pod_informer,
+                    self.service_informer, self.lmservice_informer):
             inf.stop()
         self.controller.queue.shutdown()
         self._wire()

@@ -24,10 +24,11 @@ from __future__ import annotations
 from typing import Dict
 
 from kubeflow_controller_tpu.api.topology import SliceShape
-from kubeflow_controller_tpu.api.types import ReplicaType, TPUJob
+from kubeflow_controller_tpu.api.types import LMService, ReplicaType, TPUJob
 
 PREFIX = "tpu.kubeflow.dev"
 LABEL_JOB = f"{PREFIX}/job"
+LABEL_LMSERVICE = f"{PREFIX}/lmservice"
 LABEL_RUNTIME_ID = f"{PREFIX}/runtime-id"
 LABEL_REPLICA_TYPE = f"{PREFIX}/replica-type"
 LABEL_INDEX = f"{PREFIX}/index"
@@ -65,6 +66,31 @@ def pod_name(job: TPUJob, replica_type: ReplicaType, index: int, epoch: int) -> 
         f"{job.metadata.name}-{job.spec.runtime_id}-"
         f"{replica_type.value.lower()}-e{epoch}-{index}"
     )
+
+
+def lmservice_selector(svc: LMService) -> Dict[str, str]:
+    """Ownership selector for an LMService's replica pods (claiming also
+    checks ownerReferences, same as job pods)."""
+    return {
+        LABEL_LMSERVICE: svc.metadata.name,
+        LABEL_RUNTIME_ID: svc.spec.runtime_id,
+    }
+
+
+def lmservice_pod_labels(svc: LMService, index: int) -> Dict[str, str]:
+    return {
+        LABEL_LMSERVICE: svc.metadata.name,
+        LABEL_RUNTIME_ID: svc.spec.runtime_id,
+        LABEL_REPLICA_TYPE: "serving",
+        LABEL_INDEX: str(index),
+    }
+
+
+def lmservice_pod_name(svc: LMService, index: int) -> str:
+    # Deterministic, index-stable names: a crashed replica is replaced by a
+    # same-named pod (new uid), so the router's replica identity survives
+    # chaos kills and rolling restarts.
+    return f"{svc.metadata.name}-{svc.spec.runtime_id}-serve-{index}"
 
 
 def coordinator_service_name(job: TPUJob) -> str:

@@ -108,6 +108,27 @@ def full_job() -> types.TPUJob:
     return job
 
 
+def full_lmservice() -> types.LMService:
+    return types.LMService(
+        metadata=full_meta(),
+        spec=types.LMServiceSpec(
+            model="tiny", replicas=3,
+            slo=types.SLOSpec(ttft_p99_ms=250.0, deadline_s=30.0),
+            max_queue=16, runtime_id="r",
+        ),
+        status=types.LMServiceStatus(
+            phase=types.LMServicePhase.DEGRADED, reason="rr",
+            ready_replicas=2,
+            conditions=[types.Condition(
+                type=types.ConditionType.READY,
+                status=types.ConditionStatus.FALSE,
+                reason="cr", message="cm", last_transition_time=7.0,
+            )],
+            observed_generation=3,
+        ),
+    )
+
+
 class TestCopies:
     def test_pod(self):
         pod = full_pod()
@@ -144,6 +165,19 @@ class TestCopies:
         assert job.status.replica_statuses[0].states[
             types.ReplicaState.RUNNING] == 4
         assert job.spec.replica_specs[0].termination_policy.chief.replica_index == 1
+
+    def test_lmservice(self):
+        svc = full_lmservice()
+        cp = svc.deepcopy()
+        assert cp == svc and cp == copy.deepcopy(svc)
+        cp.spec.slo.deadline_s = 1.0
+        cp.spec.replicas = 9
+        cp.status.conditions[0].reason = "x"
+        cp.status.ready_replicas = 0
+        assert svc.spec.slo.deadline_s == 30.0
+        assert svc.spec.replicas == 3
+        assert svc.status.conditions[0].reason == "cr"
+        assert svc.status.ready_replicas == 2
 
     def test_copy_module_dispatch(self):
         """copy.deepcopy must route through the fast paths (__deepcopy__)."""
@@ -196,6 +230,13 @@ EXPECTED_FIELDS = {
         "all_running_time", "completion_time", "restarts", "resizes",
         "last_restart_time", "observed_generation"},
     types.TPUJob: {"metadata", "spec", "status", "kind", "api_version"},
+    types.SLOSpec: {"ttft_p99_ms", "deadline_s"},
+    types.LMServiceSpec: {
+        "model", "replicas", "slo", "max_queue", "runtime_id"},
+    types.LMServiceStatus: {
+        "phase", "reason", "ready_replicas", "conditions",
+        "observed_generation"},
+    types.LMService: {"metadata", "spec", "status", "kind", "api_version"},
 }
 
 
@@ -256,14 +297,14 @@ def _assert_deeply_thawed(obj, path="root"):
 
 class TestFreezeThaw:
     def test_freeze_covers_every_field(self):
-        for make in (full_pod, full_service, full_job):
+        for make in (full_pod, full_service, full_job, full_lmservice):
             obj = make()
             assert obj.freeze() is obj          # freezes in place
             _assert_deeply_frozen(obj)
             assert obj.freeze() is obj          # idempotent
 
     def test_thaw_roundtrip_equal_and_mutable(self):
-        for make in (full_pod, full_service, full_job):
+        for make in (full_pod, full_service, full_job, full_lmservice):
             frozen = make().freeze()
             t = core.thaw(frozen)
             assert t is not frozen and t == frozen
@@ -271,7 +312,7 @@ class TestFreezeThaw:
             assert core.thaw(t) is t            # copy elision when owned
 
     def test_deepcopy_of_frozen_is_thawed(self):
-        for make in (full_pod, full_service, full_job):
+        for make in (full_pod, full_service, full_job, full_lmservice):
             frozen = make().freeze()
             cp = frozen.deepcopy()
             assert cp == frozen

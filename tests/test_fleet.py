@@ -1,0 +1,563 @@
+"""Fleet-scale LMService: reconciled replicas + prefix-affinity routing.
+
+Three layers, cheapest first:
+
+1. **Router semantics over fake engines** (no jax): dispatch affinity,
+   rejection retry on a different replica, fleet-boundary shedding,
+   chaos-kill re-dispatch with at-most-once completion, rolling restart
+   with zero drops, health eject/re-admit hysteresis. The FakeEngine
+   implements exactly the engine surface the router consumes, on a
+   simulated clock, so these tests are deterministic and instant.
+2. **LMService reconcile** (FakeCluster, no jax): the controller drives
+   N claimed pods from the spec — scale up/down, crash recovery with
+   stable pod names, delete cleanup, validation.
+3. **Real-engine integration** (tiny config): a 2-replica fleet serving
+   shared-prefix traffic with one chaos kill — affinity actually hits
+   the radix cache and the conservation law survives the kill. The full
+   chaos/rollout sweep is the slow-marked fleet_bench smoke.
+"""
+
+import os
+import sys
+from collections import deque
+from typing import List
+
+import numpy as np
+import pytest
+
+from kubeflow_controller_tpu.api import types
+from kubeflow_controller_tpu.api.core import ObjectMeta, PodPhase
+from kubeflow_controller_tpu.api.validation import (
+    ValidationError, validate_lmservice,
+)
+from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
+from kubeflow_controller_tpu.dataplane.metrics import ServingStats
+from kubeflow_controller_tpu.dataplane.router import (
+    FleetRouter, sync_fleet_from_pods,
+)
+from kubeflow_controller_tpu.dataplane.serving_engine import (
+    Completion, Rejected, Request,
+)
+from kubeflow_controller_tpu.runtime import LocalRuntime
+from kubeflow_controller_tpu.tpu import naming
+
+
+# -- layer 1: router over fake engines ------------------------------------
+
+
+class FakeEngine:
+    """The engine surface FleetRouter consumes, with deterministic
+    service: a request completes ``service_steps`` steps after
+    admission, emitting one token per budget unit. Prefix accounting
+    mirrors the real engine's block-granular rule so affinity tests can
+    measure hit rates without jax."""
+
+    def __init__(self, clock, n_slots=2, max_queue=4, service_steps=2,
+                 block_size=4):
+        self._clock = clock
+        self.n_slots = n_slots
+        self.max_queue = max_queue
+        self.service_steps = service_steps
+        self.block_size = block_size
+        self.queue = deque()               # [req, submit_t]
+        self.active = {}                   # rid -> [req, submit_t, admit_t, left]
+        self.stats = ServingStats(n_slots=n_slots)
+        self._draining = False
+        self._cancelled = set()
+        self._done: List[Completion] = []
+        self._blocks = set()               # block-prefix bytes "cached" here
+
+    def submit(self, req: Request) -> None:
+        if self._draining:
+            self.stats.rejected += 1
+            raise Rejected(req.rid, "draining")
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            self.stats.rejected += 1
+            raise Rejected(req.rid, "queue_full")
+        self.queue.append([req, self._clock()])
+        self.stats.submitted += 1
+
+    def cancel(self, rid: int) -> bool:
+        for item in self.queue:
+            if item[0].rid == rid:
+                self.queue.remove(item)
+                self._done.append(Completion(
+                    rid=rid, tokens=[], finish_reason="cancelled",
+                    submit_t=item[1], first_token_t=None,
+                    done_t=self._clock()))
+                return True
+        if rid in self.active:
+            self._cancelled.add(rid)
+            return True
+        return False
+
+    def _admit(self) -> None:
+        while self.queue and len(self.active) < self.n_slots:
+            req, submit_t = self.queue.popleft()
+            prompt = np.asarray(req.prompt, np.int32)
+            self.stats.prefix_lookup_tokens += prompt.size
+            n = (prompt.size // self.block_size) * self.block_size
+            for end in range(self.block_size, n + 1, self.block_size):
+                key = prompt[:end].tobytes()
+                if key in self._blocks:
+                    self.stats.prefix_hit_tokens += self.block_size
+                else:
+                    self._blocks.add(key)
+            self.active[req.rid] = [req, submit_t, self._clock(),
+                                    self.service_steps]
+            self.stats.admitted += 1
+
+    def step(self) -> List[Completion]:
+        out, self._done = self._done, []
+        now = self._clock()
+        for rid in list(self.active):
+            req, submit_t, admit_t, left = self.active[rid]
+            if rid in self._cancelled:
+                self._cancelled.discard(rid)
+                del self.active[rid]
+                out.append(Completion(
+                    rid=rid, tokens=[], finish_reason="cancelled",
+                    submit_t=submit_t, first_token_t=None, done_t=now,
+                    admit_t=admit_t))
+                continue
+            left -= 1
+            self.active[rid][3] = left
+            if left <= 0:
+                del self.active[rid]
+                comp = Completion(
+                    rid=rid, tokens=[0] * req.max_new_tokens,
+                    finish_reason="eos", submit_t=submit_t,
+                    first_token_t=admit_t, done_t=now, admit_t=admit_t)
+                self.stats.record(comp)
+                out.append(comp)
+        self._admit()
+        return out
+
+    @property
+    def n_active(self) -> int:
+        return len(self.active)
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and not self.active and not self._done
+
+    def drain(self, grace_s: float = 5.0) -> List[Completion]:
+        self._draining = True
+        out, self._done = self._done, []
+        now = self._clock()
+        while self.queue:
+            req, submit_t = self.queue.popleft()
+            comp = Completion(
+                rid=req.rid, tokens=[], finish_reason="shed",
+                submit_t=submit_t, first_token_t=None, done_t=now)
+            self.stats.record(comp)
+            out.append(comp)
+        if grace_s > 0:
+            for _ in range(self.service_steps + 1):
+                if not self.active:
+                    break
+                out.extend(self.step())
+        for rid in list(self.active):
+            req, submit_t, admit_t, _ = self.active.pop(rid)
+            comp = Completion(
+                rid=rid, tokens=[], finish_reason="deadline",
+                submit_t=submit_t, first_token_t=None, done_t=now,
+                admit_t=admit_t)
+            self.stats.record(comp)
+            out.append(comp)
+        return out
+
+
+def _req(rid, prompt, max_new=3):
+    return Request(rid=rid, prompt=np.asarray(prompt, np.int32),
+                   max_new_tokens=max_new)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_fleet(n=3, clock=None, engine_kw=None, **router_kw):
+    clock = clock or _Clock()
+    router = FleetRouter(clock=clock, block_size=4, **router_kw)
+    for i in range(n):
+        router.add_replica(f"r{i}", FakeEngine(clock, **(engine_kw or {})))
+    return router, clock
+
+
+def pump(router, clock, steps=50, dt=0.1):
+    for _ in range(steps):
+        if router.idle:
+            return
+        clock.t += dt
+        router.step()
+    assert router.idle, (
+        f"fleet not idle: {router.pending} pending, "
+        f"{router.outcome_counts}")
+
+
+SHARED_A = list(range(100, 108))       # two 4-token blocks
+SHARED_B = list(range(200, 208))
+
+
+class TestRouterDispatch:
+    def test_affinity_same_prefix_same_replica(self):
+        router, clock = make_fleet(engine_kw=dict(max_queue=None))
+        for i in range(6):
+            router.submit(_req(i, SHARED_A + [300 + i]))
+        homes = {router._assigned[i] for i in range(6)}
+        assert len(homes) == 1, "shared prefix scattered across replicas"
+
+    def test_distinct_prefixes_spread_by_load(self):
+        router, clock = make_fleet()
+        for i in range(4):
+            prompt = [1000 * (i + 1) + j for j in range(8)]
+            router.submit(_req(i, prompt))
+        assert len({router._assigned[i] for i in range(4)}) > 1
+
+    def test_random_mode_records_no_owners(self):
+        router, clock = make_fleet(affinity=False)
+        for i in range(6):
+            router.submit(_req(i, SHARED_A + [300 + i]))
+        assert not router._owners
+        pump(router, clock)
+        assert router.outcome_counts["completed"] == 6
+
+    def test_rejection_retries_on_other_replica(self):
+        router, clock = make_fleet(
+            n=2, engine_kw=dict(max_queue=1, n_slots=1, service_steps=50))
+        # r0 takes rid 0 (slot) and rid 1 (queue); rid 2 must bounce off
+        # r0's full queue and land on r1 within the same dispatch call.
+        router.submit(_req(0, SHARED_A + [0]))
+        clock.t += 0.1
+        router.step()                            # rid 0 into r0's slot
+        for i in (1, 2):
+            router.submit(_req(i, SHARED_A + [i]))
+        assert router._assigned[0] == router._assigned[1]
+        assert router._assigned[2] != router._assigned[0]
+
+    def test_fleet_shed_when_saturated_then_no_silent_drop(self):
+        router, clock = make_fleet(
+            n=2, max_retries=2,
+            engine_kw=dict(max_queue=1, n_slots=1, service_steps=10_000))
+        for i in range(12):
+            router.submit(_req(i, SHARED_A + [i]))
+        for _ in range(40):                # park -> retry -> exhaust
+            clock.t += 1.0
+            router.step()
+        counts = router.outcome_counts
+        assert counts["rejected"] > 0
+        shed = [r for r in range(12)
+                if router.outcome(r) == ("rejected", "fleet_saturated")]
+        assert len(shed) == counts["rejected"]   # typed fleet rejections
+        # The whole fleet dies; survivors' work re-parks and exhausts —
+        # EVERY request must still reach a terminal outcome.
+        for name in [h.name for h in router.replicas]:
+            router.kill(name)
+        for _ in range(40):
+            clock.t += 1.0
+            router.step()
+        counts = router.outcome_counts
+        assert counts["completed"] + counts["rejected"] == 12
+        assert router.pending == 0
+
+    def test_cancel_parked_and_inflight(self):
+        router, clock = make_fleet(
+            n=1, engine_kw=dict(max_queue=1, n_slots=1, service_steps=5))
+        router.submit(_req(0, SHARED_A))         # in slot
+        router.submit(_req(1, SHARED_A + [1]))   # queued
+        router.submit(_req(2, SHARED_A + [2]))   # rejected -> parked
+        assert router.cancel(0)                  # in-flight cancel
+        assert router.cancel(2)                  # parked: immediate outcome
+        assert router.outcome(2) == ("cancelled", None)
+        pump(router, clock)
+        counts = router.outcome_counts
+        assert counts["cancelled"] == 2 and counts["completed"] == 1
+        assert not router.cancel(0)              # already terminal
+
+
+class TestRouterChaos:
+    def test_kill_redispatches_inflight_at_most_once(self):
+        router, clock = make_fleet(engine_kw=dict(service_steps=4))
+        for i in range(9):
+            router.submit(_req(i, SHARED_A + [i]))
+        clock.t += 0.1
+        router.step()
+        victim = router._assigned[0]
+        victims = [r for r, n in router._assigned.items() if n == victim]
+        moved = router.kill(victim)
+        assert set(moved) == set(victims)
+        assert all(router._assigned[r] != victim for r in moved)
+        pump(router, clock)
+        counts = router.outcome_counts
+        assert counts["completed"] == 9
+        assert router.duplicate_completions == 0
+        rids = [c.rid for c in router.completions]
+        assert sorted(rids) == list(range(9))    # exactly once each
+
+    def test_kill_folds_stats_into_fleet_aggregate(self):
+        router, clock = make_fleet()
+        for i in range(6):
+            router.submit(_req(i, SHARED_A + [i]))
+        pump(router, clock)
+        before = router.prefix_hit_rate
+        assert before > 0
+        for name in [h.name for h in router.replicas]:
+            router.kill(name)
+        assert router.prefix_hit_rate == before  # survives the bodies
+
+    def test_rolling_restart_zero_drops(self):
+        clock = _Clock()
+        router, _ = make_fleet(clock=clock, engine_kw=dict(service_steps=3))
+
+        def factory(name):
+            return FakeEngine(clock)
+
+        for i in range(12):
+            router.submit(_req(i, SHARED_A + [i]))
+        clock.t += 0.1
+        router.step()
+        old = [h.engine for h in router.replicas]
+        router.rolling_restart(factory, grace_s=1.0)
+        assert all(h.engine not in old for h in router.replicas)
+        assert all(h.routable for h in router.replicas)
+        pump(router, clock)
+        counts = router.outcome_counts
+        assert counts["completed"] == 12 and counts["rejected"] == 0
+        assert router.duplicate_completions == 0
+
+
+class TestRouterHealth:
+    def test_eject_on_queue_depth_and_readmit(self):
+        router, clock = make_fleet(
+            n=2, eject_queue_depth=3, eject_after=1, readmit_after=2,
+            engine_kw=dict(max_queue=None, service_steps=1))
+        sick = router.get_replica("r0")
+        for i in range(8):                       # force depth past cap
+            sick.engine.queue.append([_req(100 + i, [i]), 0.0])
+        router.step()
+        assert not sick.healthy
+        assert router.ejections == 1
+        # New traffic routes around the ejected replica.
+        router.submit(_req(0, SHARED_A))
+        assert router._assigned[0] == "r1"
+        # Its backlog drains (ejected replicas still step); after
+        # readmit_after clean checks it takes traffic again.
+        for _ in range(8):
+            clock.t += 0.1
+            router.step()
+        assert sick.healthy
+        assert router.readmissions == 1
+
+    def test_ttft_slo_ejects_on_new_samples_only(self):
+        router, clock = make_fleet(
+            n=2, ttft_slo_ms=50.0, eject_after=1, readmit_after=1,
+            engine_kw=dict(max_queue=None))
+        slow = router.get_replica("r0")
+        slow.engine.stats.ttfts_s.extend([0.2, 0.3])   # way over 50ms
+        router.step()
+        assert not slow.healthy
+        # No NEW slow samples: the old tail must not keep it ejected.
+        router.step()
+        assert slow.healthy
+
+
+# -- layer 2: LMService reconcile -----------------------------------------
+
+
+def _svc(name="chat", replicas=2, **spec_kw):
+    return types.LMService(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=types.LMServiceSpec(model="tiny", replicas=replicas,
+                                 **spec_kw))
+
+
+def _serving_pods(rt, name="chat"):
+    return rt.client.list_pods(
+        "default", {naming.LABEL_LMSERVICE: name})
+
+
+@pytest.fixture()
+def rt():
+    rt = LocalRuntime(default_policy=PodRunPolicy(
+        start_delay=1.0, run_duration=1e9))
+    yield rt
+    rt.stop()
+
+
+class TestLMServiceReconcile:
+    def test_scale_up_to_ready(self, rt):
+        rt.submit_lmservice(_svc(replicas=3))
+        assert rt.run_until(lambda: (
+            (s := rt.get_lmservice("default", "chat")) is not None
+            and s.status.phase == types.LMServicePhase.READY))
+        svc = rt.get_lmservice("default", "chat")
+        assert svc.status.ready_replicas == 3
+        pods = _serving_pods(rt)
+        assert len(pods) == 3
+        assert all(p.status.phase == PodPhase.RUNNING for p in pods)
+        # Index-stable names: the dataplane router keys replicas on them.
+        names = sorted(p.metadata.name for p in pods)
+        assert names == sorted(
+            naming.lmservice_pod_name(svc, i) for i in range(3))
+
+    def test_scale_down_and_up(self, rt):
+        rt.submit_lmservice(_svc(replicas=3))
+        rt.run_until(lambda: len(_serving_pods(rt)) == 3
+                     and all(p.status.phase == PodPhase.RUNNING
+                             for p in _serving_pods(rt)))
+        svc = rt.get_lmservice("default", "chat")
+        svc.spec.replicas = 1
+        rt.cluster.lmservices.update(svc)
+        assert rt.run_until(lambda: len(_serving_pods(rt)) == 1)
+        svc = rt.get_lmservice("default", "chat")
+        svc.spec.replicas = 2
+        rt.cluster.lmservices.update(svc)
+        assert rt.run_until(lambda: (
+            (s := rt.get_lmservice("default", "chat")) is not None
+            and s.status.ready_replicas == 2))
+
+    def test_crashed_replica_recreated_same_name(self, rt):
+        rt.submit_lmservice(_svc(replicas=2))
+        rt.run_until(lambda: (
+            (s := rt.get_lmservice("default", "chat")) is not None
+            and s.status.ready_replicas == 2))
+        victim = sorted(p.metadata.name for p in _serving_pods(rt))[0]
+        rt.cluster.crash_pod("default", victim)
+        # Degrades, then self-heals with the SAME pod name (level-
+        # triggered recreate, no epoch suffix).
+        assert rt.run_until(lambda: (
+            (s := rt.get_lmservice("default", "chat")) is not None
+            and s.status.ready_replicas == 2))
+        assert victim in {p.metadata.name for p in _serving_pods(rt)}
+
+    def test_delete_cleans_up_pods(self, rt):
+        rt.submit_lmservice(_svc(replicas=2))
+        rt.run_until(lambda: len(_serving_pods(rt)) == 2)
+        rt.delete_lmservice("default", "chat")
+        assert rt.run_until(lambda: len(rt.client.list_pods(
+            "default", {naming.LABEL_LMSERVICE: "chat"})) == 0)
+
+    def test_status_degraded_while_starting(self, rt):
+        rt.submit_lmservice(_svc(replicas=2))
+        rt.controller.drain()
+        svc = rt.get_lmservice("default", "chat")
+        assert svc.status.phase == types.LMServicePhase.PENDING
+        rt.step(dt=0.5)   # pods bound, not yet past start_delay
+        svc = rt.get_lmservice("default", "chat")
+        assert svc.status.phase in (types.LMServicePhase.PENDING,
+                                    types.LMServicePhase.DEGRADED)
+
+    def test_validation(self):
+        with pytest.raises(ValidationError):
+            validate_lmservice(_svc(replicas=0))
+        with pytest.raises(ValidationError):
+            validate_lmservice(_svc(max_queue=0))
+        with pytest.raises(ValidationError):
+            validate_lmservice(types.LMService(
+                metadata=ObjectMeta(name="x", namespace="default"),
+                spec=types.LMServiceSpec(model="")))
+        with pytest.raises(ValidationError):
+            validate_lmservice(types.LMService(
+                metadata=ObjectMeta(name="x", namespace="default"),
+                spec=types.LMServiceSpec(
+                    model="tiny", slo=types.SLOSpec(deadline_s=-1))))
+        validate_lmservice(_svc())            # baseline passes
+
+    def test_sync_fleet_tracks_pods(self, rt):
+        rt.submit_lmservice(_svc(replicas=2))
+        rt.run_until(lambda: (
+            (s := rt.get_lmservice("default", "chat")) is not None
+            and s.status.ready_replicas == 2))
+        clock = _Clock()
+        router = FleetRouter(clock=clock, block_size=4)
+        added, removed = sync_fleet_from_pods(
+            router, _serving_pods(rt), lambda n: FakeEngine(clock))
+        assert len(added) == 2 and not removed
+        # Idempotent: converged membership is a no-op.
+        assert sync_fleet_from_pods(
+            router, _serving_pods(rt),
+            lambda n: FakeEngine(clock)) == ([], [])
+        victim = added[0]
+        rt.cluster.crash_pod("default", victim)
+        rt.controller.drain()                 # FAILED pod deleted+recreated
+        added2, removed2 = sync_fleet_from_pods(
+            router, _serving_pods(rt), lambda n: FakeEngine(clock))
+        assert removed2 == [victim]
+        rt.run_until(lambda: all(
+            p.status.phase == PodPhase.RUNNING
+            for p in _serving_pods(rt)) and len(_serving_pods(rt)) == 2)
+        added3, _ = sync_fleet_from_pods(
+            router, _serving_pods(rt), lambda n: FakeEngine(clock))
+        assert added3 == [victim]             # same name, fresh engine
+
+
+# -- layer 3: real engines ------------------------------------------------
+
+
+def test_real_engine_fleet_affinity_and_kill():
+    """2 real engines, shared-prefix traffic, one chaos kill: the radix
+    cache actually hits through the router, and the conservation law
+    holds across the kill."""
+    import jax
+
+    from kubeflow_controller_tpu.dataplane.serving_engine import (
+        ServingEngine,
+    )
+    from kubeflow_controller_tpu.models import generate as gen
+    from kubeflow_controller_tpu.models import transformer as tfm
+
+    cfg = tfm.tiny_config()
+    params = gen.inference_params(
+        cfg, tfm.init_params(cfg, jax.random.key(0)))
+    clock = _Clock()
+
+    def mk(name):
+        return ServingEngine(
+            cfg, params, n_slots=2, max_seq=40, prefill_mode="bucketed",
+            block_size=4, prefix_cache=True, max_queue=8,
+            clock=clock)
+
+    router = FleetRouter(clock=clock, block_size=4)
+    for n in ("a", "b"):
+        router.add_replica(n, mk(n))
+    rng = np.random.default_rng(7)
+    shared = rng.integers(0, cfg.vocab_size, 12)
+    for i in range(8):
+        tail = rng.integers(0, cfg.vocab_size, 1 + i % 3)
+        router.submit(Request(
+            rid=i, prompt=np.concatenate([shared, tail]).astype(np.int32),
+            max_new_tokens=4))
+    for _ in range(4):
+        clock.t += 0.1
+        router.step()
+    victim = next(iter(router._assigned.values()), "a")
+    router.kill(victim)
+    pump(router, clock, steps=100)
+    counts = router.outcome_counts
+    assert counts["completed"] == 8
+    assert router.duplicate_completions == 0
+    assert router.prefix_hit_rate > 0
+
+
+@pytest.mark.slow
+def test_fleet_bench_smoke(tmp_path):
+    """The full chaos + rollout sweep: every fleet_bench gate must pass
+    on the smoke config (conservation, at-most-once, goodput retention,
+    affinity hit-rate ratio, zero-drop rollout)."""
+    import json
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks"))
+    import fleet_bench
+
+    out = tmp_path / "fleet.json"
+    rc = fleet_bench.main(["--smoke", "--json", str(out)])
+    assert rc == 0
+    data = json.loads(out.read_text())
+    assert data["acceptance"] and all(data["gates"].values())

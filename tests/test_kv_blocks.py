@@ -290,6 +290,38 @@ def test_cancel_deadline_drain_release_blocks(cfg, params):
     _assert_no_leaked_pins(eng)
 
 
+def test_drain_flushes_metrics_and_releases_pins(cfg, params, tmp_path):
+    """Satellite: drain(grace_s) must flush the metrics JSONL (final
+    summary line, file closed) and release every radix-trie pin BEFORE
+    returning — a mid-flight drain is what a SIGTERM'd replica runs as
+    its last act, and anything still buffered or pinned at that point is
+    simply lost."""
+    import json
+
+    path = str(tmp_path / "replica-metrics.jsonl")
+    clock_t = [0.0]
+    eng = ServingEngine(cfg, params, n_slots=2, max_seq=40,
+                        prefill_mode="bucketed", block_size=4,
+                        prefix_cache=True, clock=lambda: clock_t[0],
+                        metrics_path=path)
+    reqs = _shared_prefix_requests(cfg, 5, max_new=20)
+    for r in reqs:
+        eng.submit(r)
+    for _ in range(4):
+        eng.step()              # some in flight holding pins, some queued
+    assert eng.n_active > 0
+    comps = eng.drain(grace_s=0.0)   # zero grace: force mid-flight retire
+    assert eng._metrics is None      # sink closed, not merely flushed
+    _assert_no_leaked_pins(eng)
+    with open(path) as f:
+        lines = [json.loads(l) for l in f if l.strip()]
+    assert lines, "drain wrote no metrics"
+    final = lines[-1]
+    assert final["drained"] == 1.0
+    # The flushed snapshot accounts for every completion drain returned.
+    assert final["requests"] == eng.stats.finished >= len(comps)
+
+
 def test_register_prefix_multiturn_session_reuse(cfg, params):
     """Satellite: a generate_from_cache(return_state=True) session
     registers its accumulated KV so the engine's next turn reuses it.

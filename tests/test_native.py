@@ -10,7 +10,10 @@ import pytest
 
 from kubeflow_controller_tpu import native
 from kubeflow_controller_tpu.controller.expectations import ControllerExpectations
-from kubeflow_controller_tpu.controller.workqueue import RateLimitingQueue
+from kubeflow_controller_tpu.controller.workqueue import (
+    RateLimitingQueue,
+    backoff_delay,
+)
 
 needs_native = pytest.mark.skipif(
     not native.available(), reason="native lib not built"
@@ -174,6 +177,52 @@ def test_native_queue_throughput_sanity():
     t_native = drive(NativeRateLimitingQueue())
     t_py = drive(RateLimitingQueue())
     assert t_native < t_py * 3, (t_native, t_py)
+
+
+class TestBackoffDelay:
+    """The rate-limit delay function: capped exponential with deterministic
+    jitter. The Python version is the spec; the C++ core must produce the
+    bit-identical double for identical inputs."""
+
+    KEYS = ["default/job-a", "lmsvc:default/chat", "", "k" * 200, "ns/j|x"]
+    FAILURES = [0, 1, 2, 3, 7, 15, 31, 32, 33, 100, 10_000]
+
+    def test_cap_and_jitter_envelope(self):
+        base, cap = 0.005, 60.0
+        for key in self.KEYS:
+            for f in self.FAILURES:
+                raw = min(base * 2.0 ** min(f, 32), cap)
+                d = backoff_delay(base, cap, key, f)
+                assert 0.75 * raw <= d < raw, (key, f, d)
+
+    def test_huge_failure_count_stays_capped(self):
+        # 2**failures must never materialize: the exponent is clamped, so
+        # even absurd counts return promptly and never exceed the cap.
+        d = backoff_delay(0.005, 60.0, "k", 10_000_000)
+        assert 0.75 * 60.0 <= d < 60.0
+
+    def test_deterministic_but_key_dependent(self):
+        a = backoff_delay(0.01, 1.0, "ns/a", 3)
+        assert a == backoff_delay(0.01, 1.0, "ns/a", 3)
+        # Different keys (or failure counts) land on different beats:
+        # the anti-thundering-herd property after a controller restart.
+        others = {
+            backoff_delay(0.01, 1.0, k, f)
+            for k in ("ns/b", "ns/c", "ns/d")
+            for f in (3, 4)
+        }
+        assert len(others) == 6 and a not in others
+
+    @needs_native
+    def test_native_parity_bit_identical(self):
+        from kubeflow_controller_tpu.native.queue import native_backoff_delay
+
+        for base, cap in ((0.005, 60.0), (0.01, 1.0), (0.02, 300.0)):
+            for key in self.KEYS:
+                for f in self.FAILURES:
+                    py = backoff_delay(base, cap, key, f)
+                    cc = native_backoff_delay(base, cap, key, f)
+                    assert py == cc, (base, cap, key, f, py, cc)
 
 
 @pytest.mark.parametrize("Queue", queue_impls())
