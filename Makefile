@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: all native test test-fast bench bench-cp bench-serve \
-	bench-overload bench-prefix bench-fleet clean stamp
+	bench-overload bench-prefix bench-fleet bench-spec clean stamp
 
 # Build-stamp analog of the reference's ldflags version injection
 # (/root/reference/Makefile:23-26): export the sha for build_version().
@@ -65,6 +65,15 @@ bench-prefix:
 bench-fleet:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_bench.py --smoke \
 		--json benchmarks/fleet_bench_summary.json
+
+# Speculative-decoding benchmark: radix drafting on repeat traffic
+# (greedy outputs asserted bit-identical before timing; exits nonzero
+# below 1.5x decode tokens/sec) plus the incompressible-traffic TPOT
+# guard (nonzero above 5% regression) — see benchmarks/RESULTS.md and
+# docs/serving.md.
+bench-spec:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/spec_bench.py \
+		--json benchmarks/spec_bench_summary.json
 
 clean:
 	$(MAKE) -C csrc clean

@@ -1,0 +1,303 @@
+"""Speculative-decoding benchmark for the serving engine.
+
+Two claims from the spec-decode design (docs/serving.md "Speculative
+decoding"), each measured on its natural workload:
+
+* **repetitive speedup**: repeat traffic — a small set of base prompts
+  served twice (retries / fan-out / agent loops re-running a
+  conversation). The first wave warms the radix prefix trie with every
+  prompt AND greedy reply; in the timed waves the radix proposer drafts
+  the cached continuation, which greedy decode reproduces exactly, so
+  the fused verifier commits multiple tokens per dispatch. Greedy
+  outputs are asserted BIT-IDENTICAL between the speculative and plain
+  engines before any timing is reported (same discipline as
+  prefix_bench.py) — with the greedy acceptance rule this is a
+  tripwire, not a tolerance. Gate: decode throughput >= --min-speedup
+  (default 1.5x) over the plain engine on the same warmed-cache
+  workload.
+* **incompressible safety**: unique random-token prompts — nothing to
+  draft. The prompt-lookup proposer (ngram_min=2) essentially never
+  matches, every step falls back to the engine's plain pipelined decode
+  chunk, and the only added cost is the host-side draft scan. Gate:
+  TPOT p50 regression <= --max-tpot-regress (default 5%) vs the
+  speculative-off engine, outputs again bit-identical.
+
+Prints one JSON object; with ``--json`` also writes it to a file. Run
+via ``make bench-spec``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np
+
+
+def repeat_workload(cfg, n_requests: int, n_base: int, prompt_len: int,
+                    max_new: int, seed: int):
+    """n_requests requests cycling over n_base distinct random prompts —
+    the repeat-traffic shape (every prompt is served multiple times)."""
+    from kubeflow_controller_tpu.dataplane.serving_engine import Request
+
+    rng = np.random.default_rng(seed)
+    base = [rng.integers(0, cfg.vocab_size, prompt_len).astype(np.int32)
+            for _ in range(n_base)]
+    return [
+        Request(rid=i, prompt=np.array(base[i % n_base]),
+                max_new_tokens=max_new)
+        for i in range(n_requests)
+    ]
+
+
+def random_workload(cfg, n_requests: int, prompt_len: int, max_new: int,
+                    seed: int):
+    """Unique random prompts — incompressible; nothing for a model-free
+    proposer to latch onto."""
+    from kubeflow_controller_tpu.dataplane.serving_engine import Request
+
+    rng = np.random.default_rng(seed)
+    return [
+        Request(rid=i,
+                prompt=rng.integers(0, cfg.vocab_size, prompt_len).astype(
+                    np.int32),
+                max_new_tokens=max_new)
+        for i in range(n_requests)
+    ]
+
+
+def _reqs(requests):
+    from kubeflow_controller_tpu.dataplane.serving_engine import Request
+
+    return [Request(rid=r.rid, prompt=np.array(r.prompt),
+                    max_new_tokens=r.max_new_tokens, eos_id=r.eos_id)
+            for r in requests]
+
+
+class _WaveRunner:
+    """Warm-cache wave timing for ONE engine: the constructor's untimed
+    wave compiles AND seeds the radix trie with every prompt + greedy
+    reply; each time() call re-serves the same requests against the
+    warm trie (no reset — the warm cache IS the workload)."""
+
+    def __init__(self, cfg, params, requests, **engine_kw):
+        from kubeflow_controller_tpu.dataplane.serving_engine import (
+            ServingEngine,
+        )
+
+        self.requests = requests
+        self.engine = ServingEngine(cfg, params, **engine_kw)
+        self.engine.run(_reqs(requests))     # warm: compile + seed trie
+        self.walls: List[float] = []
+        self.outs: Dict[int, List[int]] = {}
+        self.stable = True
+
+    def time(self) -> None:
+        t0 = time.perf_counter()
+        comps = self.engine.run(_reqs(self.requests))
+        self.walls.append(time.perf_counter() - t0)
+        out = {c.rid: list(c.tokens) for c in comps}
+        if not self.outs:
+            self.outs = out
+        elif out != self.outs:
+            self.stable = False              # greedy waves must agree
+
+    @property
+    def tokens_per_sec(self) -> float:
+        # Best (min wall) rather than median: the timed work is
+        # deterministic, so the fastest repeat is the least-noise
+        # observation — and the repeats of the two compared engines
+        # are interleaved, so drift hits both.
+        tokens = sum(len(t) for t in self.outs.values())
+        return tokens / min(self.walls)
+
+
+class _ResetRunner:
+    """Cold-per-repeat timing (prefix_bench idiom, best-of-repeats):
+    reset between repeats — backoff lanes deliberately survive the
+    reset, so the warmup run's adaptation carries into the timed
+    runs."""
+
+    def __init__(self, cfg, params, requests, **engine_kw):
+        from kubeflow_controller_tpu.dataplane.serving_engine import (
+            ServingEngine,
+        )
+
+        self.requests = requests
+        self.engine = ServingEngine(cfg, params, **engine_kw)
+        self.engine.run(_reqs(requests))          # warmup: compile + run
+        self.runs = []
+
+    def time(self) -> None:
+        self.engine.reset()
+        t0 = time.perf_counter()
+        completions = self.engine.run(_reqs(self.requests))
+        wall = time.perf_counter() - t0
+        self.runs.append((wall, completions, self.engine.stats))
+
+    def best(self):
+        wall, completions, stats = min(self.runs, key=lambda r: r[0])
+        summary = stats.summary(wall_s=wall)
+        summary["wall_s"] = wall
+        # Gate TPOT on the best-of-repeats p50, not the min-wall run's
+        # p50: the decode work is deterministic, so scheduler noise
+        # only ever INFLATES inter-token gaps, and at tiny-model
+        # per-token times (~0.2 ms) one noisy quantum in the min-wall
+        # run moves its p50 by several percent. The repeat minima of
+        # the two compared engines are the least-noise comparison.
+        summary["tpot_p50_ms"] = min(
+            s.summary()["tpot_p50_ms"] for _, _, s in self.runs)
+        return {c.rid: list(c.tokens) for c in completions}, summary
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="tiny")
+    p.add_argument("--requests", type=int, default=16,
+                   help="repeat-traffic wave size (speedup leg)")
+    p.add_argument("--base-prompts", type=int, default=4,
+                   help="distinct prompts the repeat wave cycles over")
+    p.add_argument("--prompt-len", type=int, default=24)
+    p.add_argument("--max-new", type=int, default=128)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--draft-k", type=int, default=24)
+    p.add_argument("--block-size", type=int, default=8)
+    p.add_argument("--rand-requests", type=int, default=12,
+                   help="incompressible workload size (TPOT leg)")
+    p.add_argument("--rand-prompt-len", type=int, default=24)
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--min-speedup", type=float, default=1.5,
+                   help="decode tokens/sec gate on the repeat leg")
+    p.add_argument("--max-tpot-regress", type=float, default=0.05,
+                   help="allowed TPOT p50 regression on random traffic")
+    p.add_argument("--json", default="", help="also write the summary here")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from kubeflow_controller_tpu.dataplane.entrypoints.lm import CONFIGS
+    from kubeflow_controller_tpu.models import generate as gen
+    from kubeflow_controller_tpu.models import transformer as tfm
+
+    cfg = CONFIGS[args.config]()
+    params = gen.inference_params(
+        cfg, tfm.init_params(cfg, jax.random.key(0)))
+
+    # ---- leg 1: repeat traffic, radix drafting vs plain decode ----------
+    # Both engines get the prefix cache (warm-trie admission hits are a
+    # separately-benchmarked win — prefix_bench.py); the ONLY difference
+    # is speculation, so the ratio isolates multi-token verify commits.
+    reqs = repeat_workload(
+        cfg, args.requests, args.base_prompts, args.prompt_len,
+        args.max_new, args.seed)
+    max_seq = args.prompt_len + args.max_new
+    base_kw = dict(n_slots=args.slots, max_seq=max_seq,
+                   prefill_mode="bucketed", prefix_cache=True,
+                   block_size=args.block_size)
+    plain_run = _WaveRunner(cfg, params, reqs, **base_kw)
+    spec_run = _WaveRunner(cfg, params, reqs, spec_decode=True,
+                           draft_k=args.draft_k, proposer="radix",
+                           **base_kw)
+    for _ in range(args.repeats):        # interleaved: drift hits both
+        plain_run.time()
+        spec_run.time()
+    plain_out, plain_tps, plain_stable = (
+        plain_run.outs, plain_run.tokens_per_sec, plain_run.stable)
+    spec_out, spec_tps, spec_stable = (
+        spec_run.outs, spec_run.tokens_per_sec, spec_run.stable)
+    spec_eng = spec_run.engine
+
+    # Bit-exactness gate BEFORE any timing is reported: a speedup over
+    # different outputs would be comparing different work.
+    mismatches = [r for r in plain_out if plain_out[r] != spec_out.get(r)]
+    outputs_match = not mismatches and plain_stable and spec_stable
+    speedup = spec_tps / plain_tps if plain_tps else float("inf")
+    spec_sum = spec_eng.stats.summary()
+
+    # ---- leg 2: incompressible traffic, prompt-lookup fallback ----------
+    rand = random_workload(
+        cfg, args.rand_requests, args.rand_prompt_len, args.max_new,
+        args.seed + 1)
+    rand_kw = dict(n_slots=args.slots,
+                   max_seq=args.rand_prompt_len + args.max_new,
+                   prefill_mode="bucketed", block_size=args.block_size)
+    roff_run = _ResetRunner(cfg, params, rand, **rand_kw)
+    ron_run = _ResetRunner(cfg, params, rand, spec_decode=True,
+                           draft_k=args.draft_k, proposer="prompt",
+                           **rand_kw)
+    for _ in range(args.repeats):        # interleaved: drift hits both
+        roff_run.time()
+        ron_run.time()
+    roff_out, roff_sum = roff_run.best()
+    ron_out, ron_sum = ron_run.best()
+    ron_eng = ron_run.engine
+    rand_mism = [r for r in roff_out if roff_out[r] != ron_out.get(r)]
+    tpot_ratio = (ron_sum["tpot_p50_ms"] / roff_sum["tpot_p50_ms"]
+                  if roff_sum["tpot_p50_ms"] else 1.0)
+
+    out = {
+        "metric": "spec_decode_tokens_per_sec_speedup",
+        "value": round(speedup, 2),
+        "unit": "x spec-on vs spec-off decode tokens/sec, repeat traffic",
+        "outputs_match": outputs_match and not rand_mism,
+        "repeat_leg": {
+            "requests": args.requests,
+            "base_prompts": args.base_prompts,
+            "prompt_len": args.prompt_len,
+            "max_new": args.max_new,
+            "slots": args.slots,
+            "draft_k": args.draft_k,
+            "plain_tokens_per_sec": round(plain_tps, 1),
+            "spec_tokens_per_sec": round(spec_tps, 1),
+            "acceptance_rate": round(spec_sum["acceptance_rate"], 4),
+            "draft_proposed": spec_sum["draft_proposed"],
+            "draft_accepted": spec_sum["draft_accepted"],
+            "spec_steps": spec_sum["spec_steps"],
+            "spec_step_tokens_hist": {
+                k: v for k, v in sorted(
+                    spec_eng.stats.spec_step_tokens_hist.items())},
+        },
+        "incompressible_leg": {
+            "requests": args.rand_requests,
+            "prompt_len": args.rand_prompt_len,
+            "tpot_ratio": round(tpot_ratio, 4),
+            "plain_tpot_p50_ms": round(roff_sum["tpot_p50_ms"], 3),
+            "spec_tpot_p50_ms": round(ron_sum["tpot_p50_ms"], 3),
+            "spec_draft_proposed": ron_eng.stats.draft_proposed,
+        },
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+    if mismatches or not plain_stable or not spec_stable:
+        print(f"OUTPUT MISMATCH on repeat leg: rids {mismatches[:8]}"
+              f" stable=({plain_stable},{spec_stable})")
+        return 1
+    if rand_mism:
+        print(f"OUTPUT MISMATCH on incompressible leg: rids"
+              f" {rand_mism[:8]}")
+        return 1
+    if speedup < args.min_speedup:
+        print(f"SPEEDUP BELOW TARGET: {speedup:.2f}x <"
+              f" {args.min_speedup}x")
+        return 1
+    if tpot_ratio > 1.0 + args.max_tpot_regress:
+        print(f"TPOT REGRESSION ABOVE TARGET: {tpot_ratio:.3f} >"
+              f" {1.0 + args.max_tpot_regress:.3f}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

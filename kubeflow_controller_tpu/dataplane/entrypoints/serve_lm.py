@@ -25,6 +25,12 @@ completions (tagged with finish reasons) plus the metrics JSONL instead
 of dying with empty artifacts. ``--max-queue`` bounds admission and
 ``--deadline-s`` sheds/retires requests past their latency budget —
 docs/serving.md "Overload & shutdown semantics".
+
+``--speculative`` turns on speculative decoding (``--draft-k``,
+``--proposer {prompt,radix}``): model-free drafts verified in one fused
+forward per step, bit-identical greedy outputs, acceptance stats
+(``draft_proposed``/``draft_accepted``/``acceptance_rate``) in the same
+metrics JSONL summary — docs/serving.md "Speculative decoding".
 """
 
 from __future__ import annotations
@@ -135,6 +141,9 @@ def serve(
     prefix_cache: bool = False,
     block_size: int = 16,
     kv_pool_mb: Optional[float] = None,
+    speculative: bool = False,
+    draft_k: int = 4,
+    proposer: str = "prompt",
     stop=None,
 ) -> Dict[str, float]:
     """``stop`` is a ``threading.Event`` (e.g. from
@@ -187,6 +196,7 @@ def serve(
             prefill_mode=("bucketed" if prefix_cache else prefill_mode),
             prefix_cache=prefix_cache, block_size=block_size,
             kv_hbm_budget_mb=kv_pool_mb,
+            spec_decode=speculative, draft_k=draft_k, proposer=proposer,
         )
         prompts_np = np.asarray(prompts)
         completions = []
@@ -243,6 +253,7 @@ def serve(
             temperature=temperature, rng=rng, max_queue=max_queue,
             prefill_mode="bucketed", prefix_cache=True,
             block_size=block_size, kv_hbm_budget_mb=kv_pool_mb,
+            spec_decode=speculative, draft_k=draft_k, proposer=proposer,
         )
         prompts_np = np.asarray(prompts)
         history = [list(map(int, prompts_np[i])) for i in range(b)]
@@ -411,6 +422,19 @@ def main(argv=None) -> int:
     p.add_argument("--kv-pool-mb", type=float, default=0.0,
                    help="HBM budget for the prefix-cache block pool in "
                         "MiB (0 = one full context per slot)")
+    p.add_argument("--speculative", action="store_true",
+                   help="speculative decoding: model-free drafts "
+                        "verified in one fused forward; greedy only "
+                        "(requires --temperature 0), outputs stay "
+                        "bit-identical to plain decode")
+    p.add_argument("--draft-k", type=int, default=4,
+                   help="max draft tokens proposed per slot per step "
+                        "(adaptive-K shrinks below this on rejection)")
+    p.add_argument("--proposer", default="prompt",
+                   choices=["prompt", "radix"],
+                   help="draft source: prompt = n-gram lookup in the "
+                        "request's own context; radix = walk the "
+                        "--prefix-cache trie (requires --prefix-cache)")
     args = p.parse_args(argv)
     ctx = initialize_from_env()
     # Two-strike SIGTERM/SIGINT drain (util/signals.py, signals.go:26-40
@@ -443,6 +467,9 @@ def main(argv=None) -> int:
         prefix_cache=args.prefix_cache,
         block_size=args.block_size,
         kv_pool_mb=args.kv_pool_mb if args.kv_pool_mb > 0 else None,
+        speculative=args.speculative,
+        draft_k=args.draft_k,
+        proposer=args.proposer,
         stop=stop,
     )
     if metrics["interrupted"]:

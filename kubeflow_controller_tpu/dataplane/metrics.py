@@ -87,6 +87,22 @@ class ServingStats:
     admit_cache_size: int = 0
     pool_blocks_total: int = 0
     pool_blocks_in_use: int = 0
+    # Speculative decoding (docs/serving.md "Speculative decoding"):
+    # ``draft_proposed`` counts draft tokens sent to the verifier,
+    # ``draft_accepted`` those that committed (acceptance_rate is their
+    # ratio — the number adaptive-K is steering on), ``spec_steps``
+    # counts fused verify dispatches, and ``spec_step_tokens_hist``
+    # maps committed-tokens-per-slot-step (1..K+1) to occurrence count
+    # — the distribution behind the speedup claim.
+    # ``spec_probe_steps`` additionally counts every scheduling quantum
+    # that took the un-pipelined proposal path (a superset of
+    # spec_steps: probes that found no draft still paid the
+    # serialization) — the backoff tuning signal.
+    draft_proposed: int = 0
+    draft_accepted: int = 0
+    spec_steps: int = 0
+    spec_probe_steps: int = 0
+    spec_step_tokens_hist: Dict[int, int] = field(default_factory=dict)
 
     def record(self, completion) -> None:
         self.finished += 1
@@ -109,6 +125,15 @@ class ServingStats:
         if not self.prefix_lookup_tokens:
             return 0.0
         return self.prefix_hit_tokens / self.prefix_lookup_tokens
+
+    @property
+    def acceptance_rate(self) -> float:
+        """Fraction of proposed draft tokens the verifier committed
+        (0.0 before any proposal — an idle or non-speculative engine
+        stays JSON-clean)."""
+        if not self.draft_proposed:
+            return 0.0
+        return self.draft_accepted / self.draft_proposed
 
     def summary(self, wall_s: float = 0.0) -> Dict[str, float]:
         out = {
@@ -134,7 +159,18 @@ class ServingStats:
             "admit_cache_size": float(self.admit_cache_size),
             "pool_blocks_total": float(self.pool_blocks_total),
             "pool_blocks_in_use": float(self.pool_blocks_in_use),
+            "draft_proposed": float(self.draft_proposed),
+            "draft_accepted": float(self.draft_accepted),
+            "acceptance_rate": self.acceptance_rate,
+            "spec_steps": float(self.spec_steps),
+            "spec_probe_steps": float(self.spec_probe_steps),
         }
+        # Flatten the committed-tokens histogram into stable scalar keys
+        # (spec_step_tokens_1 .. spec_step_tokens_{K+1}) so the JSONL
+        # stays one flat record per line.
+        for n_tok in sorted(self.spec_step_tokens_hist):
+            out[f"spec_step_tokens_{n_tok}"] = float(
+                self.spec_step_tokens_hist[n_tok])
         if wall_s > 0:
             out["tokens_per_sec"] = self.tokens_out / wall_s
         return out

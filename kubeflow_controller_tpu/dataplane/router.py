@@ -156,10 +156,13 @@ class FleetRouter:
         self.ejections = 0
         self.readmissions = 0
         self.affinity_hits = 0
-        # Prefix accounting folded in from killed/replaced engines so
-        # fleet hit-rate survives chaos.
+        # Prefix + speculative-decoding accounting folded in from
+        # killed/replaced engines so fleet hit/acceptance rates survive
+        # chaos.
         self._retired_hit_tokens = 0
         self._retired_lookup_tokens = 0
+        self._retired_draft_proposed = 0
+        self._retired_draft_accepted = 0
 
     # -- fleet membership --------------------------------------------------
 
@@ -445,6 +448,8 @@ class FleetRouter:
     def _fold_stats(self, engine: ServingEngine) -> None:
         self._retired_hit_tokens += engine.stats.prefix_hit_tokens
         self._retired_lookup_tokens += engine.stats.prefix_lookup_tokens
+        self._retired_draft_proposed += engine.stats.draft_proposed
+        self._retired_draft_accepted += engine.stats.draft_accepted
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -457,6 +462,22 @@ class FleetRouter:
             h.engine.stats.prefix_lookup_tokens
             for h in self._replicas.values())
         return hit / lookup if lookup else 0.0
+
+    @property
+    def spec_acceptance_rate(self) -> float:
+        """Fleet-level draft acceptance across live AND retired engines
+        — the health signal for speculative decoding: a fleet-wide
+        collapse toward 0 means the traffic mix stopped rewarding
+        drafts (the engines' per-slot backoff is already limiting the
+        cost; this number says whether speculation is worth running at
+        all)."""
+        proposed = self._retired_draft_proposed + sum(
+            h.engine.stats.draft_proposed
+            for h in self._replicas.values())
+        accepted = self._retired_draft_accepted + sum(
+            h.engine.stats.draft_accepted
+            for h in self._replicas.values())
+        return accepted / proposed if proposed else 0.0
 
     def fleet_summary(self) -> Dict[str, float]:
         counts = self.outcome_counts
@@ -474,6 +495,7 @@ class FleetRouter:
             "readmissions": float(self.readmissions),
             "affinity_hits": float(self.affinity_hits),
             "prefix_hit_rate": self.prefix_hit_rate,
+            "spec_acceptance_rate": self.spec_acceptance_rate,
         }
 
 

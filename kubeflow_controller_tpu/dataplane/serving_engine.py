@@ -99,6 +99,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_controller_tpu.dataplane import kv_blocks
+from kubeflow_controller_tpu.dataplane import spec_decode as spec_decode_mod
 from kubeflow_controller_tpu.dataplane.metrics import MetricsLogger, ServingStats
 from kubeflow_controller_tpu.models import generate as gen
 from kubeflow_controller_tpu.models.transformer import (
@@ -236,6 +237,29 @@ class _Slot:
     # INACTIVE: decode dispatches skip it and its chunk tokens are never
     # booked).
     prefill: Optional[_Prefill] = None
+    # Speculative-decoding state (spec_decode=True engines only). The
+    # proposer needs the NEXT committed token (argmax of the carried
+    # logits) to extend the context it drafts from; it is fetched with
+    # the step that computed it and is None until the slot's first
+    # booked step — a fresh slot decodes plainly for one step, then
+    # starts drafting. ``spec_k`` is the per-slot adaptive draft length
+    # (shrinks toward the recently-accepted run, regrows on full
+    # accepts); ``spec_miss`` counts consecutive fruitless speculation
+    # rounds for this request. The backoff COOLDOWN itself lives on the
+    # engine per slot lane (``_spec_cooldown``/``_spec_backoff``), not
+    # here: "this traffic does not speculate" is a property of the
+    # stream a lane keeps serving, so it must outlive any one request
+    # — otherwise every admission restarts the ladder from zero and
+    # hostile traffic pays the un-pipelined probe steps over and over.
+    next_tok: Optional[int] = None
+    spec_k: int = 0
+    spec_miss: int = 0
+    # Consecutive full accepts since the last miss — the recovery
+    # hysteresis for a backed-off lane: one lucky 1-token probe accept
+    # (p = 1/vocab on random traffic) must not clear the backoff, so
+    # clearing takes either a full accept of a >= 2-token draft or two
+    # probe hits in a row.
+    spec_hits: int = 0
 
 
 class ServingEngine:
@@ -267,6 +291,11 @@ class ServingEngine:
         kv_hbm_budget_mb: Optional[float] = None,
         admit_cache_cap: int = 64,
         metrics_path: Optional[str] = None,
+        spec_decode: bool = False,
+        draft_k: int = 4,
+        proposer: object = "prompt",
+        spec_patience: int = 2,
+        spec_cooldown_max: int = 256,
     ):
         self.cfg = cfg
         self.params = params
@@ -326,6 +355,42 @@ class ServingEngine:
                     kv_pool_blocks = n_slots * self._max_blocks
             self._prefix_store = kv_blocks.PrefixStore(
                 cfg, self.block_size, int(kv_pool_blocks))
+        # Speculative decoding (docs/serving.md "Speculative decoding"):
+        # draft K tokens host-side (model-free proposers), verify all
+        # K+1 positions in ONE fused forward, commit the longest
+        # greedy-consistent run. Greedy-only: the acceptance rule that
+        # makes outputs provably identical is argmax equality, so a
+        # sampling engine must not silently change its distribution.
+        self.spec_decode = bool(spec_decode)
+        self.draft_k = int(draft_k)
+        self.spec_patience = max(1, int(spec_patience))
+        self.spec_cooldown_max = max(1, int(spec_cooldown_max))
+        # Per-LANE zero-accept backoff (see the _Slot comment): cooldown
+        # is steps left before the lane may propose again; backoff is
+        # the last cooldown applied, doubled on every relapse up to
+        # spec_cooldown_max. Deliberately NOT cleared by reset(): like
+        # the compiled step functions, it is adaptation to the traffic,
+        # not in-flight state.
+        self._spec_cooldown = [0] * n_slots
+        self._spec_backoff = [0] * n_slots
+        self._proposer: Optional[spec_decode_mod.DraftProposer] = None
+        if self.spec_decode:
+            if temperature > 0.0:
+                raise ValueError(
+                    "spec_decode requires temperature=0 (greedy): the "
+                    "accept rule is argmax equality — sampled decode "
+                    "through it would change the output distribution")
+            if self.draft_k < 1:
+                raise ValueError(f"draft_k must be >= 1 (got {draft_k})")
+            if isinstance(proposer, str):
+                self._proposer = spec_decode_mod.make_proposer(
+                    proposer, self._prefix_store)
+            elif isinstance(proposer, spec_decode_mod.DraftProposer):
+                self._proposer = proposer
+            else:
+                raise ValueError(
+                    f"proposer must be 'prompt', 'radix', or a "
+                    f"DraftProposer (got {proposer!r})")
         self._rng = rng if rng is not None else jax.random.key(0)
         self._clock = clock
         self._step_idx = 0
@@ -395,12 +460,48 @@ class ServingEngine:
                     else jax.random.split(key, chunk))
             (logits, cache, emitted), toks = jax.lax.scan(
                 body, (logits, cache, emitted), keys, length=chunk)
-            return toks, logits, cache, emitted      # toks: [chunk, B]
+            # next_tok: what each row's NEXT sampled token will be (the
+            # carried logits' argmax) — spec mode feeds it to the draft
+            # proposer; plain mode never fetches it.
+            next_tok = logits.argmax(-1).astype(jnp.int32)
+            return toks, next_tok, logits, cache, emitted
 
         # Donating the carried logits / cache / emitted lets XLA update
         # the KV pool in place instead of copying it every step (~30%
         # off the per-step dispatch on CPU tiny config).
         self._step_fn = jax.jit(_step, donate_argnums=(1, 2, 5))
+
+        # Speculative step: verify the host-proposed draft window in one
+        # fused forward (generate.verify_step_slots), commit the
+        # accepted run's KV/length, apply the SAME on-device retirement
+        # rule _micro applies (EOS inside the committed window, or
+        # budget exhausted by the multi-token commit). max_commit caps
+        # the accepted run at the row's remaining budget so a slot
+        # retires at EXACTLY max_new_tokens — a draft window crossing
+        # the budget boundary truncates, never overshoots.
+        if self.spec_decode:
+            k_draft = self.draft_k
+
+            def _spec(params, logits, cache, eos, budget, emitted,
+                      draft, dlen):
+                max_commit = jnp.maximum(budget - emitted, 1)
+                window, n, new_logits, cache = gen.verify_step_slots(
+                    cfg, params, draft, dlen, logits, cache, eos,
+                    max_commit)
+                emitted = emitted + n          # n = 0 on inactive rows
+                in_commit = (jnp.arange(k_draft + 1, dtype=jnp.int32)
+                             [None, :] < n[:, None])
+                committed_eos = (
+                    (window == eos[:, None]) & (eos[:, None] >= 0)
+                    & in_commit
+                ).any(axis=1)
+                done = cache.active & (committed_eos
+                                       | (emitted >= budget))
+                cache = cache._replace(active=cache.active & ~done)
+                next_tok = new_logits.argmax(-1).astype(jnp.int32)
+                return window, n, next_tok, new_logits, cache, emitted
+
+            self._spec_fn = jax.jit(_spec, donate_argnums=(1, 2, 5))
         # Exact-mode per-length admission memo, LRU-bounded (satellite of
         # the compile-explosion fix: even the fallback path cannot grow
         # without limit).
@@ -704,7 +805,7 @@ class ServingEngine:
                 )
                 self.slots[slot] = _Slot(
                     req=req, submit_t=q.submit_t, admit_t=now,
-                    deadline_t=q.deadline_t,
+                    deadline_t=q.deadline_t, spec_k=self.draft_k,
                 )
             else:
                 path: List[kv_blocks.RadixNode] = []
@@ -727,6 +828,7 @@ class ServingEngine:
                 self.slots[slot] = _Slot(
                     req=req, submit_t=q.submit_t, admit_t=now,
                     deadline_t=q.deadline_t, path=path,
+                    spec_k=self.draft_k,
                     prefill=_Prefill(
                         tokens=req.prompt, next_off=matched,
                         eos_val=(-1 if req.eos_id is None
@@ -820,7 +922,17 @@ class ServingEngine:
         amortizes over ``decode_chunk`` tokens per slot and the host's
         per-token work (device_get, bookkeeping, admission) overlaps
         device compute instead of serializing with it.
+
+        ``spec_decode=True`` engines route to :meth:`_step_spec`
+        instead: steps where some slot has a draft run the fused
+        verifier synchronously (the NEXT draft depends on this step's
+        committed tokens, so there is nothing to pipeline); steps where
+        no slot drafts — cold slots, cooldown backoff, incompressible
+        traffic — dispatch the SAME pipelined plain chunk as here, so
+        hostile traffic keeps plain-decode TPOT.
         """
+        if self.spec_decode:
+            return self._step_spec()
         finished: List[Completion] = list(self._done_buf)
         self._done_buf.clear()
         finished.extend(self._retire_due())
@@ -839,16 +951,290 @@ class ServingEngine:
             else:
                 self._step_idx += 1
                 key = jax.random.fold_in(self._rng, self._step_idx)
-            toks, self.logits, self.cache, self.emitted = self._step_fn(
-                self.params, self.logits, self.cache, self.eos,
-                self.budget, self.emitted, key)
-            dispatched = (toks, snapshot, n_decoding)
+            toks, next_tok, self.logits, self.cache, self.emitted = (
+                self._step_fn(
+                    self.params, self.logits, self.cache, self.eos,
+                    self.budget, self.emitted, key))
+            dispatched = (toks, next_tok, snapshot, n_decoding)
 
         finished.extend(self._process_pending())
         self._pending = dispatched
         self._admit_waiting()
         self._advance_prefills()
         self._sync_stats()
+        return finished
+
+    def _step_spec(self) -> List[Completion]:
+        """One scheduling quantum with speculative decoding. Ordering
+        differs from :meth:`step` because drafting depends on the last
+        committed token: the previous dispatch books FIRST (it carries
+        each surviving slot's ``next_tok``), then the proposer runs over
+        the live contexts, and the dispatch is either the fused
+        draft-verify step (booked synchronously — its output feeds the
+        next proposal) or, when nothing drafts, the plain pipelined
+        chunk. Retirement, admission, and chunked prefill are shared
+        with the plain path unchanged — deadline/cancel retirement
+        clears the row's ``active`` bit before dispatch, the verifier
+        commits nothing on inactive rows (``n = 0``), and neighbors'
+        committed streams are untouched (row-independent math)."""
+        finished: List[Completion] = list(self._done_buf)
+        self._done_buf.clear()
+        finished.extend(self._retire_due())
+        # Decide serialized-probe vs pipelined BEFORE paying for it: a
+        # lane is probe-worthy only if it is decoding, out of cooldown,
+        # AND a cheap host-side scan of its already-booked context finds
+        # a draft candidate. The booked context trails the device by up
+        # to one pipelined chunk, but n-gram/trie candidates are sticky
+        # at that horizon — and a no-candidate scan costs microseconds
+        # where a serialized no-match probe quantum costs a dispatch
+        # bubble. Scanning fruitlessly counts as the miss it is, so
+        # incompressible traffic backs the scan itself off too.
+        probe = False
+        for i, s in enumerate(self.slots):
+            if s is None or s.prefill is not None:
+                continue
+            if self._spec_cooldown[i] > 0:
+                continue
+            ctx = np.concatenate([
+                s.req.prompt, np.asarray(s.tokens, np.int32)])
+            if self._proposer.has_candidate(ctx):
+                probe = True
+            else:
+                self._note_spec_miss(i, s)
+        if not probe:
+            # No probe-worthy lane (the steady state on incompressible
+            # traffic once backoff engages): skip the proposal round
+            # entirely and run the EXACT plain pipelined quantum —
+            # dispatch first, book the previous chunk while the device
+            # works. The serial propose -> verify -> book ordering
+            # below costs that overlap, which is only worth paying
+            # when some slot might actually draft; this branch is what
+            # caps hostile-traffic TPOT at plain-decode TPOT.
+            dispatched = None
+            snapshot_p: List[Optional[_Slot]] = [
+                s if (s is not None and s.prefill is None) else None
+                for s in self.slots
+            ]
+            if sum(s is not None for s in snapshot_p) > 0:
+                for i, s in enumerate(snapshot_p):
+                    if s is not None and self._spec_cooldown[i] > 0:
+                        self._spec_cooldown[i] -= 1
+                toks, next_tok, self.logits, self.cache, self.emitted = (
+                    self._step_fn(
+                        self.params, self.logits, self.cache, self.eos,
+                        self.budget, self.emitted, None))
+                dispatched = (toks, next_tok, snapshot_p,
+                              sum(s is not None for s in snapshot_p))
+            finished.extend(self._process_pending())
+            self._pending = dispatched
+            self._admit_waiting()
+            self._advance_prefills()
+            self._sync_stats()
+            return finished
+        finished.extend(self._process_pending())
+        snapshot: List[Optional[_Slot]] = [
+            s if (s is not None and s.prefill is None) else None
+            for s in self.slots
+        ]
+        n_decoding = sum(s is not None for s in snapshot)
+        if n_decoding > 0:
+            self.stats.spec_probe_steps += 1
+            proposal = self._propose_drafts(snapshot)
+            if proposal is not None:
+                draft, dlen = proposal
+                window, n, next_tok, self.logits, self.cache, \
+                    self.emitted = self._spec_fn(
+                        self.params, self.logits, self.cache, self.eos,
+                        self.budget, self.emitted,
+                        jnp.asarray(draft), jnp.asarray(dlen))
+                # One transfer for all three outputs: the spec step is
+                # synchronous (the next proposal needs these), so every
+                # extra device_get round-trip lands on the critical path.
+                window_np, n_np, next_np = jax.device_get(
+                    (window, n, next_tok))
+                finished.extend(self._book_spec(
+                    snapshot, np.asarray(window_np), np.asarray(n_np),
+                    np.asarray(next_np), dlen))
+            else:
+                # No drafts anywhere: plain chunk, pipelined one deep
+                # exactly like the non-spec engine — this is the path
+                # incompressible traffic settles into under backoff.
+                toks, next_tok, self.logits, self.cache, self.emitted = (
+                    self._step_fn(
+                        self.params, self.logits, self.cache, self.eos,
+                        self.budget, self.emitted, None))
+                self._pending = (toks, next_tok, snapshot, n_decoding)
+        self._admit_waiting()
+        self._advance_prefills()
+        self._sync_stats()
+        return finished
+
+    def _propose_drafts(self, snapshot):
+        """Collect draft proposals for every slot eligible to speculate
+        this step. Returns ``(draft [B, K] int32, dlen [B] int32)`` or
+        None when no slot has a non-empty draft (the caller falls back
+        to the plain chunk). Eligibility is host-local: the slot is
+        decoding, knows its next committed token, has >= 2 tokens of
+        budget left (committing the draft's first token plus one more
+        must be possible — otherwise speculation cannot beat the plain
+        step), is not an EOS away from retiring, and is not in
+        zero-accept cooldown. Cooldown ticks down HERE, on every step
+        the slot sits out, so a backed-off slot probes again after
+        ``spec_backoff`` steps."""
+        k = self.draft_k
+        contexts: List[Optional[np.ndarray]] = [None] * self.n_slots
+        caps = np.zeros((self.n_slots,), np.int32)
+        for i, slot in enumerate(snapshot):
+            if slot is None:
+                continue
+            if self._spec_cooldown[i] > 0:
+                self._spec_cooldown[i] -= 1
+                continue
+            if slot.next_tok is None:
+                continue                  # first step after admission
+            remaining = slot.req.max_new_tokens - len(slot.tokens) - 1
+            if remaining < 1:
+                continue                  # next_tok retires the slot
+            if (slot.req.eos_id is not None
+                    and slot.next_tok == slot.req.eos_id):
+                continue                  # nothing follows EOS
+            caps[i] = min(max(1, slot.spec_k), remaining, k)
+            if self._spec_backoff[i] > 0 and slot.spec_hits == 0:
+                # Backed-off lane probing after cooldown: draft at most
+                # ONE token, so a spurious match cannot buy a full-width
+                # garbage verify — hostile traffic pays <= 1 extra
+                # verify position per probe. A probe hit (spec_hits > 0)
+                # lifts the cap for the follow-up draft, and only a
+                # full accept at that width clears the backoff.
+                caps[i] = 1
+            contexts[i] = np.concatenate([
+                slot.req.prompt,
+                np.asarray(slot.tokens + [slot.next_tok], np.int32)])
+        if not any(c is not None for c in contexts):
+            return None
+        draft, lens = self._proposer.propose(contexts, k)
+        lens = np.minimum(np.asarray(lens, np.int32), caps)
+        # Drop drafts too short to beat the plain path: a pipelined
+        # chunk commits ``decode_chunk`` tokens per quantum while a
+        # verify quantum is serialized (~2x the dispatch cost), so a
+        # draft must be able to commit ~2*decode_chunk tokens to win.
+        # Probes (cap 1) are exempt — their value is the backoff
+        # decision, not throughput — and so are budget-capped drafts
+        # (caps[i] == remaining: full acceptance retires the request
+        # this quantum, which no chunk can beat).
+        min_len = 2 * self.decode_chunk
+        for i in range(self.n_slots):
+            if caps[i] > 1 and 0 < lens[i] < min(min_len, int(caps[i])):
+                lens[i] = 0
+        # A proposer that found nothing (or nothing long enough) for an
+        # eligible slot is a miss too: without this, incompressible
+        # traffic never enters cooldown (no draft -> no verify -> no
+        # zero-accept) and pays the un-pipelined proposal round every
+        # single step.
+        for i, slot in enumerate(snapshot):
+            if contexts[i] is not None and lens[i] == 0:
+                self._note_spec_miss(i, slot)
+        if not lens.any():
+            return None
+        return np.asarray(draft, np.int32), lens
+
+    def _note_spec_miss(self, i: int, slot: _Slot) -> None:
+        """One fruitless speculation round (no match, or a verified
+        draft with zero accepts) on lane ``i``. The initial descent
+        takes ``spec_patience`` consecutive misses; once backoff has
+        engaged, a SINGLE fruitless probe re-enters cooldown with the
+        doubled interval (capped at ``spec_cooldown_max``) — hostile
+        traffic converges to plain decode with a vanishing probe
+        rate."""
+        slot.spec_hits = 0
+        slot.spec_miss += 1
+        if (self._spec_backoff[i] > 0
+                or slot.spec_miss >= self.spec_patience):
+            self._spec_backoff[i] = min(
+                max(4, self._spec_backoff[i] * 2),
+                self.spec_cooldown_max)
+            self._spec_cooldown[i] = self._spec_backoff[i]
+            slot.spec_miss = 0
+
+    def _book_spec(self, snapshot, window, n, next_tok,
+                   dlen) -> List[Completion]:
+        """Book one fused verify step: per surviving snapshot row,
+        record the ``n[i]`` committed window tokens through the shared
+        EOS/budget rule, update acceptance stats and the per-slot
+        adaptive-K / backoff state, and stash ``next_tok`` for the next
+        proposal round. Rows retired host-side between dispatch and
+        booking fail the snapshot-identity check and their committed
+        tokens are discarded — same rule as the plain chunk path."""
+        now = self._clock()
+        self.stats.steps += 1
+        self.stats.spec_steps += 1
+        finished: List[Completion] = []
+        for i, slot in enumerate(snapshot):
+            if slot is None or self.slots[i] is not slot:
+                continue
+            n_i = int(n[i])
+            if n_i <= 0:
+                continue
+            hist = self.stats.spec_step_tokens_hist
+            hist[n_i] = hist.get(n_i, 0) + 1
+            d = int(dlen[i])
+            accepted = min(n_i - 1, d)
+            if d > 0:
+                self.stats.draft_proposed += d
+                self.stats.draft_accepted += accepted
+                if accepted >= d:
+                    # Full accept: regrow toward the configured K —
+                    # doubling, not +1, so recovered traffic reaches
+                    # full-width drafts in O(log K) quanta instead of
+                    # crawling through K sub-chunk-sized verifies. A
+                    # probe hit (1-token draft on a backed-off lane)
+                    # jumps straight to full width: the probe's whole
+                    # job was that binary decision, and a wrong jump
+                    # costs one garbage verify before re-cooling.
+                    if self._spec_backoff[i] > 0 and d == 1:
+                        slot.spec_k = self.draft_k
+                    else:
+                        slot.spec_k = min(self.draft_k,
+                                          max(1, slot.spec_k) * 2)
+                    slot.spec_miss = 0
+                    slot.spec_hits += 1
+                    # Forgiving the lane's backoff takes real evidence —
+                    # a >= 2-token full accept, or two consecutive probe
+                    # hits. A single accepted 1-token probe is 1/vocab
+                    # likely on pure noise; zeroing backoff on it would
+                    # let luck restart the ramp and probe-storm a
+                    # settled lane.
+                    if d >= 2 or slot.spec_hits >= 2:
+                        self._spec_backoff[i] = 0
+                        slot.spec_hits = 0
+                elif accepted == 0:
+                    slot.spec_k = max(1, slot.spec_k // 2)
+                    self._note_spec_miss(i, slot)
+                else:
+                    # Partial accept: track the run the traffic supports.
+                    slot.spec_k = max(1, accepted + 1)
+                    slot.spec_miss = 0
+                    slot.spec_hits = 0
+            # Only the LAST committed token can finish the request:
+            # verify_step_slots truncated n at the first committed EOS
+            # (eos_pos + 1) and at the remaining budget (max_commit),
+            # so positions 0..n-2 are guaranteed non-final. Book them
+            # in bulk — the per-token call would dominate spec-step
+            # host time at large K — and route only the final token
+            # through the shared retirement rule.
+            if n_i > 1:
+                if slot.first_token_t is None:
+                    slot.first_token_t = now
+                slot.tokens.extend(int(t) for t in window[i, :n_i - 1])
+                self.stats.tokens_out += n_i - 1
+                self.stats.active_slot_steps += n_i - 1
+            comp = self._book_token(i, slot, int(window[i, n_i - 1]), now)
+            if comp is not None:
+                finished.append(comp)
+            else:
+                slot.next_tok = int(next_tok[i])
+        for c in finished:
+            self.stats.record(c)
         return finished
 
     def _sync_stats(self) -> None:
@@ -861,6 +1247,50 @@ class ServingEngine:
             self.stats.pool_blocks_in_use = (
                 self._prefix_store.pool.used_blocks)
 
+    def _book_token(self, i: int, slot: _Slot, tok: int,
+                    now: float) -> Optional[Completion]:
+        """Record ONE committed token against a live slot and apply the
+        host half of the retirement rule (EOS / budget — the same rule
+        the device applied). Returns the Completion when this token
+        finishes the request, else None. Shared by the plain chunk
+        booking path and the speculative commit path: one retirement
+        rule, two schedulers, so a spec-committed stream retires at
+        exactly the token the plain path would."""
+        req = slot.req
+        if slot.first_token_t is None:
+            slot.first_token_t = now
+        slot.tokens.append(tok)
+        self.stats.tokens_out += 1
+        # Useful-work accounting: slot-steps that produced a RECORDED
+        # token (idle lag + dead chunk tail excluded; a spec step can
+        # book several per slot-step, so utilization may exceed 1).
+        self.stats.active_slot_steps += 1
+        done_eos = req.eos_id is not None and tok == req.eos_id
+        if not done_eos and len(slot.tokens) < req.max_new_tokens:
+            return None
+        if self._prefix_store is not None:
+            # RadixAttention semantics: the finished row's DECODED
+            # tokens join the trie too (their KV is already in the row
+            # — every committed token's KV landed before the row went
+            # inactive), so a follow-up turn whose prompt extends this
+            # conversation reuses reply blocks, not just prompt blocks.
+            full = np.concatenate([
+                req.prompt, np.asarray(slot.tokens, np.int32)])
+            self._prefix_store.insert_from_row(
+                full, self.cache.k, self.cache.v, i,
+                known_path=slot.path)
+        self._release_pins(slot)
+        comp = Completion(
+            rid=req.rid, tokens=slot.tokens,
+            finish_reason="eos" if done_eos else "length",
+            submit_t=slot.submit_t,
+            first_token_t=slot.first_token_t, done_t=now,
+            admit_t=slot.admit_t,
+        )
+        self.slots[i] = None
+        self._rids.discard(req.rid)
+        return comp
+
     def _process_pending(self) -> List[Completion]:
         """Book the token chunk of the previous dispatch (if any):
         record tokens against the slots captured AT dispatch time,
@@ -868,12 +1298,22 @@ class ServingEngine:
         device applied, so the host stops recording exactly where the
         row went inactive and the rest of the chunk row is discarded
         garbage. A snapshot row whose slot has since been freed or
-        reassigned is skipped entirely."""
+        reassigned is skipped entirely. In spec mode the dispatch also
+        carried each row's next committed token; surviving slots stash
+        it for the next proposal round."""
         if self._pending is None:
             return []
-        toks_dev, snapshot, _ = self._pending
+        toks_dev, next_dev, snapshot, _ = self._pending
         self._pending = None
-        toks_np = np.asarray(jax.device_get(toks_dev))   # [chunk, B]
+        if self.spec_decode:
+            # One transfer for both: this fetch blocks on the chunk, so
+            # a second round-trip would land on the critical path.
+            toks_np, next_np = jax.device_get((toks_dev, next_dev))
+            toks_np = np.asarray(toks_np)    # [chunk, B]
+            next_np = np.asarray(next_np)
+        else:
+            toks_np = np.asarray(jax.device_get(toks_dev))   # [chunk, B]
+            next_np = None
         now = self._clock()
         self.stats.steps += toks_np.shape[0]
 
@@ -881,43 +1321,14 @@ class ServingEngine:
         for i, slot in enumerate(snapshot):
             if slot is None or self.slots[i] is not slot:
                 continue
-            req = slot.req
+            comp = None
             for k in range(toks_np.shape[0]):
-                tok = int(toks_np[k, i])
-                if slot.first_token_t is None:
-                    slot.first_token_t = now
-                slot.tokens.append(tok)
-                self.stats.tokens_out += 1
-                # Useful-work accounting: slot-steps that produced a
-                # RECORDED token (idle lag + dead chunk tail excluded).
-                self.stats.active_slot_steps += 1
-                done_eos = req.eos_id is not None and tok == req.eos_id
-                if done_eos or len(slot.tokens) >= req.max_new_tokens:
-                    if self._prefix_store is not None:
-                        # RadixAttention semantics: the finished row's
-                        # DECODED tokens join the trie too (their KV is
-                        # already in the row — every emitted token was
-                        # fed through decode before the row went
-                        # inactive), so a follow-up turn whose prompt
-                        # extends this conversation reuses reply blocks,
-                        # not just prompt blocks.
-                        full = np.concatenate([
-                            req.prompt,
-                            np.asarray(slot.tokens, np.int32)])
-                        self._prefix_store.insert_from_row(
-                            full, self.cache.k, self.cache.v, i,
-                            known_path=slot.path)
-                    self._release_pins(slot)
-                    finished.append(Completion(
-                        rid=req.rid, tokens=slot.tokens,
-                        finish_reason="eos" if done_eos else "length",
-                        submit_t=slot.submit_t,
-                        first_token_t=slot.first_token_t, done_t=now,
-                        admit_t=slot.admit_t,
-                    ))
-                    self.slots[i] = None
-                    self._rids.discard(req.rid)
+                comp = self._book_token(i, slot, int(toks_np[k, i]), now)
+                if comp is not None:
+                    finished.append(comp)
                     break
+            if comp is None and next_np is not None:
+                slot.next_tok = int(next_np[i])
 
         for c in finished:
             self.stats.record(c)

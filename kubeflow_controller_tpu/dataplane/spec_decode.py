@@ -1,0 +1,238 @@
+"""Model-free draft proposers for speculative decoding.
+
+Speculative decoding (Leviathan et al., "Fast Inference from
+Transformers via Speculative Decoding") splits a decode step into
+*draft* and *verify*: something cheap guesses the next K tokens, one
+fused forward pass (``generate.verify_step_slots``) scores all K+1
+positions, and the longest greedy-consistent run commits. With greedy
+acceptance the committed stream is provably the stream plain decode
+would have produced — speculation changes latency, never output.
+
+This module is the *draft* half. No draft model: both proposers guess
+from token statistics the serving stack already holds, so a wrong
+guess costs only the wasted verify positions (and the engine's
+adaptive-K backoff drives even that to ~zero on incompressible
+traffic):
+
+* :class:`PromptLookupProposer` — vLLM's ``ngram`` backend idea
+  (prompt lookup decoding): match the LAST n-gram of the request's own
+  prompt + emitted tokens against its earlier history and propose the
+  tokens that followed the most recent earlier occurrence. Free wins on
+  extraction, summarization, code edits — anything that re-emits its
+  input.
+* :class:`RadixProposer` — walk the prefix-cache radix trie
+  (:class:`~kubeflow_controller_tpu.dataplane.kv_blocks.RadixCache`)
+  from the slot's current context and propose the cached continuation.
+  The trie already stores every served prompt AND reply
+  block-granularly, so repeat traffic (retries, fan-out sampling,
+  agent loops re-running a conversation) drafts the previous reply —
+  which greedy decode will reproduce exactly, giving ~100% acceptance.
+  The walk is STRICTLY read-only: no pins, no refcounts, no LRU
+  touches (pinned by tests/test_spec_decode.py) — a proposer must
+  never extend block lifetimes or perturb eviction order.
+
+Contract (shared by both): ``propose(contexts, k)`` takes one optional
+1-D int32 context per slot (prompt + emitted tokens + the next
+committed token; None = slot not drafting) and returns a padded
+``[B, k]`` int32 draft array plus per-row valid lengths ``[B]``.
+Proposals are deterministic functions of the contexts, never longer
+than ``k``, and every proposed token is copied from the context /
+trie — nothing is invented, nothing past valid history is read.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubeflow_controller_tpu.dataplane.kv_blocks import (
+    PrefixStore, RadixNode,
+)
+
+
+class DraftProposer:
+    """Interface: batched, deterministic, model-free draft proposal."""
+
+    def propose(
+        self,
+        contexts: Sequence[Optional[np.ndarray]],
+        k: int,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """``contexts[b]`` is the slot's full token context (1-D int32:
+        prompt + emitted + next committed token) or None when the slot
+        is not drafting this step. Returns ``(draft [B, k] int32 padded
+        with zeros, lens [B] int32 in [0, k])``."""
+        raise NotImplementedError
+
+    def has_candidate(self, ctx: np.ndarray) -> bool:
+        """Cheap host-side pre-filter: could :meth:`propose` return a
+        non-empty draft for this one context? The serving engine calls
+        this before committing to a serialized proposal round — a
+        no-candidate answer keeps the quantum on the pipelined plain
+        path. Default: run a k=1 proposal."""
+        _, lens = self.propose([ctx], 1)
+        return bool(lens[0])
+
+
+class PromptLookupProposer(DraftProposer):
+    """Prompt-lookup (n-gram) drafting from the request's own context.
+
+    For n from ``ngram_max`` down to ``ngram_min``: take the context's
+    last n tokens, find the most recent earlier occurrence of that
+    n-gram that has a full ``k``-token continuation (nearest occurrence
+    as fallback), and propose up to ``k`` of the tokens that followed
+    it. First n that matches wins (longer n-grams give
+    higher-precision drafts).
+
+    ``ngram_min`` defaults to 2: on incompressible (random-token)
+    traffic a single-token match fires constantly and every draft is
+    garbage; 2-grams make spurious matches vanishingly rare while
+    repetitive text still matches at n=2+ immediately.
+    """
+
+    def __init__(self, ngram_max: int = 3, ngram_min: int = 2):
+        if ngram_min < 1 or ngram_max < ngram_min:
+            raise ValueError(
+                f"need 1 <= ngram_min <= ngram_max "
+                f"(got {ngram_min}, {ngram_max})")
+        self.ngram_max = ngram_max
+        self.ngram_min = ngram_min
+
+    def _match(self, ctx: np.ndarray, k: int) -> np.ndarray:
+        n_ctx = ctx.size
+        for n in range(min(self.ngram_max, n_ctx - 1), self.ngram_min - 1,
+                       -1):
+            tail = ctx[n_ctx - n:]
+            # Earlier occurrences with a continuation: start positions
+            # n_ctx-n-1 ... 0 (the occurrence at n_ctx-n is the tail
+            # itself — no continuation). Vectorized sliding-window
+            # compare — this scan runs on the engine's critical path
+            # every decode step, so a Python loop over positions would
+            # show up directly in TPOT.
+            win = np.lib.stride_tricks.sliding_window_view(
+                ctx[:n_ctx - 1], n)            # starts 0 .. n_ctx-n-1
+            hits = np.flatnonzero((win == tail).all(axis=1))
+            if hits.size:
+                # Prefer the most recent occurrence that still has a
+                # FULL k-token continuation. On looping tails (the
+                # n-gram repeats right up to the context end) the
+                # nearest occurrence sits a token or two from the end
+                # and would truncate the draft to almost nothing —
+                # exactly the traffic where a full-width draft pays
+                # most. Fall back to the nearest occurrence when no
+                # hit has k tokens of continuation.
+                full = hits[hits + n + k <= n_ctx]
+                s = int(full[-1]) if full.size else int(hits[-1])
+                return ctx[s + n:s + n + k]
+            # fall through to a shorter n-gram
+        return ctx[:0]
+
+    def propose(self, contexts, k):
+        b = len(contexts)
+        draft = np.zeros((b, k), np.int32)
+        lens = np.zeros((b,), np.int32)
+        for i, ctx in enumerate(contexts):
+            if ctx is None:
+                continue
+            ctx = np.asarray(ctx, np.int32).reshape(-1)
+            if ctx.size < self.ngram_min + 1:
+                continue                  # too short to have a match
+            got = self._match(ctx, k)
+            draft[i, :got.size] = got
+            lens[i] = got.size
+        return draft, lens
+
+
+class RadixProposer(DraftProposer):
+    """Draft from the prefix-cache radix trie's cached continuations.
+
+    The context walks the trie block by block (exact match, the trie's
+    own granularity); the remainder (< block_size tokens) must prefix
+    exactly one child's key, and the draft is that child's remaining
+    tokens followed by a deterministic descent (most recently used
+    child, node key as tiebreak) until ``k`` tokens are drafted or the
+    chain ends. Any mismatch anywhere -> no draft: the trie holds
+    *exact* served continuations, and a partial mismatch means this
+    context diverged from everything cached.
+
+    Read-only by contract: the walk calls neither ``acquire`` (no pins
+    — a draft must not extend block lifetime; the KV bytes are never
+    touched, only the token keys) nor ``match`` (which bumps LRU
+    ``last_use`` — drafting must not perturb eviction order). Pinned by
+    tests/test_spec_decode.py composed with the kv_blocks leak checks.
+    """
+
+    def __init__(self, store: PrefixStore):
+        self.store = store
+
+    @staticmethod
+    def _best_child(node: RadixNode) -> Optional[RadixNode]:
+        if not node.children:
+            return None
+        return max(node.children.values(),
+                   key=lambda c: (c.last_use, c.key))
+
+    def propose(self, contexts, k):
+        b = len(contexts)
+        draft = np.zeros((b, k), np.int32)
+        lens = np.zeros((b,), np.int32)
+        trie = self.store.trie
+        bs = trie.block_size
+        for i, ctx in enumerate(contexts):
+            if ctx is None:
+                continue
+            toks = [int(t) for t in np.asarray(ctx, np.int32).reshape(-1)]
+            node = trie.root
+            # Pure read walk over full blocks (RadixCache.match without
+            # the _touch): a missing block means nothing cached extends
+            # this context.
+            n_full = (len(toks) // bs) * bs
+            matched = True
+            for s in range(0, n_full, bs):
+                child = node.children.get(tuple(toks[s:s + bs]))
+                if child is None:
+                    matched = False
+                    break
+                node = child
+            if not matched:
+                continue
+            tail = tuple(toks[n_full:])
+            out: List[int] = []
+            if tail:
+                # The remainder must prefix exactly one child edge.
+                nxt = next(
+                    (c for key, c in node.children.items()
+                     if key[:len(tail)] == tail), None)
+                if nxt is None:
+                    continue
+                out.extend(nxt.key[len(tail):])
+                node = nxt
+            while len(out) < k:
+                nxt = self._best_child(node)
+                if nxt is None:
+                    break
+                out.extend(nxt.key)
+                node = nxt
+            got = np.asarray(out[:k], np.int32)
+            draft[i, :got.size] = got
+            lens[i] = got.size
+        return draft, lens
+
+
+def make_proposer(
+    name: str, store: Optional[PrefixStore] = None,
+) -> DraftProposer:
+    """Build a proposer by CLI name. ``radix`` requires the engine's
+    prefix store (``prefix_cache=True``) — there is nothing to walk
+    without the trie."""
+    if name == "prompt":
+        return PromptLookupProposer()
+    if name == "radix":
+        if store is None:
+            raise ValueError(
+                "proposer='radix' requires prefix_cache=True "
+                "(the radix trie is the draft source)")
+        return RadixProposer(store)
+    raise ValueError(
+        f"unknown proposer {name!r} (expected 'prompt' or 'radix')")

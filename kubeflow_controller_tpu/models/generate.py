@@ -752,6 +752,166 @@ def prefill_chunk_into_slot(
     )
 
 
+def verify_step_slots(
+    cfg: TransformerConfig,
+    params: Params,
+    draft: jax.Array,           # [B, K] int32 — proposed continuations
+    draft_len: jax.Array,       # [B] int32 in [0, K] — valid drafts/row
+    logits: jax.Array,          # [B, vocab] — carried last-position logits
+    cache: SlotKVCache,
+    eos: jax.Array,             # [B] int32 — per-row EOS id (-1 = none)
+    max_commit: jax.Array,      # [B] int32 — commit budget cap, >= 1
+) -> Tuple[jax.Array, jax.Array, jax.Array, SlotKVCache]:
+    """Fused speculative-decoding verifier: score K+1 positions per slot
+    in ONE forward pass, accept the longest greedy-consistent run, and
+    commit exactly the accepted tokens' KV — nothing else.
+
+    Per row, the verify *window* is ``[t0, draft_0, ..., draft_{K-1}]``
+    where ``t0 = argmax(logits)`` is the token greedy decode would emit
+    next anyway. The window runs through ``prefill_chunk_into_slot``'s
+    math batched over slots at per-row offsets (``prefill_continue``'s
+    layer body verbatim): position j sits at absolute offset
+    ``length[b] + j``, attends to the row's cached columns
+    ``< length[b]`` plus intra-window causal positions, RoPE at the
+    absolute offsets, one fp32 softmax over the concatenated scores,
+    MoE branch included. Greedy acceptance (Leviathan et al.): draft_j
+    is accepted iff every earlier draft was and
+    ``argmax(window_logits[j]) == draft_j`` — so the committed stream is
+    the stream plain ``decode_step_slots`` would have produced, token
+    for token (pinned bitwise by tests/test_spec_decode.py; the same
+    empirical backend-determinism contract chunked prefill pins).
+
+    The accepted count ``n`` (1 <= n <= K+1 on active rows; 0 on
+    inactive rows) is further truncated by ``max_commit`` (budget: a
+    row never commits past its remaining token budget) and by EOS (the
+    window is cut just after the first committed EOS — tokens "after"
+    an EOS must not exist, let alone leave KV behind). *Rollback is
+    by never committing*: the window's k/v are scan outputs, not cache
+    writes — only columns ``length[b] + [0, n)`` scatter into the row
+    (``mode="drop"`` sentinel columns discard the rest), so rejected
+    and padded positions leave no trace and the row's next write lands
+    exactly where decode would have put it.
+
+    Returns ``(window [B, K+1], n_commit [B], new_logits [B, vocab],
+    cache)``: ``new_logits`` is the window logits at position n-1 — the
+    carried logits for the NEXT step, exactly what decode_step_slots
+    would have carried after emitting the same n tokens.
+    """
+    b, k_draft = draft.shape
+    w = k_draft + 1
+    dt = cfg.dtype
+    hd = cfg.head_dim
+    max_seq = cache.k.shape[2]
+    rep = cfg.n_heads // cfg.n_kv_heads
+    pos0 = cache.length                              # [B]
+
+    t0 = logits.argmax(-1).astype(jnp.int32)
+    window = jnp.concatenate(
+        [t0[:, None], draft.astype(jnp.int32)], axis=1)   # [B, W]
+
+    x = params["embed"].astype(dt)[window]           # [B, W, D]
+    positions = pos0[:, None] + jnp.broadcast_to(
+        jnp.arange(w, dtype=jnp.int32), (b, w))
+    if cfg.moe_experts:
+        moe_cfg = cfg.replace(
+            moe_capacity_factor=float(cfg.moe_experts) / cfg.moe_top_k
+        )
+    cache_cols = jnp.arange(max_seq, dtype=jnp.int32)
+    causal = (
+        jnp.arange(w, dtype=jnp.int32)[:, None]
+        >= jnp.arange(w, dtype=jnp.int32)[None, :]
+    )                                                # [W, W]
+
+    def body(x, layer_in):
+        lp, kc, vc = layer_in                        # kc [B,max_seq,KVH,D]
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ _w(lp, "wq", dt)).reshape(b, w, cfg.n_heads, hd)
+        k = (h @ _w(lp, "wk", dt)).reshape(b, w, cfg.n_kv_heads, hd)
+        v = (h @ _w(lp, "wv", dt)).reshape(b, w, cfg.n_kv_heads, hd)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        qg = q.reshape(b, w, cfg.n_kv_heads, rep, hd)
+        scale = hd ** -0.5
+        s_cache = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, kc,
+            preferred_element_type=jnp.float32,
+        ) * scale                                    # [B,G,rep,W,max_seq]
+        s_cache = jnp.where(
+            (cache_cols[None, :] < pos0[:, None])[:, None, None, None, :],
+            s_cache, -1e30,
+        )
+        s_new = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, k,
+            preferred_element_type=jnp.float32,
+        ) * scale                                    # [B,G,rep,W,W]
+        s_new = jnp.where(causal[None, None, None], s_new, -1e30)
+        p = jax.nn.softmax(
+            jnp.concatenate([s_cache, s_new], axis=-1), axis=-1
+        ).astype(dt)
+        attn = (
+            jnp.einsum("bgrqk,bkgd->bqgrd", p[..., :max_seq], vc)
+            + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., max_seq:], v)
+        ).reshape(b, w, -1)
+        x = x + attn @ _w(lp, "wo", dt)
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        if cfg.moe_experts:
+            down, _aux = tfm._moe_ffn(moe_cfg, _dense_lp(lp, dt), h2)
+            x = x + down
+        else:
+            gate = jax.nn.silu(h2 @ _w(lp, "w_gate", dt))
+            up = h2 @ _w(lp, "w_up", dt)
+            x = x + (gate * up) @ _w(lp, "w_down", dt)
+        return x, (k, v)                             # [B, W, KVH, D]
+
+    x, (k_win, v_win) = lax.scan(
+        body, x, (params["layers"], cache.k, cache.v))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    all_logits = _head_logits(cfg, params, x)        # [B, W, vocab]
+
+    # Greedy acceptance: draft_j survives iff it equals the model's
+    # argmax at the preceding window position AND every earlier draft
+    # survived (cumprod), AND it lies inside the row's valid draft run.
+    preds = all_logits.argmax(-1).astype(jnp.int32)  # [B, W]
+    ok = (
+        (window[:, 1:] == preds[:, :-1])
+        & (jnp.arange(k_draft, dtype=jnp.int32)[None, :]
+           < draft_len[:, None])
+    )
+    acc = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
+    n = 1 + acc                                      # [B], 1..K+1
+    # Budget truncation: never commit past the remaining token budget.
+    n = jnp.minimum(n, jnp.maximum(max_commit, 1))
+    # EOS truncation: cut just after the first committed EOS — decode
+    # would have stopped there, so later window tokens must not commit.
+    is_eos = (window == eos[:, None]) & (eos[:, None] >= 0)
+    eos_pos = jnp.argmax(is_eos, axis=1).astype(jnp.int32)
+    has_eos = is_eos.any(axis=1)
+    n = jnp.where(has_eos & (eos_pos < n), eos_pos + 1, n)
+    n = jnp.where(cache.active, n, 0).astype(jnp.int32)
+
+    # Commit KV for accepted positions only: columns length + [0, n)
+    # scatter in place; everything else goes to the max_seq sentinel
+    # column and is dropped — rejected/pad KV never enters the cache.
+    wcols = pos0[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    wcols = jnp.where(
+        jnp.arange(w, dtype=jnp.int32)[None, :] < n[:, None],
+        wcols, max_seq)                              # [B, W]
+    rows = jnp.arange(b)[:, None]
+    k_all = cache.k.at[:, rows, wcols].set(
+        k_win.astype(cache.k.dtype), mode="drop")    # k_win [L,B,W,KVH,D]
+    v_all = cache.v.at[:, rows, wcols].set(
+        v_win.astype(cache.v.dtype), mode="drop")
+
+    # Carried logits for the next step: window position n-1 (the last
+    # committed token's output distribution). Inactive rows (n = 0)
+    # clamp to 0; their logits row is dead weight either way.
+    idx = jnp.clip(n - 1, 0, k_draft)
+    new_logits = jnp.take_along_axis(
+        all_logits, idx[:, None, None], axis=1)[:, 0]
+    return window, n, new_logits, SlotKVCache(
+        k=k_all, v=v_all, length=pos0 + n, active=cache.active)
+
+
 def _check_cache_capacity(cache: KVCache, new_tokens: int, what: str) -> None:
     """Reject writes past the cache's allocated window.
 

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from kubeflow_controller_tpu.dataplane import spec_decode
 from kubeflow_controller_tpu.dataplane.serving_engine import (
     DrainError, Rejected, Request, ServingEngine,
 )
@@ -500,3 +501,90 @@ def test_metrics_populated(cfg, params):
     assert s["requests"] == 4
     assert s["tokens_out"] == sum(r.max_new_tokens for r in reqs)
     assert 0.0 < eng.stats.slot_utilization <= 1.0
+
+
+# -- speculative decoding: budget/deadline accounting ---------------------
+#
+# Multi-token commits move the retirement boundary from "one token per
+# step" to "up to K+1 tokens per step". These tests pin that the budget
+# and deadline policies stay EXACT at that coarser boundary — the spec
+# path must clamp commits to the remaining budget, never overshoot and
+# trim after the fact, and deadline retirement must stay row-local.
+
+
+class _GreedyRepeatProposer(spec_decode.DraftProposer):
+    """Test-only proposer: drafts the context's last token repeated k
+    times. The untrained tiny model's greedy streams collapse into
+    repeated-token runs, so this structurally guarantees both long
+    multi-token accepts (inside a run) and rejects (at run boundaries)
+    — the churn that makes boundary accounting bugs visible."""
+
+    def propose(self, contexts, k):
+        b = len(contexts)
+        draft = np.zeros((b, k), np.int32)
+        lens = np.zeros((b,), np.int32)
+        for i, ctx in enumerate(contexts):
+            if ctx is None or np.size(ctx) == 0:
+                continue
+            draft[i, :] = int(np.asarray(ctx).reshape(-1)[-1])
+            lens[i] = k
+        return draft, lens
+
+
+def test_spec_budget_exact_at_multi_token_boundary(cfg, params):
+    """Under multi-token accepts every request must retire at EXACTLY
+    max_new_tokens (reason 'length', stream bit-exact) — a draft window
+    crossing the budget must be clamped, not committed-then-trimmed."""
+    max_seq = 48
+    reqs = _mixed_requests(cfg, n=8)
+    # Budgets deliberately NOT multiples of draft_k+1: with draft_k=7
+    # the 8-wide verify window would overshoot budgets like 10 or 5
+    # unless the engine clamps max_commit to the remaining budget.
+    eng = ServingEngine(cfg, params, n_slots=3, max_seq=max_seq,
+                        spec_decode=True, draft_k=7,
+                        proposer=_GreedyRepeatProposer())
+    got = {c.rid: c for c in eng.run(list(reqs))}
+    assert set(got) == {r.rid for r in reqs}
+    for r in reqs:
+        c = got[r.rid]
+        assert len(c.tokens) == r.max_new_tokens, (
+            f"rid {r.rid}: spec commit overshot/undershot the budget "
+            f"({len(c.tokens)} != {r.max_new_tokens})")
+        assert c.finish_reason == "length"
+        assert c.tokens == _reference(cfg, params, r, max_seq)
+    # The boundary case is only exercised if multi-token commits fired.
+    assert eng.stats.draft_accepted > 0
+    assert any(k > 1 for k in eng.stats.spec_step_tokens_hist)
+
+
+def test_spec_deadline_retirement_is_row_local(cfg, params):
+    """Deadline-retiring a slot mid-spec must not perturb its neighbor:
+    the doomed row retires with a bit-exact PREFIX, the survivor and
+    the late admit finish their full budgets bit-exact."""
+    clk = FakeClock()
+    rs = _mixed_requests(cfg, n=3)
+    doomed = Request(rid=0, prompt=rs[0].prompt, max_new_tokens=24,
+                     deadline_s=4.5)
+    survivor = Request(rid=1, prompt=rs[1].prompt, max_new_tokens=12)
+    queued = Request(rid=2, prompt=rs[2].prompt, max_new_tokens=10)
+    eng = ServingEngine(cfg, params, n_slots=2, max_seq=40,
+                        decode_chunk=1, clock=clk, spec_decode=True,
+                        draft_k=4, proposer=_GreedyRepeatProposer())
+    comps = []
+    for r in (doomed, survivor, queued):
+        eng.submit(r)
+    for _ in range(200):
+        comps.extend(eng.step())
+        clk.t += 1.0
+        if eng.idle:
+            break
+    by_rid = {c.rid: c for c in comps}
+    assert by_rid[0].finish_reason == "deadline"
+    assert 0 < len(by_rid[0].tokens) < 24
+    ref0 = _reference(cfg, params, doomed, 40, upto=24)
+    assert by_rid[0].tokens == ref0[:len(by_rid[0].tokens)]
+    assert by_rid[1].finish_reason == "length"
+    assert by_rid[1].tokens == _reference(cfg, params, survivor, 40)
+    assert by_rid[2].finish_reason == "length"
+    assert by_rid[2].tokens == _reference(cfg, params, queued, 40)
+    assert eng.n_active == 0 and eng.idle

@@ -1,0 +1,490 @@
+"""Speculative-decoding invariants (ISSUE 7).
+
+Three layers, matching the subsystem split:
+
+1. **Proposers** (``dataplane/spec_decode.py``, host-only): proposals
+   are deterministic, bounded by ``k``, safe on degenerate contexts,
+   verified against a brute-force n-gram reference, and the radix walk
+   is STRICTLY read-only — no pins, no refcount changes, no LRU
+   perturbation.
+2. **Fused verifier** (``models/generate.py:verify_step_slots``): a
+   perfect draft commits the whole window, a garbage draft commits
+   exactly the one token plain decode would have, EOS and budget
+   truncate the commit, and — the acceptance invariant — the stream
+   after ANY verify step continues bit-identical to plain decode
+   (rollback-by-never-committing leaves no trace in the slot KV).
+3. **Engine + benchmark contract**: spec-on greedy streams are
+   bit-identical to spec-off across both proposers (with speculation
+   demonstrably exercised), and ``benchmarks/spec_bench.py`` keeps its
+   JSON contract (smoke here; the gated full run is slow-marked).
+"""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_controller_tpu.dataplane.kv_blocks import PrefixStore
+from kubeflow_controller_tpu.dataplane.spec_decode import (
+    DraftProposer, PromptLookupProposer, RadixProposer, make_proposer,
+)
+from kubeflow_controller_tpu.dataplane.serving_engine import (
+    Request, ServingEngine,
+)
+from kubeflow_controller_tpu.models import generate as gen
+from kubeflow_controller_tpu.models import transformer as tfm
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks"))
+
+import spec_bench  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tfm.tiny_config()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return gen.inference_params(cfg, tfm.init_params(cfg, jax.random.key(0)))
+
+
+def _toks(seq):
+    return np.asarray(seq, np.int32)
+
+
+# -- PromptLookupProposer -------------------------------------------------
+
+
+def _ref_prompt_lookup(ctx, k, ngram_min=2, ngram_max=3):
+    """Brute-force reference for PromptLookupProposer._match: longest
+    n first; prefer the most recent occurrence with a full k-token
+    continuation, else the most recent occurrence."""
+    ctx = list(ctx)
+    n_ctx = len(ctx)
+    for n in range(min(ngram_max, n_ctx - 1), ngram_min - 1, -1):
+        tail = ctx[n_ctx - n:]
+        starts = [s for s in range(n_ctx - n)
+                  if ctx[s:s + n] == tail]
+        if starts:
+            full = [s for s in starts if s + n + k <= n_ctx]
+            s = full[-1] if full else starts[-1]
+            return ctx[s + n:s + n + k]
+    return []
+
+
+def test_prompt_lookup_matches_reference():
+    """Vectorized scan == brute force over a soup of small-vocab
+    contexts (vocab 4: n-gram repeats are everywhere)."""
+    prop = PromptLookupProposer()
+    rng = np.random.default_rng(0)
+    for trial in range(200):
+        ctx = rng.integers(0, 4, size=rng.integers(3, 40)).astype(np.int32)
+        k = int(rng.integers(1, 9))
+        draft, lens = prop.propose([ctx], k)
+        ref = _ref_prompt_lookup(ctx, k)
+        assert list(draft[0, :lens[0]]) == ref, (
+            f"trial {trial}: ctx={ctx.tolist()} k={k}")
+
+
+def test_prompt_lookup_deterministic_and_bounded():
+    prop = PromptLookupProposer()
+    rng = np.random.default_rng(1)
+    ctxs = [rng.integers(0, 6, size=20).astype(np.int32) for _ in range(4)]
+    d1, l1 = prop.propose(ctxs, 5)
+    d2, l2 = prop.propose(ctxs, 5)
+    assert np.array_equal(d1, d2) and np.array_equal(l1, l2)
+    assert d1.shape == (4, 5) and l1.shape == (4,)
+    assert (l1 >= 0).all() and (l1 <= 5).all()
+    for i in range(4):
+        assert (d1[i, l1[i]:] == 0).all()     # zero-padded past valid len
+
+
+def test_prompt_lookup_short_and_none_contexts():
+    """Degenerate inputs must yield empty drafts, never crash: empty,
+    sub-ngram_min, and None (slot not drafting) rows."""
+    prop = PromptLookupProposer()
+    ctxs = [_toks([]), _toks([7]), _toks([7, 7]), None]
+    draft, lens = prop.propose(ctxs, 4)
+    assert (lens == 0).all()
+    assert (draft == 0).all()
+
+
+def test_prompt_lookup_loop_tail_drafts_full_width():
+    """On a looping tail the nearest occurrence sits a token from the
+    end; the proposer must prefer an earlier one with a FULL k-token
+    continuation — this is what makes speculation pay on repetitive
+    streams."""
+    ctx = np.tile(_toks([1, 2, 3]), 10)
+    draft, lens = PromptLookupProposer().propose([ctx], 8)
+    assert lens[0] == 8
+    assert list(draft[0]) == [1, 2, 3, 1, 2, 3, 1, 2]
+
+
+def test_has_candidate_agrees_with_propose():
+    prop = PromptLookupProposer()
+    rng = np.random.default_rng(2)
+    for _ in range(50):
+        ctx = rng.integers(0, 5, size=rng.integers(1, 24)).astype(np.int32)
+        _, lens = prop.propose([ctx], 1)
+        assert prop.has_candidate(ctx) == bool(lens[0])
+
+
+def test_prompt_lookup_rejects_bad_ngram_range():
+    with pytest.raises(ValueError):
+        PromptLookupProposer(ngram_max=1, ngram_min=2)
+    with pytest.raises(ValueError):
+        PromptLookupProposer(ngram_min=0)
+
+
+# -- RadixProposer --------------------------------------------------------
+
+
+def _trie_snapshot(store):
+    """(id -> (refs, last_use, block)) for every live node, plus pool
+    occupancy — the full mutable surface a proposer could touch."""
+    snap = {}
+    stack = list(store.trie.root.children.values())
+    while stack:
+        n = stack.pop()
+        snap[id(n)] = (n.refs, n.last_use, n.block)
+        stack.extend(n.children.values())
+    return snap, store.pool.used_blocks, store.pool.free_blocks
+
+
+def test_radix_proposer_drafts_cached_continuation(cfg):
+    store = PrefixStore(cfg, block_size=2, n_blocks=8)
+    store.trie.insert(_toks(range(12)))
+    prop = RadixProposer(store)
+    # Context [0..4]: two full blocks + tail [4] prefixing edge (4, 5).
+    draft, lens = prop.propose([_toks([0, 1, 2, 3, 4])], 5)
+    assert lens[0] == 5
+    assert list(draft[0]) == [5, 6, 7, 8, 9]
+    # Block-aligned context: pure descent from the matched node.
+    draft, lens = prop.propose([_toks([0, 1, 2, 3])], 4)
+    assert lens[0] == 4
+    assert list(draft[0]) == [4, 5, 6, 7]
+    # Diverged context: nothing cached extends it -> no draft.
+    draft, lens = prop.propose([_toks([0, 1, 9, 9])], 4)
+    assert lens[0] == 0
+
+
+def test_radix_proposer_is_strictly_read_only(cfg):
+    """The walk must not pin, bump refcounts, or touch LRU order:
+    drafting is an observer, never a tenant — otherwise speculation
+    would extend block lifetimes and perturb eviction."""
+    store = PrefixStore(cfg, block_size=2, n_blocks=16)
+    store.trie.insert(_toks(range(10)))
+    store.trie.insert(_toks([0, 1, 7, 7, 7, 7]))
+    before = _trie_snapshot(store)
+    prop = RadixProposer(store)
+    for ctx in ([0, 1, 2, 3, 4], [0, 1, 7, 7], [0, 1], [5, 5, 5],
+                list(range(10))):
+        prop.propose([_toks(ctx)], 6)
+        prop.has_candidate(_toks(ctx))
+    assert _trie_snapshot(store) == before
+
+
+def test_radix_proposer_deterministic(cfg):
+    store = PrefixStore(cfg, block_size=2, n_blocks=8)
+    store.trie.insert(_toks(range(12)))
+    prop = RadixProposer(store)
+    ctxs = [_toks([0, 1, 2]), None, _toks(range(8))]
+    d1, l1 = prop.propose(ctxs, 6)
+    d2, l2 = prop.propose(ctxs, 6)
+    assert np.array_equal(d1, d2) and np.array_equal(l1, l2)
+    assert (l1 <= 6).all()
+
+
+def test_make_proposer_wiring(cfg):
+    assert isinstance(make_proposer("prompt"), PromptLookupProposer)
+    store = PrefixStore(cfg, block_size=2, n_blocks=4)
+    assert isinstance(make_proposer("radix", store), RadixProposer)
+    with pytest.raises(ValueError):
+        make_proposer("radix")           # trie required
+    with pytest.raises(ValueError):
+        make_proposer("medusa")
+
+
+# -- verify_step_slots ----------------------------------------------------
+
+
+def _slot_setup(cfg, params, B=2, S=6, max_seq=32, seed=3):
+    """Prefill B prompts into a slot cache (uniform prefill copied in —
+    the test_serving_engine idiom) and return the greedy reference:
+    (cache, logits, prompts, greedy tokens from this state)."""
+    prompts = jnp.asarray(
+        np.random.default_rng(seed).integers(0, cfg.vocab_size, (B, S)),
+        jnp.int32)
+    u_logits, u_cache = gen.prefill(cfg, params, prompts,
+                                    gen.init_kv_cache(cfg, B, max_seq))
+    s_cache = gen.init_slot_cache(cfg, B, max_seq)
+    s_cache = s_cache._replace(
+        k=s_cache.k.at[:, :, :S].set(
+            u_cache.k[:, :, :S].astype(s_cache.k.dtype)),
+        v=s_cache.v.at[:, :, :S].set(
+            u_cache.v[:, :, :S].astype(s_cache.v.dtype)),
+        length=jnp.full((B,), S, jnp.int32),
+        active=jnp.ones((B,), bool),
+    )
+    return s_cache, u_logits, prompts
+
+
+def _greedy_rollout(cfg, params, cache, logits, n):
+    """n plain decode_step_slots steps: (tokens [B, n], cache, logits)."""
+    toks = []
+    for _ in range(n):
+        t = logits.argmax(-1).astype(jnp.int32)[:, None]
+        toks.append(np.asarray(t)[:, 0])
+        logits, cache = gen.decode_step_slots(cfg, params, t, cache)
+    return np.stack(toks, axis=1), cache, logits
+
+
+def test_verify_perfect_draft_commits_full_window(cfg, params):
+    B, K = 2, 4
+    cache, logits, _ = _slot_setup(cfg, params, B=B)
+    ref, _, _ = _greedy_rollout(cfg, params, cache, logits, K + 1)
+    # Draft rows = greedy tokens AFTER t0 (t0 itself is the verifier's
+    # free position).
+    draft = jnp.asarray(ref[:, 1:], jnp.int32)
+    window, n, _, vcache = gen.verify_step_slots(
+        cfg, params, draft, jnp.full((B,), K, jnp.int32), logits, cache,
+        jnp.full((B,), -1, jnp.int32), jnp.full((B,), K + 1, jnp.int32))
+    assert (np.asarray(n) == K + 1).all()
+    assert np.array_equal(np.asarray(window), ref)
+    assert (np.asarray(vcache.length) == np.asarray(cache.length)
+            + K + 1).all()
+
+
+def test_verify_garbage_draft_commits_one_token(cfg, params):
+    B, K = 2, 4
+    cache, logits, _ = _slot_setup(cfg, params, B=B)
+    ref, _, _ = _greedy_rollout(cfg, params, cache, logits, 1)
+    # Shift every greedy token by 1 mod vocab: guaranteed argmax
+    # mismatch at draft position 0.
+    t1 = ref[:, 0]
+    draft = jnp.asarray(
+        (np.tile(t1[:, None], (1, K)) + 1) % cfg.vocab_size, jnp.int32)
+    window, n, _, vcache = gen.verify_step_slots(
+        cfg, params, draft, jnp.full((B,), K, jnp.int32), logits, cache,
+        jnp.full((B,), -1, jnp.int32), jnp.full((B,), K + 1, jnp.int32))
+    assert (np.asarray(n) == 1).all()
+    assert np.array_equal(np.asarray(window)[:, 0], t1)
+    assert (np.asarray(vcache.length) == np.asarray(cache.length) + 1).all()
+
+
+def test_verify_rollback_leaves_no_trace(cfg, params):
+    """THE verifier invariant: after a verify step with a mostly-
+    rejected draft, continuing with plain decode must reproduce the
+    plain greedy stream token for token — rejected window positions
+    left nothing in the slot KV."""
+    B, K, n_more = 2, 4, 10
+    cache, logits, _ = _slot_setup(cfg, params, B=B)
+    ref, _, _ = _greedy_rollout(cfg, params, cache, logits, 1 + n_more)
+    bad = jnp.asarray(
+        (np.tile(ref[:, :1], (1, K)) + 1) % cfg.vocab_size, jnp.int32)
+    window, n, vlogits, vcache = gen.verify_step_slots(
+        cfg, params, bad, jnp.full((B,), K, jnp.int32), logits, cache,
+        jnp.full((B,), -1, jnp.int32), jnp.full((B,), K + 1, jnp.int32))
+    assert (np.asarray(n) == 1).all()
+    cont, _, _ = _greedy_rollout(cfg, params, vcache, vlogits, n_more)
+    got = np.concatenate([np.asarray(window)[:, :1], cont], axis=1)
+    assert np.array_equal(got, ref), (
+        "stream diverged after rollback — rejected positions left KV")
+
+
+def test_verify_truncates_at_committed_eos(cfg, params):
+    """EOS inside the accepted run cuts the commit just after it:
+    tokens 'after' an EOS must not exist, let alone leave KV."""
+    B, K = 2, 4
+    cache, logits, _ = _slot_setup(cfg, params, B=B)
+    ref, _, _ = _greedy_rollout(cfg, params, cache, logits, K + 1)
+    draft = jnp.asarray(ref[:, 1:], jnp.int32)     # perfect draft
+    eos = jnp.asarray(ref[:, 1], jnp.int32)        # 2nd committed token
+    window, n, _, vcache = gen.verify_step_slots(
+        cfg, params, draft, jnp.full((B,), K, jnp.int32), logits, cache,
+        eos, jnp.full((B,), K + 1, jnp.int32))
+    assert (np.asarray(n) == 2).all()              # t0 + the EOS itself
+    assert (np.asarray(vcache.length) == np.asarray(cache.length) + 2).all()
+
+
+def test_verify_respects_commit_budget(cfg, params):
+    B, K = 2, 4
+    cache, logits, _ = _slot_setup(cfg, params, B=B)
+    ref, _, _ = _greedy_rollout(cfg, params, cache, logits, K + 1)
+    draft = jnp.asarray(ref[:, 1:], jnp.int32)     # perfect draft
+    window, n, _, vcache = gen.verify_step_slots(
+        cfg, params, draft, jnp.full((B,), K, jnp.int32), logits, cache,
+        jnp.full((B,), -1, jnp.int32), jnp.asarray([1, 3], jnp.int32))
+    assert np.asarray(n).tolist() == [1, 3]
+    assert np.array_equal(np.asarray(window)[1, :3], ref[1, :3])
+
+
+# -- engine integration ---------------------------------------------------
+
+
+def _mixed_len_requests(cfg, n=8, seed=4):
+    """Random prompts, mixed lengths and budgets — admission churn plus
+    long enough decodes for repeated-token runs to appear."""
+    rng = np.random.default_rng(seed)
+    return [
+        Request(rid=i,
+                prompt=rng.integers(0, cfg.vocab_size,
+                                    6 + i % 5).astype(np.int32),
+                max_new_tokens=16 + 4 * (i % 3))
+        for i in range(n)
+    ]
+
+
+def _tiled_requests(cfg, n=6, period=4, reps=6, max_new=12, seed=5):
+    """Repetitive prompts (a short pattern tiled): prompt-lookup has
+    real n-gram matches from the first eligible step."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        pattern = rng.integers(0, cfg.vocab_size, period).astype(np.int32)
+        out.append(Request(rid=i, prompt=np.tile(pattern, reps),
+                           max_new_tokens=max_new + i % 3))
+    return out
+
+
+def _run(cfg, params, reqs, **kw):
+    eng = ServingEngine(cfg, params, **kw)
+    comps = eng.run([Request(rid=r.rid, prompt=np.array(r.prompt),
+                             max_new_tokens=r.max_new_tokens,
+                             eos_id=r.eos_id) for r in reqs])
+    return {c.rid: list(c.tokens) for c in comps}, eng
+
+
+class _LastTokenProposer(DraftProposer):
+    """Test-only DraftProposer: always drafts the context's last token
+    repeated k times. Structurally guarantees proposals every eligible
+    quantum — accepts land exactly on the (common) repeated-token runs
+    of the tiny model, rejects everywhere else, so verify churn covers
+    both sides of the acceptance rule."""
+
+    def propose(self, contexts, k):
+        b = len(contexts)
+        draft = np.zeros((b, k), np.int32)
+        lens = np.zeros((b,), np.int32)
+        for i, ctx in enumerate(contexts):
+            if ctx is None or np.size(ctx) == 0:
+                continue
+            draft[i, :] = int(np.asarray(ctx).reshape(-1)[-1])
+            lens[i] = k
+        return draft, lens
+
+
+def test_spec_engine_bit_exact_under_draft_churn(cfg, params):
+    """Spec-on == spec-off bitwise with an injected always-proposing
+    proposer: every eligible quantum runs the fused verifier, drafts
+    are accepted on repeated-token runs and rejected elsewhere, and
+    not one bit of any stream may move."""
+    kw = dict(n_slots=3, max_seq=64, prefill_mode="bucketed",
+              block_size=4)
+    reqs = _mixed_len_requests(cfg, n=8)
+    off, _ = _run(cfg, params, reqs, **kw)
+    on, eng = _run(cfg, params, reqs, spec_decode=True, draft_k=8,
+                   proposer=_LastTokenProposer(), **kw)
+    assert on == off
+    assert eng.stats.draft_proposed > 0
+    assert eng.stats.spec_steps > 0
+    assert eng.stats.draft_accepted <= eng.stats.draft_proposed
+
+
+def test_spec_engine_bit_exact_prompt_proposer(cfg, params):
+    """Spec-on == spec-off bitwise with the production prompt-lookup
+    proposer across repetitive-prompt traffic (whether or not the
+    adaptive backoff ends up speculating is traffic-dependent — the
+    output contract is unconditional)."""
+    kw = dict(n_slots=3, max_seq=48, prefill_mode="bucketed",
+              block_size=4)
+    reqs = _tiled_requests(cfg, n=8)
+    off, _ = _run(cfg, params, reqs, **kw)
+    on, _ = _run(cfg, params, reqs, spec_decode=True, draft_k=8,
+                 proposer="prompt", **kw)
+    assert on == off
+
+
+def test_spec_engine_bit_exact_radix_repeat_wave(cfg, params):
+    """Repeat traffic with the radix proposer: wave 2 drafts wave 1's
+    cached replies, commits multi-token accepts, and stays bit-exact
+    against both the plain engine and per-sequence generate."""
+    # kv_pool_blocks: the default pool (n_slots * max_blocks = 24) is
+    # exactly consumed by the four 6-block prompts, and RadixCache
+    # .insert is best-effort — on a pinned-full pool the reply chain
+    # silently stops, leaving nothing for wave 2 to draft from.
+    kw = dict(n_slots=2, max_seq=48, prefill_mode="bucketed",
+              block_size=4, prefix_cache=True, kv_pool_blocks=96)
+    reqs = _tiled_requests(cfg, n=4, seed=6)
+    eng = ServingEngine(cfg, params, spec_decode=True, draft_k=8,
+                        proposer="radix", **kw)
+    for _ in range(2):                   # wave 1 warms the trie
+        comps = eng.run([Request(rid=r.rid, prompt=np.array(r.prompt),
+                                 max_new_tokens=r.max_new_tokens)
+                         for r in reqs])
+    got = {c.rid: list(c.tokens) for c in comps}
+    plain, _ = _run(cfg, params, reqs, **kw)
+    assert got == plain
+    assert eng.stats.draft_accepted > 0
+    # The histogram proves multi-token commits happened (keys > 1).
+    assert any(k > 1 for k in eng.stats.spec_step_tokens_hist)
+
+
+def test_spec_engine_radix_requires_prefix_cache(cfg, params):
+    with pytest.raises(ValueError):
+        ServingEngine(cfg, params, n_slots=2, max_seq=32,
+                      spec_decode=True, proposer="radix")
+
+
+# -- benchmark contract ---------------------------------------------------
+
+
+def test_spec_bench_smoke_contract(tmp_path):
+    """Smoke-sized run pins the JSON contract and the bit-exactness
+    bit; the speed gates are disabled (a smoke workload is too small
+    for a reliable ratio — the slow test keeps the real gates)."""
+    out = tmp_path / "spec.json"
+    rc = spec_bench.main([
+        "--requests", "6", "--base-prompts", "2", "--prompt-len", "16",
+        "--max-new", "24", "--draft-k", "8", "--rand-requests", "4",
+        "--repeats", "2", "--min-speedup", "0.0",
+        "--max-tpot-regress", "100.0", "--json", str(out),
+    ])
+    res = json.loads(out.read_text())
+    assert rc == 0
+    assert res["metric"] == "spec_decode_tokens_per_sec_speedup"
+    assert res["outputs_match"] is True
+    assert set(res) >= {"value", "unit", "repeat_leg",
+                        "incompressible_leg"}
+    rep = res["repeat_leg"]
+    assert set(rep) >= {"plain_tokens_per_sec", "spec_tokens_per_sec",
+                        "acceptance_rate", "draft_proposed",
+                        "draft_accepted", "spec_steps",
+                        "spec_step_tokens_hist"}
+    assert 0.0 <= rep["acceptance_rate"] <= 1.0
+    assert rep["draft_accepted"] <= rep["draft_proposed"]
+    inc = res["incompressible_leg"]
+    assert set(inc) >= {"tpot_ratio", "plain_tpot_p50_ms",
+                        "spec_tpot_p50_ms"}
+    assert inc["tpot_ratio"] > 0
+
+
+@pytest.mark.slow
+def test_spec_bench_full_gates(tmp_path):
+    """The gated acceptance run: >= 1.5x decode throughput on repeat
+    traffic with bit-identical outputs, <= 5% TPOT regression on
+    incompressible traffic."""
+    out = tmp_path / "spec_full.json"
+    rc = spec_bench.main(["--json", str(out)])
+    res = json.loads(out.read_text())
+    assert rc == 0
+    assert res["outputs_match"] is True
+    assert res["value"] >= 1.5
+    assert res["incompressible_leg"]["tpot_ratio"] <= 1.05
