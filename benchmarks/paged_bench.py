@@ -1,0 +1,214 @@
+"""Paged-attention benchmark: capacity at fixed HBM, zero-copy TTFT.
+
+Three claims from the PR 8 paged KV design (docs/serving.md "KV block
+pool: paged attention, zero-copy prefix reuse, int8 pages"), each gated
+before any timing is celebrated:
+
+* **bit-exactness**: the fp paged engine's greedy outputs are asserted
+  IDENTICAL, token for token, to the standalone contiguous
+  ``generate()`` reference (the pre-paging code path, kept in
+  models/generate.py precisely as this oracle). The paged kernels
+  gather a dense view out of the pool and then run the contiguous
+  einsum/mask/softmax verbatim at the same width, so this is a
+  tripwire, not a tolerance.
+* **capacity at fixed HBM**: with the pool as the ONLY KV storage,
+  int8 pages (+ per-(row, head) fp32 scales) shrink bytes/token from
+  ``2*L*KVH*D*2`` (bf16) to ``2*L*KVH*(D+4)``, so at any fixed byte
+  budget the pool admits >= 1.5x the fully-reserved slots of the PR 5
+  contiguous layout (exactly 2D/(D+4) = 1.6x at head_dim 16). The
+  sweep reports both the analytic page counts (``blocks_for_budget``)
+  and the PR 5 contiguous-row arithmetic it replaces.
+* **zero-copy prefix TTFT**: the shared-system-prompt workload from
+  prefix_bench, re-run on the paged engine. A radix hit now appends
+  shared page ids to the slot's block table — zero device bytes moved
+  — so TTFT p50 must hold the PR 6 gate (<= 74.9 ms) and
+  ``prefix_zero_copy_tokens`` must equal ``prefix_hit_tokens`` (> 0).
+
+An int8 leg re-runs the workload with ``kv_quant="int8"`` and asserts
+identical finish reasons and token counts vs fp (the bounded-error
+model never changes scheduling semantics; see docs/serving.md "int8 KV
+error model").
+
+Prints one JSON object; with ``--json`` also writes it to a file. Run
+via ``make bench-paged``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np
+
+from benchmarks.prefix_bench import run_engine, shared_prefix_workload
+
+TTFT_GATE_MS = 74.9          # PR 6 prefix_bench result; paged must hold it
+CAPACITY_GATE = 1.5
+
+
+def reference_outputs(cfg, params, requests):
+    """Greedy outputs from the standalone contiguous path — one
+    ``generate()`` call per request, no pool, no tables, no sharing."""
+    import jax.numpy as jnp
+
+    from kubeflow_controller_tpu.models import generate as gen
+
+    out = {}
+    for r in requests:
+        toks = gen.generate(
+            cfg, params, jnp.asarray(r.prompt[None]), r.max_new_tokens,
+            max_seq=r.prompt.size + r.max_new_tokens)
+        out[r.rid] = [int(t) for t in np.asarray(toks)[0]]
+    return out
+
+
+def capacity_sweep(cfg, block_size: int, max_seq: int, budgets_mb):
+    """Slots admissible at each fixed HBM budget: PR 5 contiguous rows
+    vs paged fp vs paged int8 (full per-slot reservation, the engine's
+    admission-time worst case)."""
+    from kubeflow_controller_tpu.dataplane import kv_blocks
+
+    max_blocks = -(-max_seq // block_size)
+    rows = []
+    for mb in budgets_mb:
+        budget = mb << 20
+        row_bytes = max_seq * kv_blocks.kv_bytes_per_token(cfg, "")
+        contiguous_slots = budget // row_bytes
+        paged_fp = kv_blocks.blocks_for_budget(
+            cfg, block_size, budget, "") // max_blocks
+        paged_int8 = kv_blocks.blocks_for_budget(
+            cfg, block_size, budget, "int8") // max_blocks
+        rows.append({
+            "budget_mb": mb,
+            "contiguous_slots": int(contiguous_slots),
+            "paged_fp_slots": int(paged_fp),
+            "paged_int8_slots": int(paged_int8),
+            "int8_vs_contiguous": (paged_int8 / contiguous_slots
+                                   if contiguous_slots else 0.0),
+        })
+    return rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="tiny")
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--shared-len", type=int, default=96)
+    p.add_argument("--tail-max", type=int, default=8)
+    p.add_argument("--max-new", type=int, default=8)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--budgets-mb", default="4,8,16,64",
+                   help="fixed-HBM sweep points (MiB, comma-separated)")
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json", default="", help="also write the summary here")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from kubeflow_controller_tpu.dataplane.entrypoints.lm import CONFIGS
+    from kubeflow_controller_tpu.models import generate as gen
+    from kubeflow_controller_tpu.models import transformer as tfm
+
+    cfg = CONFIGS[args.config]()
+    params = gen.inference_params(
+        cfg, tfm.init_params(cfg, jax.random.key(0)))
+
+    reqs = shared_prefix_workload(
+        cfg, args.requests, args.shared_len, args.tail_max, args.max_new,
+        args.seed)
+    max_seq = args.shared_len + args.tail_max + args.max_new + 1
+    base_kw = dict(n_slots=args.slots, max_seq=max_seq,
+                   prefill_mode="bucketed", block_size=args.block_size,
+                   prefix_cache=True)
+
+    # ---- gate 1: fp paged greedy == contiguous generate() ---------------
+    ref = reference_outputs(cfg, params, reqs)
+    fp_out, fp_sum, fp_eng = run_engine(
+        cfg, params, reqs, args.repeats, **base_kw)
+    mismatches = [rid for rid in ref if ref[rid] != fp_out.get(rid)]
+
+    # ---- gate 2: capacity at fixed HBM ----------------------------------
+    budgets = [int(b) for b in args.budgets_mb.split(",")]
+    sweep = capacity_sweep(cfg, args.block_size, max_seq, budgets)
+    worst_ratio = min(r["int8_vs_contiguous"] for r in sweep)
+
+    # ---- gate 3: zero-copy prefix TTFT ----------------------------------
+    zero_copy_ok = (fp_eng.stats.prefix_zero_copy_tokens > 0
+                    and fp_eng.stats.prefix_zero_copy_tokens
+                    == fp_eng.stats.prefix_hit_tokens)
+
+    # ---- int8 leg: same scheduling semantics, cheaper pages -------------
+    q_out, q_sum, q_eng = run_engine(
+        cfg, params, reqs, args.repeats, kv_quant="int8", **base_kw)
+    int8_len_mismatch = [
+        rid for rid in fp_out
+        if len(fp_out[rid]) != len(q_out.get(rid, []))]
+    int8_token_agreement = (
+        sum(sum(a == b for a, b in zip(fp_out[r], q_out[r]))
+            for r in fp_out)
+        / max(1, sum(len(v) for v in fp_out.values())))
+
+    out = {
+        "metric": "paged_int8_slots_vs_contiguous_at_fixed_hbm",
+        "value": round(worst_ratio, 2),
+        "unit": "x admissible slots, int8 paged vs PR 5 contiguous rows",
+        "outputs_match_reference": not mismatches,
+        "ttft_p50_ms": fp_sum["ttft_p50_ms"],
+        "ttft_gate_ms": TTFT_GATE_MS,
+        "zero_copy": {
+            "prefix_hit_tokens": fp_eng.stats.prefix_hit_tokens,
+            "prefix_zero_copy_tokens":
+                fp_eng.stats.prefix_zero_copy_tokens,
+            "device_copy_bytes_on_hit": 0,
+        },
+        "capacity_sweep": sweep,
+        "fp": {k: fp_sum[k] for k in
+               ("ttft_p50_ms", "tpot_p50_ms", "tokens_per_sec",
+                "kv_bytes_per_token", "pool_blocks_total")},
+        "int8": {
+            **{k: q_sum[k] for k in
+               ("ttft_p50_ms", "tpot_p50_ms", "tokens_per_sec",
+                "kv_bytes_per_token", "pool_blocks_total")},
+            "finish_reasons_match": not int8_len_mismatch,
+            "greedy_token_agreement": round(int8_token_agreement, 4),
+        },
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+    if mismatches:
+        print(f"OUTPUT MISMATCH vs contiguous reference: rids"
+              f" {mismatches[:8]}")
+        return 1
+    if worst_ratio < CAPACITY_GATE:
+        print(f"CAPACITY BELOW TARGET: {worst_ratio:.2f}x <"
+              f" {CAPACITY_GATE}x")
+        return 1
+    if fp_sum["ttft_p50_ms"] > TTFT_GATE_MS:
+        print(f"TTFT REGRESSION: {fp_sum['ttft_p50_ms']:.1f} ms >"
+              f" {TTFT_GATE_MS} ms")
+        return 1
+    if not zero_copy_ok:
+        print("ZERO-COPY VIOLATION: prefix hits did not take the"
+              " pointer-assembly path")
+        return 1
+    if int8_len_mismatch:
+        print(f"INT8 SEMANTICS DRIFT: token counts differ for rids"
+              f" {int8_len_mismatch[:8]}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -6,13 +6,14 @@ workload:
 
 * **shared-prefix TTFT**: N requests share one system prompt and differ
   only in a short tail — production chat traffic. With the radix prefix
-  cache ON, admission device-copies the shared blocks out of the pool
-  and prefills only the tail, so TTFT p50 must drop >= 2x vs the same
+  cache ON, admission appends the matched pages' ids to the slot's
+  block table (zero device bytes moved — PR 8's paged design) and
+  prefills only the tail, so TTFT p50 must drop >= 2x vs the same
   bucketed engine with the cache OFF. Greedy outputs are asserted
   BIT-IDENTICAL between the two paths before any timing is reported
-  (same discipline as serving_bench.py) — the copy-into-slot design
-  makes cached and cold runs execute identical compiled computations on
-  identical bytes, so this is a tripwire, not a tolerance.
+  (same discipline as serving_bench.py) — cached and cold slots gather
+  identical bytes through their tables into identical compiled
+  computations, so this is a tripwire, not a tolerance.
 * **compile count**: random prompt lengths in [1, max_len]. Exact-length
   admission compiles one prefill per DISTINCT length (unbounded);
   bucketed admission decomposes every prefill into block-grid chunks

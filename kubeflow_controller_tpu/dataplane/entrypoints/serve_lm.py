@@ -12,8 +12,9 @@ Pipeline: load params from the train loop's orbax checkpoint in
 prepare serving weights (bf16 cast, or weight-only int8 with
 ``--quant int8``); read prompts (token-id JSONL from ``--input``, else a
 synthetic batch); run the **continuous-batching engine**
-(``dataplane/serving_engine.py`` — per-slot KV cache, prefill-on-admit,
-EOS/budget retirement, slot reuse; docs/serving.md) over the requests;
+(``dataplane/serving_engine.py`` — paged KV block pool with per-slot
+block tables, prefill-on-admit, EOS/budget retirement, slot reuse;
+docs/serving.md) over the requests;
 write completions JSONL to ``--output`` (``spec.exportDir`` analog) and
 report TTFT/TPOT/tokens-per-sec/slot-utilization, to the return dict and
 to the job's ``log_dir`` metrics sink when one is wired.
@@ -141,6 +142,8 @@ def serve(
     prefix_cache: bool = False,
     block_size: int = 16,
     kv_pool_mb: Optional[float] = None,
+    kv_quant: str = "",
+    paged: bool = True,
     speculative: bool = False,
     draft_k: int = 4,
     proposer: str = "prompt",
@@ -195,7 +198,7 @@ def serve(
             temperature=temperature, rng=rng, max_queue=max_queue,
             prefill_mode=("bucketed" if prefix_cache else prefill_mode),
             prefix_cache=prefix_cache, block_size=block_size,
-            kv_hbm_budget_mb=kv_pool_mb,
+            kv_hbm_budget_mb=kv_pool_mb, kv_quant=kv_quant, paged=paged,
             spec_decode=speculative, draft_k=draft_k, proposer=proposer,
         )
         prompts_np = np.asarray(prompts)
@@ -241,11 +244,12 @@ def serve(
     elif prefix_cache:
         # Multi-turn through the ENGINE with the radix prefix cache:
         # every turn submits the FULL conversation so far as a fresh
-        # request. Turn N's retirement registered its prompt AND reply
-        # blocks in the trie, so turn N+1's admission device-copies all
-        # of them and prefills only the new follow-up — the block-pool
-        # version of the shared-cache session below, with the engine's
-        # scheduling, overload policies, and stats along for the ride.
+        # request. Turn N's retirement published its prompt AND reply
+        # pages to the trie, so turn N+1's admission references all of
+        # them in its block table (zero-copy) and prefills only the new
+        # follow-up — the paged-pool version of the shared-cache session
+        # below, with the engine's scheduling, overload policies, and
+        # stats along for the ride.
         n_slots = min(slots, b) if slots > 0 else b
         engine = ServingEngine(
             cfg, params, n_slots=n_slots,
@@ -253,6 +257,7 @@ def serve(
             temperature=temperature, rng=rng, max_queue=max_queue,
             prefill_mode="bucketed", prefix_cache=True,
             block_size=block_size, kv_hbm_budget_mb=kv_pool_mb,
+            kv_quant=kv_quant, paged=paged,
             spec_decode=speculative, draft_k=draft_k, proposer=proposer,
         )
         prompts_np = np.asarray(prompts)
@@ -420,8 +425,21 @@ def main(argv=None) -> int:
                    help="KV page size in tokens (power of two) for the "
                         "block pool and prefill chunking")
     p.add_argument("--kv-pool-mb", type=float, default=0.0,
-                   help="HBM budget for the prefix-cache block pool in "
-                        "MiB (0 = one full context per slot)")
+                   help="HBM budget for the KV block pool in MiB (0 = "
+                        "one full context per slot, doubled when the "
+                        "prefix cache is on); with --kv-quant int8 the "
+                        "same budget holds ~2x the pages")
+    p.add_argument("--kv-quant", default="none", choices=["none", "int8"],
+                   help="KV pool precision: int8 stores pages as int8 + "
+                        "per-(row, head) fp32 scales dequantized in the "
+                        "attention gather — ~2x slots per HBM byte at a "
+                        "bounded output error (docs/serving.md)")
+    p.add_argument("--paged", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="block-table-indexed paged KV (the only "
+                        "supported engine since PR 8; --no-paged fails "
+                        "loudly and exists only so rollout tooling can "
+                        "probe for the capability)")
     p.add_argument("--speculative", action="store_true",
                    help="speculative decoding: model-free drafts "
                         "verified in one fused forward; greedy only "
@@ -467,6 +485,8 @@ def main(argv=None) -> int:
         prefix_cache=args.prefix_cache,
         block_size=args.block_size,
         kv_pool_mb=args.kv_pool_mb if args.kv_pool_mb > 0 else None,
+        kv_quant="" if args.kv_quant == "none" else args.kv_quant,
+        paged=args.paged,
         speculative=args.speculative,
         draft_k=args.draft_k,
         proposer=args.proposer,

@@ -1,43 +1,45 @@
 """Block-pooled KV cache with radix prefix reuse for the serving engine.
 
 Production LM traffic is dominated by shared prefixes — system prompts,
-few-shot templates, multi-turn sessions — yet the slot engine (PR 3/4)
-prefilled every admitted prompt from token zero. This module brings the
-two standard remedies to the slot pool:
+few-shot templates, multi-turn sessions. Since PR 8 the pool is not a
+side cache but **the only KV storage** (vLLM PagedAttention semantics,
+Kwon et al. 2023): every slot's KV lives in fixed ``block_size``-token
+pages of a shared device pool ``[L, n_blocks, block_size, KVH, D]``
+(``models/generate.py:PagedKVCache``), and attention reads pages through
+a per-slot block table (``ops/attention.py:paged_kv_view``). This module
+is the pure-host bookkeeping over that pool:
 
-* **Block pool** (vLLM's PagedAttention granularity, Kwon et al. 2023):
-  KV for cached prefixes lives in fixed ``block_size``-token pages of a
-  shared device pool ``[L, n_blocks, block_size, KVH, D]``, managed by a
-  host-side free-list allocator with per-block refcounts. The pool is
-  sized from an HBM budget (:func:`blocks_for_budget`), so prefix
-  caching can never grow past the memory an operator granted it.
+* **Block pool**: a free-list allocator with per-block refcounts. The
+  pool is sized from an HBM budget (:func:`blocks_for_budget`), and with
+  ``kv_quant="int8"`` each page stores int8 payload plus per-(token row,
+  head) fp32 scales — smaller pages, so the same budget admits more
+  concurrent slots.
 * **Radix trie** (SGLang's RadixAttention, Zheng et al. 2024):
   :class:`RadixCache` keys a trie over *block-granular* token-id chunks.
-  Admission walks the trie with the request's prompt, takes the longest
-  chain of fully-matching blocks, and device-copies those pages into the
-  slot's KV row — only the uncached suffix is prefilled. Completed
-  prefills insert their prompt's full blocks back into the trie.
+  Admission walks the trie with the request's prompt and appends the
+  matched chain's page ids to the slot's block table — a prefix hit is
+  pointer assembly, zero bytes moved. Completed prefills *publish* their
+  already-in-pool blocks to the trie via :meth:`RadixCache.insert_owned`
+  (ownership transfer, again no copy).
 
 Ownership model (the part the property tests pin):
 
-* allocating a block hands it to the trie with refcount 1 — the trie's
-  own structural hold;
-* every live request that matched through (or inserted) a node holds
-  one additional pin from admission to retirement — eos, length,
-  deadline, cancel, and drain all release through the same path;
+* every pool page is either **owned** by exactly one live slot (refcount
+  1, freed at retirement) or **shared** through a trie node — the node's
+  structural hold is refcount 1, and every live request whose table
+  references the page holds one additional pin from admission (or
+  publish) to retirement;
+* eos, length, deadline, cancel, and drain all release through the same
+  path, and each page's refcount hits zero exactly once per tenancy,
+  enforced loudly by :meth:`BlockPool.unref`;
 * eviction (LRU over leaf nodes) may only reclaim nodes with zero
-  request pins, and dropping the trie's hold is what returns the block
-  to the free list — each block's refcount hits zero exactly once per
-  tenancy, enforced loudly by :meth:`BlockPool.unref`.
+  request pins AND no outstanding pool refs beyond the trie's own hold —
+  a page named by any live slot table must survive for the *table's*
+  lifetime, not just the admission that created the pin.
 
-The engine COPIES matched pages into the slot row rather than attending
-to them in place: the decode path keeps its contiguous per-slot layout
-(and with it every bit-exactness invariant in tests/test_serving_engine),
-while eviction stays trivially safe — a pool page is never aliased by a
-live slot, only snapshotted into it. Device copy/gather helpers live in
-``models/generate.py`` (``copy_blocks_into_slot`` /
-``copy_row_into_blocks``); this module is pure host bookkeeping plus the
-:class:`PrefixStore` facade that owns the device pool arrays.
+Publishing a chain whose node already exists (two slots computed the
+same block concurrently) keeps the loser's duplicate page owned by its
+slot until retirement — tables never retarget mid-flight.
 """
 
 from __future__ import annotations
@@ -46,19 +48,34 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
-def blocks_for_budget(cfg, block_size: int, budget_bytes: int) -> int:
-    """How many KV pages fit in ``budget_bytes`` of HBM for this model.
+def kv_bytes_per_token(cfg, kv_quant: str = "") -> int:
+    """HBM bytes one token's K+V occupies across all layers.
 
-    One page holds k AND v for ``block_size`` tokens across all layers:
-    ``2 * L * block_size * KVH * D * itemsize`` bytes.
+    fp pages: ``2 * L * KVH * D * itemsize``. int8 pages add a fp32
+    scale per (token row, head, layer, k/v): ``2 * L * KVH * (D + 4)``.
     """
     import jax.numpy as jnp
 
-    itemsize = jnp.dtype(cfg.dtype).itemsize
-    per_block = (
-        2 * cfg.n_layers * block_size * cfg.n_kv_heads * cfg.head_dim
-        * itemsize
-    )
+    if kv_quant == "int8":
+        per_head = cfg.head_dim * 1 + 4
+    elif not kv_quant or kv_quant == "none":
+        per_head = cfg.head_dim * jnp.dtype(cfg.dtype).itemsize
+    else:
+        raise ValueError(f"unknown kv_quant {kv_quant!r}")
+    return 2 * cfg.n_layers * cfg.n_kv_heads * per_head
+
+
+def blocks_for_budget(
+    cfg, block_size: int, budget_bytes: int, kv_quant: str = "",
+) -> int:
+    """How many KV pages fit in ``budget_bytes`` of HBM for this model.
+
+    One page holds k AND v for ``block_size`` tokens across all layers;
+    int8 pages account their fp32 dequant scales too, which is what
+    makes the paged+int8 capacity gain an honest apples-to-apples
+    number.
+    """
+    per_block = block_size * kv_bytes_per_token(cfg, kv_quant)
     return max(0, int(budget_bytes) // per_block)
 
 
@@ -157,12 +174,19 @@ class RadixCache:
 
     def _evictable(self) -> List[RadixNode]:
         """Unpinned leaves, the only safely removable nodes: an interior
-        node's page encodes context its descendants were computed in."""
+        node's page encodes context its descendants were computed in.
+        Beyond the node's own pin count, the pool refcount must show no
+        holder other than the trie itself — attention now reads pages in
+        place through slot tables, so a page referenced by ANY live
+        table (request pin, external registration, in-flight publish)
+        must never return to the free list while that table can still
+        be dispatched."""
         out = []
         stack = list(self.root.children.values())
         while stack:
             n = stack.pop()
-            if not n.children and n.refs == 0:
+            if (not n.children and n.refs == 0
+                    and self.pool.refcount(n.block) <= 1):
                 out.append(n)
             stack.extend(n.children.values())
         return out
@@ -202,18 +226,23 @@ class RadixCache:
         self, tokens: Sequence[int],
         known_path: Sequence[RadixNode] = (),
     ) -> Tuple[List[RadixNode], List[Tuple[RadixNode, int]]]:
-        """Ensure every full block of ``tokens`` has a trie node.
+        """Ensure every full block of ``tokens`` has a trie node,
+        ALLOCATING fresh pool pages for blocks not yet present.
 
-        Walks/extends the chain; for blocks not yet present, allocates a
-        pool page (evicting LRU leaves when the pool is exhausted) and
-        creates the node. Returns ``(path, new)`` where ``path`` is the
-        full chain that now exists and ``new`` lists ``(node,
-        token_offset)`` pairs whose KV the caller must device-copy into
-        the pool. Best-effort: when no page can be found even after
-        eviction, the chain simply stops there (a shorter cached prefix,
-        never an error). ``known_path`` is a chain already matched (and
-        pinned, so it cannot have been evicted) for this exact prefix —
-        the walk resumes after it instead of re-hashing those blocks.
+        This is the external-ingest path (``register_prefix``: KV
+        arrives in a caller's contiguous cache and must be scattered
+        into the new pages) and the test/proposer seeding path. Engine
+        slots publish their own in-pool blocks through
+        :meth:`insert_owned` instead — no allocation, no copy.
+
+        Returns ``(path, new)`` where ``path`` is the full chain that
+        now exists and ``new`` lists ``(node, token_offset)`` pairs
+        whose KV the caller must scatter into the pool. Best-effort:
+        when no page can be found even after eviction, the chain simply
+        stops there (a shorter cached prefix, never an error).
+        ``known_path`` is a chain already matched (and pinned, so it
+        cannot have been evicted) for this exact prefix — the walk
+        resumes after it instead of re-hashing those blocks.
         """
         bs = self.block_size
         toks = [int(t) for t in tokens]
@@ -236,6 +265,52 @@ class RadixCache:
             path.append(child)
             node = child
         return path, new
+
+    def insert_owned(
+        self, tokens: Sequence[int], owned: Dict[int, int],
+        known_path: Sequence[RadixNode] = (),
+    ) -> Tuple[List[RadixNode], List[int]]:
+        """Publish a slot's already-in-pool blocks to the trie — the
+        zero-copy retirement path.
+
+        ``owned`` maps token offsets (multiples of ``block_size``) to
+        the pool page already holding that block's KV, owned by the
+        publishing slot (refcount 1). For each full block of ``tokens``
+        beyond ``known_path``:
+
+        * node absent  -> create it ADOPTING the owned page: ownership
+          transfers to the trie (the slot's refcount-1 *becomes* the
+          trie's structural hold — no alloc, no device copy);
+        * node present -> another slot published the same block first;
+          reuse its node and leave the caller's duplicate page owned
+          (the caller's table keeps reading its own copy until
+          retirement frees it).
+
+        Returns ``(path, adopted_offsets)``; the caller must stop
+        tracking adopted offsets' pages as owned, and must ``acquire``
+        the path extension if its table keeps referencing the chain.
+        Stops early (best-effort, like :meth:`insert`) if an offset is
+        missing from ``owned``.
+        """
+        bs = self.block_size
+        toks = [int(t) for t in tokens]
+        node = known_path[-1] if known_path else self.root
+        path: List[RadixNode] = list(known_path)
+        adopted: List[int] = []
+        for i in range(len(known_path) * bs, len(toks) - bs + 1, bs):
+            key = tuple(toks[i:i + bs])
+            child = node.children.get(key)
+            if child is None:
+                bid = owned.get(i)
+                if bid is None:
+                    return path, adopted
+                child = RadixNode(key=key, block=bid, parent=node)
+                node.children[key] = child
+                adopted.append(i)
+            self._touch(child)
+            path.append(child)
+            node = child
+        return path, adopted
 
     def acquire(self, path: Sequence[RadixNode]) -> None:
         """Pin a chain on behalf of a live request (refcount +1 per node,
@@ -264,7 +339,14 @@ class RadixCache:
 
 
 class PrefixStore:
-    """Device pool arrays + trie + allocator, the unit the engine owns.
+    """Trie + allocator facade, the unit the engine owns.
+
+    Pure host bookkeeping since PR 8 — the device pool arrays live in
+    the engine's ``PagedKVCache`` (``models/generate.py``), which the
+    trie's page ids index into. ``pool`` may be supplied to share the
+    engine's allocator (slot reservations and trie tenancy compete for
+    the same pages); by default a fresh one is built, which is what the
+    standalone proposer/seeding paths use.
 
     ``match_for_admission`` caps the usable match one block short of a
     fully-cached prompt: admission needs the last prompt position's
@@ -272,15 +354,12 @@ class PrefixStore:
     recompute-the-tail rule vLLM applies).
     """
 
-    def __init__(self, cfg, block_size: int, n_blocks: int):
-        from kubeflow_controller_tpu.models import generate as gen
-
+    def __init__(self, cfg, block_size: int, n_blocks: int,
+                 pool: Optional[BlockPool] = None):
         self.cfg = cfg
         self.block_size = block_size
-        self.pool = BlockPool(n_blocks)
+        self.pool = pool if pool is not None else BlockPool(n_blocks)
         self.trie = RadixCache(self.pool, block_size)
-        self.k, self.v = gen.init_block_pool(cfg, max(1, n_blocks),
-                                             block_size)
 
     @property
     def n_blocks(self) -> int:
@@ -298,32 +377,20 @@ class PrefixStore:
         self.trie.acquire(path)
         return path, len(path) * self.block_size
 
-    def insert_from_row(
-        self, tokens: Sequence[int], cache_k, cache_v, row: int,
-        known_path: Sequence[RadixNode] = (),
-    ) -> List[RadixNode]:
-        """Register ``tokens``' full blocks, copying KV for newly-created
-        nodes out of row ``row`` of a slot-cache/KV-cache pair (layout
-        ``[L, B, S, KVH, D]``). Returns the chain, NOT acquired — pin it
-        with ``trie.acquire`` if the caller's tenant should hold it."""
-        from kubeflow_controller_tpu.models import generate as gen
-
-        path, new = self.trie.insert(tokens, known_path=known_path)
-        if new:
-            ids = [n.block for n, _ in new]
-            starts = [off for _, off in new]
-            self.k, self.v = gen.copy_row_into_blocks(
-                self.k, self.v, cache_k, cache_v, row, ids, starts,
-                self.block_size,
-            )
-        return path
-
     def release(self, path: Sequence[RadixNode]) -> None:
         self.trie.release(path)
 
     def clear(self) -> None:
-        """Drop every cached prefix (host bookkeeping only — device
-        pages hold stale bytes until the next insert overwrites them,
-        and nothing can reference a page the trie no longer names)."""
-        self.pool = BlockPool(self.pool.n_blocks)
+        """Drop every cached prefix: the trie's structural hold on each
+        node's page is returned to the (possibly shared) pool and a
+        fresh trie is built. Only safe when no request pins are live —
+        the engine calls this from ``reset()`` after retiring every
+        slot."""
+        stack = list(self.trie.root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.refs:
+                raise RuntimeError("clear() with live request pins")
+            self.pool.unref(n.block)
         self.trie = RadixCache(self.pool, self.block_size)

@@ -74,19 +74,32 @@ class ServingStats:
     finish_reasons: Dict[str, int] = field(default_factory=dict)
     # Prefix-cache / prefill accounting (docs/serving.md "KV block
     # pool, prefix reuse, and prefill bucketing"): hit tokens are prompt
-    # tokens whose KV came out of the block pool instead of a prefill;
+    # tokens whose KV was served out of pool pages instead of a prefill;
     # lookup tokens are all prompt tokens that went through admission
-    # with a prefix store attached (the hit-rate denominator).
+    # with a prefix store attached (the hit-rate denominator). Since the
+    # paged engine (PR 8) a hit moves ZERO device bytes — the matched
+    # pages' ids are appended to the slot's block table and attention
+    # reads them in place — so the same token count also lands in
+    # ``prefix_zero_copy_tokens``, the counter that replaces the old
+    # copy-based accounting (kept equal to ``prefix_hit_tokens``; the
+    # two would diverge only if a copy-on-admit path ever returned).
     prefix_hit_tokens: int = 0
+    prefix_zero_copy_tokens: int = 0
     prefix_lookup_tokens: int = 0
     prefill_chunks: int = 0
     # Gauges the engine refreshes every step: cumulative prefill
     # compiles (exact lengths + bucket widths), live entries in the
-    # LRU-bounded exact-length admit memo, and block-pool occupancy.
+    # LRU-bounded exact-length admit memo, and block-pool occupancy —
+    # ``pool_blocks_resident`` counts pages holding live KV (slot
+    # reservations plus trie tenancy; the pool is the ONLY KV storage),
+    # and ``kv_bytes_per_token`` is the static per-token page cost
+    # (kv_blocks.kv_bytes_per_token — halves-ish under kv_quant="int8").
     prefill_compiles: int = 0
     admit_cache_size: int = 0
     pool_blocks_total: int = 0
     pool_blocks_in_use: int = 0
+    pool_blocks_resident: int = 0
+    kv_bytes_per_token: int = 0
     # Speculative decoding (docs/serving.md "Speculative decoding"):
     # ``draft_proposed`` counts draft tokens sent to the verifier,
     # ``draft_accepted`` those that committed (acceptance_rate is their
@@ -153,12 +166,15 @@ class ServingStats:
             "queue_depth_max": float(self.queue_depth_max),
             "slot_utilization": self.slot_utilization,
             "prefix_hit_tokens": float(self.prefix_hit_tokens),
+            "prefix_zero_copy_tokens": float(self.prefix_zero_copy_tokens),
             "prefix_hit_rate": self.prefix_hit_rate,
             "prefill_compiles": float(self.prefill_compiles),
             "prefill_chunks": float(self.prefill_chunks),
             "admit_cache_size": float(self.admit_cache_size),
             "pool_blocks_total": float(self.pool_blocks_total),
             "pool_blocks_in_use": float(self.pool_blocks_in_use),
+            "pool_blocks_resident": float(self.pool_blocks_resident),
+            "kv_bytes_per_token": float(self.kv_bytes_per_token),
             "draft_proposed": float(self.draft_proposed),
             "draft_accepted": float(self.draft_accepted),
             "acceptance_rate": self.acceptance_rate,

@@ -7,8 +7,10 @@ mixed output lengths that strands most of the batch in dead decode steps
 — the Orca (OSDI '22) observation. This engine schedules at token
 granularity instead:
 
-* a fixed pool of ``n_slots`` KV-cache rows (:class:`~generate.SlotKVCache`
-  — per-slot ``length``, per-slot attention masks, an ``active`` mask);
+* KV lives in a shared block pool (:class:`~generate.PagedKVCache` —
+  ``[L, n_blocks, block_size, KVH, D]`` pages, per-slot ``length`` and
+  ``active`` vectors) and each of the ``n_slots`` lanes reads/writes the
+  pool through its row of a host-owned block table;
 * a FIFO request queue; a request is **admitted** the moment a slot is
   free — its prompt block-prefills into the slot's rows
   (``prefill_into_slot``) while the other slots' caches sit untouched
@@ -68,22 +70,35 @@ snapshot-identity check. All retirement paths are row-local, so greedy
 decode of *unaffected* slots stays bit-equivalent to per-sequence
 ``gen.generate`` (pinned by tests/test_serving_engine.py).
 
-Prefix reuse & prefill bucketing (docs/serving.md "KV block pool,
-prefix reuse, and prefill bucketing"): with ``prefill_mode="bucketed"``
-every prefill is decomposed on the absolute ``block_size`` grid into
-full-block chunks plus a pow2-padded tail, run one chunk per step
-interleaved with decode (Sarathi-style), bounding total prefill
-compiles at ``1 + log2(block_size)`` regardless of prompt-length
-diversity. ``prefix_cache=True`` adds a refcounted block pool + radix
-trie (:mod:`~kubeflow_controller_tpu.dataplane.kv_blocks`): admission
-walks the trie over the prompt's token chunks, device-copies the
-longest cached prefix's pages into the slot, and prefills only the
-uncached suffix; retirement registers prompt+decoded tokens back into
-the trie so later requests (and later conversation turns, via
-``register_prefix``) reuse them. Because chunk boundaries sit on the
-absolute block grid, cached and cold runs execute identical compiled
-functions on identical bytes — greedy outputs are bit-equal with the
-cache on or off BY CONSTRUCTION (pinned by tests/test_kv_blocks.py).
+Paged KV & prefix reuse (docs/serving.md "KV block pool, prefix reuse,
+and prefill bucketing"): the pool is the ONLY KV storage (vLLM
+PagedAttention semantics — PR 8). Admission reserves the request's full
+page budget up front (``ceil((prompt + max_new) / block_size)`` pages,
+evicting cold trie leaves when the free list runs dry, requeueing the
+request when even eviction cannot supply it), writes the page ids into
+the slot's host table row, and pushes the table to the device before
+the next dispatch — no allocation ever happens mid-decode, so a slot
+can never strand half-generated output on a full pool. With
+``prefill_mode="bucketed"`` every prefill is decomposed on the absolute
+``block_size`` grid into full-block chunks plus a pow2-padded tail, run
+one chunk per step interleaved with decode (Sarathi-style), bounding
+total prefill compiles at ``1 + log2(block_size)`` regardless of
+prompt-length diversity. ``prefix_cache=True`` adds the radix trie
+(:mod:`~kubeflow_controller_tpu.dataplane.kv_blocks`): admission walks
+the trie over the prompt's token chunks and appends the matched chain's
+page ids to the slot's table — a hit is POINTER ASSEMBLY, zero device
+bytes moved — and prefills only the uncached suffix; prefill completion
+and retirement *publish* the slot's own already-in-pool pages to the
+trie (``insert_owned`` — ownership transfer, again no copy) so later
+requests (and later conversation turns, via ``register_prefix``) reuse
+them. Because chunk boundaries sit on the absolute block grid and the
+table-gathered KV view has the contiguous layout's exact shape, cached
+and cold runs execute identical compiled functions on identical bytes —
+greedy outputs are bit-equal with the cache on or off BY CONSTRUCTION
+(pinned by tests/test_kv_blocks.py). ``kv_quant="int8"`` stores pool
+pages as int8 with per-(page row, head) fp32 scales — dequantized
+inside the attention gather — roughly doubling concurrent slots per
+HBM byte at a documented bounded output error (docs/serving.md).
 """
 
 from __future__ import annotations
@@ -206,8 +221,9 @@ class _Prefill:
     one chunk per scheduling step, interleaved with the pool's decode
     dispatches (Sarathi-style) so a long prompt no longer head-of-line
     blocks TPOT for in-flight slots. ``next_off`` starts at the
-    prefix-cache match length — the cached blocks were device-copied
-    into the row at admission, so only the suffix runs."""
+    prefix-cache match length — the matched chain's pages are already
+    referenced by the slot's block table (pointer assembly, zero bytes
+    moved), so only the suffix runs."""
 
     tokens: np.ndarray
     next_off: int
@@ -218,7 +234,7 @@ class _Prefill:
 @dataclass
 class _Slot:
     """Host bookkeeping for one live slot (device truth lives in the
-    SlotKVCache row)."""
+    slot's PagedKVCache table row + length/active entries)."""
 
     req: Request
     submit_t: float
@@ -229,10 +245,16 @@ class _Slot:
     tokens: List[int] = field(default_factory=list)
     # Radix-trie nodes this request pins (prefix-cache mode). Acquired
     # at admission (the matched prefix) and extended when the finished
-    # prefill registers the full prompt; released on EVERY retirement
+    # prefill publishes the full prompt; released on EVERY retirement
     # path — eos, length, deadline, cancel, and drain all funnel through
     # _release_pins.
     path: List["kv_blocks.RadixNode"] = field(default_factory=list)
+    # Pool pages this slot OWNS (refcount 1, allocated up front at
+    # admission to cover the whole prompt+budget span beyond the shared
+    # prefix). Shrinks when a publish transfers pages to the trie
+    # (insert_owned adoption); whatever remains is freed at retirement
+    # (_free_owned) on every path.
+    owned: List[int] = field(default_factory=list)
     # Non-None while the slot is mid-chunked-prefill (device row
     # INACTIVE: decode dispatches skip it and its chunk tokens are never
     # booked).
@@ -289,6 +311,8 @@ class ServingEngine:
         block_size: int = 16,
         kv_pool_blocks: Optional[int] = None,
         kv_hbm_budget_mb: Optional[float] = None,
+        kv_quant: str = "",
+        paged: bool = True,
         admit_cache_cap: int = 64,
         metrics_path: Optional[str] = None,
         spec_decode: bool = False,
@@ -332,29 +356,65 @@ class ServingEngine:
                 f"block_size must be a power of two >= 1 "
                 f"(got {block_size})"
             )
-        if prefill_mode == "bucketed" and block_size > self.max_seq:
-            # Exact mode never touches the block grid, so a default
-            # block_size larger than a small max_seq must not reject it.
-            raise ValueError(
-                f"block_size {block_size} exceeds max_seq {self.max_seq}"
-            )
+        if prefill_mode == "bucketed":
+            # A slot's KV is exactly its table span (max_blocks pages),
+            # so a max_seq that does not land on the block grid is
+            # rounded UP to the next multiple — pure headroom: every
+            # admission limit only relaxes, and the paged kernels'
+            # bitwise equivalence needs the span to EQUAL the row width,
+            # which rounding restores.
+            self.max_seq = -(-self.max_seq // block_size) * block_size
+        else:
+            # Exact mode never exposes the grid, but the paged pool
+            # still needs one: shrink to the largest power-of-two
+            # divisor of max_seq so the slot's table span
+            # (max_blocks * block_size) lands exactly on max_seq — the
+            # precondition for the paged kernels' bitwise equivalence
+            # with the contiguous reference.
+            while block_size > self.max_seq or self.max_seq % block_size:
+                block_size //= 2
         self.prefill_mode = prefill_mode
         self.block_size = int(block_size)
         self.admit_cache_cap = max(1, int(admit_cache_cap))
         self._max_blocks = self.max_seq // self.block_size
+        if kv_quant in (None, "none"):
+            kv_quant = ""
+        if kv_quant not in ("", "int8"):
+            raise ValueError(
+                f"kv_quant must be 'none' or 'int8' (got {kv_quant!r})")
+        self.kv_quant = kv_quant
+        if not paged:
+            raise ValueError(
+                "the contiguous engine path was removed in PR 8 — the "
+                "block pool is the only KV storage (paged=False is "
+                "unsupported; the contiguous kernels survive in "
+                "models/generate.py as the bit-exactness reference)")
+        # The pool is the ONLY KV storage, so it exists in every mode
+        # (prefix_cache merely adds the trie over it). Sizing: explicit
+        # page count > HBM budget (int8 pages are smaller, so the same
+        # budget admits more slots) > one full context per slot.
+        if kv_pool_blocks is None:
+            if kv_hbm_budget_mb is not None:
+                kv_pool_blocks = kv_blocks.blocks_for_budget(
+                    cfg, self.block_size,
+                    int(kv_hbm_budget_mb * (1 << 20)), kv_quant)
+            elif prefix_cache:
+                # One full context per slot for live reservations PLUS
+                # an equal allowance for trie tenancy — matching the PR 5
+                # layout, where the cache pool was a whole side store on
+                # top of the slots' contiguous rows. Sized tighter, every
+                # retirement-published chain would be evicted by the next
+                # wave's reservations and the cache would never hit.
+                kv_pool_blocks = 2 * n_slots * self._max_blocks
+            else:
+                kv_pool_blocks = n_slots * self._max_blocks
+        self._kv_pool_blocks = int(kv_pool_blocks)
+        self.pool = kv_blocks.BlockPool(self._kv_pool_blocks)
         self._prefix_store: Optional[kv_blocks.PrefixStore] = None
         if prefix_cache:
-            if kv_pool_blocks is None:
-                if kv_hbm_budget_mb is not None:
-                    kv_pool_blocks = kv_blocks.blocks_for_budget(
-                        cfg, self.block_size,
-                        int(kv_hbm_budget_mb * (1 << 20)))
-                else:
-                    # Default pool: one full context per slot — enough
-                    # to cache every live prompt plus a retired tail.
-                    kv_pool_blocks = n_slots * self._max_blocks
             self._prefix_store = kv_blocks.PrefixStore(
-                cfg, self.block_size, int(kv_pool_blocks))
+                cfg, self.block_size, self._kv_pool_blocks,
+                pool=self.pool)
         # Speculative decoding (docs/serving.md "Speculative decoding"):
         # draft K tokens host-side (model-free proposers), verify all
         # K+1 positions in ONE fused forward, commit the longest
@@ -400,7 +460,16 @@ class ServingEngine:
         # aggregates them from disk after the pod is gone.
         self._metrics = MetricsLogger(metrics_path) if metrics_path else None
 
-        self.cache = gen.init_slot_cache(cfg, n_slots, self.max_seq)
+        self.cache = gen.init_paged_cache(
+            cfg, n_slots, self._max_blocks, self._kv_pool_blocks,
+            self.block_size, kv_quant)
+        # Host-owned block tables, the scheduler's source of truth for
+        # which pool pages each slot reads/writes. Mirrored to the
+        # device (_push_tables) before every dispatch that could read
+        # them; the sentinel id (== n_blocks) marks unallocated entries.
+        self._tables = np.full(
+            (n_slots, self._max_blocks), self._kv_pool_blocks, np.int32)
+        self._tables_dirty = False
         self.logits = jnp.zeros((n_slots, cfg.vocab_size), jnp.float32)
         # Per-slot retirement rule, kept ON DEVICE so the fused step can
         # flip `active` itself: eos id (-1 = none), token budget, tokens
@@ -441,7 +510,7 @@ class ServingEngine:
                 )
                 toks = jax.random.categorical(key, filtered, axis=-1)
             was_active = cache.active
-            new_logits, cache = gen.decode_step_slots(
+            new_logits, cache = gen.decode_step_paged(
                 cfg, params, toks[:, None], cache)
             # On-device retirement: this token IS decoded (the stream
             # includes EOS), then the row goes inactive for every later
@@ -485,7 +554,7 @@ class ServingEngine:
             def _spec(params, logits, cache, eos, budget, emitted,
                       draft, dlen):
                 max_commit = jnp.maximum(budget - emitted, 1)
-                window, n, new_logits, cache = gen.verify_step_slots(
+                window, n, new_logits, cache = gen.verify_step_paged(
                     cfg, params, draft, dlen, logits, cache, eos,
                     max_commit)
                 emitted = emitted + n          # n = 0 on inactive rows
@@ -514,16 +583,27 @@ class ServingEngine:
         # lengths + bucket widths); survives reset() because the
         # compiled functions do too.
         self._prefill_compiles = 0
-        # One compiled pool->slot page copy (ids padded to the row's
-        # full page capacity, so ONE shape forever).
-        self._copy_fn = jax.jit(
-            gen.copy_blocks_into_slot, donate_argnums=(0,))
 
     def reset(self) -> None:
         """Drop all queued/in-flight state and zero the pool, KEEPING the
         compiled step/admission functions — benchmark harnesses reuse one
         engine across warmup and timed runs without recompiling."""
-        self.cache = gen.init_slot_cache(self.cfg, self.n_slots, self.max_seq)
+        # Rebuild the allocator + tables from scratch (cheaper and safer
+        # than unwinding every pin), MUTATING the prefix store in place:
+        # RadixProposer instances hold a reference to the store object,
+        # so replacing it would silently detach them.
+        self.pool = kv_blocks.BlockPool(self._kv_pool_blocks)
+        if self._prefix_store is not None:
+            self._prefix_store.pool = self.pool
+            self._prefix_store.trie = kv_blocks.RadixCache(
+                self.pool, self.block_size)
+        self._tables = np.full(
+            (self.n_slots, self._max_blocks), self._kv_pool_blocks,
+            np.int32)
+        self._tables_dirty = False
+        self.cache = gen.init_paged_cache(
+            self.cfg, self.n_slots, self._max_blocks,
+            self._kv_pool_blocks, self.block_size, self.kv_quant)
         self.logits = jnp.zeros((self.n_slots, self.cfg.vocab_size),
                                 jnp.float32)
         self.eos = jnp.full((self.n_slots,), -1, jnp.int32)
@@ -537,8 +617,6 @@ class ServingEngine:
         self._rids = set()
         self._done_buf = []
         self._draining = False
-        if self._prefix_store is not None:
-            self._prefix_store.clear()
 
     def register_prefix(self, tokens, cache, row: int = 0) -> int:
         """Seed the prefix trie from an EXTERNAL KV cache — the
@@ -553,7 +631,13 @@ class ServingEngine:
         :class:`~generate.SlotKVCache`), ``row`` the batch row to
         snapshot. Only full ``block_size`` blocks register. Returns the
         number of tokens now cached for this prefix (0 when the engine
-        has no prefix store)."""
+        has no prefix store).
+
+        This is the ONE path that still copies KV: external bytes must
+        enter the pool (``gen.scatter_row_into_pool``, quantize-on-write
+        for int8 pools). The serving flow itself never copies —
+        admission is pointer assembly and retirement publishes pages in
+        place."""
         if self._prefix_store is None:
             return 0
         tokens = np.asarray(tokens, np.int32).reshape(-1)
@@ -561,8 +645,12 @@ class ServingEngine:
         if n > cache.k.shape[2]:
             raise ValueError(
                 f"{n} tokens exceed cache capacity {cache.k.shape[2]}")
-        path = self._prefix_store.insert_from_row(
-            tokens, cache.k, cache.v, row)
+        path, new = self._prefix_store.trie.insert(tokens)
+        if new:
+            self.cache = gen.scatter_row_into_pool(
+                self.cache, cache.k, cache.v, row,
+                [node.block for node, _ in new],
+                [off for _, off in new], self.block_size)
         return len(path) * self.block_size
 
     # -- request intake --------------------------------------------------
@@ -578,6 +666,15 @@ class ServingEngine:
             raise ValueError(
                 f"request {req.rid}: prompt {prompt.size} + "
                 f"{req.max_new_tokens} new exceeds max_seq {self.max_seq}"
+            )
+        needed = self._blocks_needed(prompt.size, req.max_new_tokens)
+        if needed > self._kv_pool_blocks:
+            # Admission reserves the request's FULL page span up front;
+            # a request the empty pool cannot hold would requeue forever.
+            raise ValueError(
+                f"request {req.rid}: needs {needed} pool pages, pool "
+                f"holds {self._kv_pool_blocks} (raise kv_pool_blocks / "
+                f"kv_hbm_budget_mb, or shrink the request)"
             )
         if req.rid in self._rids:
             # Silent duplicate admission would corrupt any harness keyed
@@ -641,18 +738,67 @@ class ServingEngine:
             self._prefix_store.release(slot.path)
             slot.path = []
 
+    # -- block-table plumbing --------------------------------------------
+
+    def _push_tables(self) -> None:
+        """Mirror the host block tables to the device cache. Called
+        before EVERY dispatch that could read them; a no-op while clean.
+        The copy() matters: jnp.asarray on CPU may alias the numpy
+        buffer, and the host keeps mutating ``_tables`` after the
+        push."""
+        if not self._tables_dirty:
+            return
+        self.cache = self.cache._replace(
+            tables=jnp.asarray(self._tables.copy()))
+        self._tables_dirty = False
+
+    def _blocks_needed(self, prompt_size: int, max_new: int) -> int:
+        """Pages covering the request's whole prompt+budget span."""
+        return -(-(prompt_size + max_new) // self.block_size)
+
+    def _alloc_block(self) -> Optional[int]:
+        """One pool page for a slot's reservation, evicting cold trie
+        leaves while the free list runs dry. None when even eviction
+        cannot help — every page is pinned by live tables."""
+        bid = self.pool.alloc()
+        while bid is None:
+            if (self._prefix_store is None
+                    or self._prefix_store.trie.evict_one() is None):
+                return None
+            bid = self.pool.alloc()
+        return bid
+
+    def _free_owned(self, slot: _Slot) -> None:
+        """Return the slot's still-owned pages to the pool (pages a
+        publish adopted into the trie were already removed from
+        ``owned``)."""
+        for bid in slot.owned:
+            self.pool.unref(bid)
+        slot.owned = []
+
+    def _clear_table_row(self, i: int) -> None:
+        """Reset slot ``i``'s host table row to the sentinel. The stale
+        DEVICE row persists until the next push, which is safe: the
+        row's ``active`` bit is already clear by every path that gets
+        here, and the paged kernels write nothing on inactive rows."""
+        self._tables[i] = self._kv_pool_blocks
+        self._tables_dirty = True
+
     def _retire_slot(self, i: int, slot: _Slot, reason: str,
                      now: float) -> Completion:
         """Host-side policy retirement of an in-flight slot: emit the
         partial completion, free the slot, release its prefix-cache
-        pins, and clear the device row's ``active`` bit so the next
-        dispatch stops advancing it. The pending chunk's tokens for this
-        row are dropped by the snapshot-identity check in
-        _process_pending — row-local, so neighbors' greedy streams are
-        untouched. A slot still mid-chunked-prefill retires the same
-        way: its row was never activated, and the next tenant's
-        copy/chunk writes land at absolute positions."""
+        pins, return its owned pages, clear its table row, and clear
+        the device row's ``active`` bit so the next dispatch stops
+        advancing it. The pending chunk's tokens for this row are
+        dropped by the snapshot-identity check in _process_pending —
+        row-local, so neighbors' greedy streams are untouched. A slot
+        still mid-chunked-prefill retires the same way: its row was
+        never activated, and a freed page's next tenant overwrites
+        every position before its length mask can expose it."""
         self._release_pins(slot)
+        self._free_owned(slot)
+        self._clear_table_row(i)
         comp = Completion(
             rid=slot.req.rid, tokens=slot.tokens, finish_reason=reason,
             submit_t=slot.submit_t, first_token_t=slot.first_token_t,
@@ -698,7 +844,7 @@ class ServingEngine:
 
         def admit(params, prompt, cache, logits_buf, eos, budget,
                   emitted, slot, eos_val, budget_val):
-            row_logits, cache = gen.prefill_into_slot(
+            row_logits, cache = gen.prefill_into_paged(
                 cfg, params, prompt, cache, slot)
             logits_buf = jax.lax.dynamic_update_slice(
                 logits_buf, row_logits.astype(logits_buf.dtype),
@@ -728,7 +874,7 @@ class ServingEngine:
 
         def chunk(params, toks, cache, logits_buf, eos, budget, emitted,
                   slot, offset, n_real, eos_val, budget_val, activate):
-            row_logits, cache = gen.prefill_chunk_into_slot(
+            row_logits, cache = gen.prefill_chunk_paged(
                 cfg, params, toks, cache, slot, offset, n_real)
             logits_buf = jax.lax.dynamic_update_slice(
                 logits_buf, row_logits.astype(logits_buf.dtype),
@@ -775,22 +921,61 @@ class ServingEngine:
         """Fill every free slot from the queue. The other slots' cache
         rows are untouched — they resume decoding in the same step.
 
+        Admission is POINTER ASSEMBLY over the pool: walk the prefix
+        trie (bucketed mode), append the matched chain's page ids to the
+        slot's table row by reference (refcount++, zero device bytes
+        moved), then allocate owned pages covering the REST of the
+        request's full prompt+budget span — all up front, evicting cold
+        trie leaves as needed, so no admitted request can ever strand
+        mid-decode on a full pool. A request whose reservation cannot be
+        met even after eviction goes back to the queue head (its pins
+        and partial pages released) and admission stops for this step.
+
         ``exact`` mode prefills the whole prompt on admit (one compiled
-        fn per length). ``bucketed`` mode walks the prefix trie,
-        device-copies the longest cached prefix's pool pages into the
-        row, and leaves a :class:`_Prefill` cursor at the match point —
-        :meth:`_advance_prefills` runs the uncached suffix one chunk per
-        step, interleaved with decode."""
+        fn per length); ``bucketed`` mode leaves a :class:`_Prefill`
+        cursor at the match point — :meth:`_advance_prefills` runs the
+        uncached suffix one chunk per step, interleaved with decode."""
         self._shed_queued()
         while self.queue:
             try:
                 slot = self.slots.index(None)
             except ValueError:
-                return                      # pool full
+                return                      # slots full
             q = self.queue.popleft()
             req = q.req
             now = self._clock()
+            path: List[kv_blocks.RadixNode] = []
+            matched = 0
+            if (self.prefill_mode != "exact"
+                    and self._prefix_store is not None):
+                path, matched = (
+                    self._prefix_store.match_for_admission(req.prompt))
+                self.stats.prefix_lookup_tokens += req.prompt.size
+                self.stats.prefix_hit_tokens += matched
+                self.stats.prefix_zero_copy_tokens += matched
+            needed = self._blocks_needed(
+                req.prompt.size, req.max_new_tokens)
+            owned: List[int] = []
+            while len(path) + len(owned) < needed:
+                bid = self._alloc_block()
+                if bid is None:
+                    # Reservation unmet: unwind and requeue at the HEAD
+                    # (FIFO order is a fairness contract) — retirements
+                    # will refill the free list.
+                    for b in owned:
+                        self.pool.unref(b)
+                    if path:
+                        self._prefix_store.release(path)
+                    self.queue.appendleft(q)
+                    return
+                owned.append(bid)
+            row = self._tables[slot]
+            row[:] = self._kv_pool_blocks
+            row[:len(path)] = [n.block for n in path]
+            row[len(path):needed] = owned
+            self._tables_dirty = True
             if self.prefill_mode == "exact":
+                self._push_tables()
                 admit = self._admit_fn(req.prompt.size)
                 (self.cache, self.logits, self.eos, self.budget,
                  self.emitted) = admit(
@@ -806,29 +991,13 @@ class ServingEngine:
                 self.slots[slot] = _Slot(
                     req=req, submit_t=q.submit_t, admit_t=now,
                     deadline_t=q.deadline_t, spec_k=self.draft_k,
+                    owned=owned,
                 )
             else:
-                path: List[kv_blocks.RadixNode] = []
-                matched = 0
-                if self._prefix_store is not None:
-                    path, matched = (
-                        self._prefix_store.match_for_admission(
-                            req.prompt))
-                    self.stats.prefix_lookup_tokens += req.prompt.size
-                    self.stats.prefix_hit_tokens += matched
-                    if matched:
-                        ids = np.zeros((self._max_blocks,), np.int32)
-                        ids[:len(path)] = [n.block for n in path]
-                        self.cache = self._copy_fn(
-                            self.cache, self._prefix_store.k,
-                            self._prefix_store.v, jnp.asarray(ids),
-                            jnp.asarray(matched, jnp.int32),
-                            jnp.asarray(slot, jnp.int32),
-                        )
                 self.slots[slot] = _Slot(
                     req=req, submit_t=q.submit_t, admit_t=now,
                     deadline_t=q.deadline_t, path=path,
-                    spec_k=self.draft_k,
+                    spec_k=self.draft_k, owned=owned,
                     prefill=_Prefill(
                         tokens=req.prompt, next_off=matched,
                         eos_val=(-1 if req.eos_id is None
@@ -846,8 +1015,9 @@ class ServingEngine:
         newly-admitted prompt is). Chunks sit on the absolute
         ``block_size`` grid; the final (possibly partial) chunk pads to
         a power-of-two bucket, installs the last real position's logits,
-        activates the row, and registers the prompt's full blocks in the
-        prefix trie."""
+        activates the row, and publishes the prompt's full blocks to the
+        prefix trie (ownership transfer — the pages are already in the
+        pool)."""
         bs = self.block_size
         for i, slot in enumerate(self.slots):
             if slot is None or slot.prefill is None:
@@ -865,6 +1035,7 @@ class ServingEngine:
             buf = np.zeros((1, w), np.int32)
             buf[0, :w_real] = tokens[off:off + w_real]
             fn = self._chunk_fn(w)
+            self._push_tables()
             (self.cache, self.logits, self.eos, self.budget,
              self.emitted) = fn(
                 self.params, jnp.asarray(buf), self.cache, self.logits,
@@ -880,13 +1051,23 @@ class ServingEngine:
             p.next_off = off + w_real
             if final:
                 if self._prefix_store is not None:
-                    # Register the prompt's full blocks: copy KV for
-                    # blocks the trie didn't already hold out of this
-                    # row, then extend this request's pin to the whole
-                    # chain (released at retirement).
-                    full = self._prefix_store.insert_from_row(
-                        tokens, self.cache.k, self.cache.v, i,
-                        known_path=slot.path)
+                    # Publish the prompt's full blocks: their KV is
+                    # already in this slot's own pool pages, so blocks
+                    # the trie lacks are ADOPTED in place (ownership
+                    # transfer, zero bytes moved); then extend this
+                    # request's pin to the whole chain (released at
+                    # retirement). Blocks another slot published first
+                    # stay owned duplicates — this table keeps reading
+                    # its own copy until retirement frees it.
+                    owned_map = {
+                        o: int(self._tables[i, o // bs])
+                        for o in range(len(slot.path) * bs,
+                                       (tokens.size // bs) * bs, bs)
+                    }
+                    full, adopted = self._prefix_store.trie.insert_owned(
+                        tokens, owned_map, known_path=slot.path)
+                    for o in adopted:
+                        slot.owned.remove(owned_map[o])
                     ext = full[len(slot.path):]
                     self._prefix_store.trie.acquire(ext)
                     slot.path = slot.path + ext
@@ -951,6 +1132,7 @@ class ServingEngine:
             else:
                 self._step_idx += 1
                 key = jax.random.fold_in(self._rng, self._step_idx)
+            self._push_tables()
             toks, next_tok, self.logits, self.cache, self.emitted = (
                 self._step_fn(
                     self.params, self.logits, self.cache, self.eos,
@@ -1019,6 +1201,7 @@ class ServingEngine:
                 for i, s in enumerate(snapshot_p):
                     if s is not None and self._spec_cooldown[i] > 0:
                         self._spec_cooldown[i] -= 1
+                self._push_tables()
                 toks, next_tok, self.logits, self.cache, self.emitted = (
                     self._step_fn(
                         self.params, self.logits, self.cache, self.eos,
@@ -1040,6 +1223,7 @@ class ServingEngine:
         if n_decoding > 0:
             self.stats.spec_probe_steps += 1
             proposal = self._propose_drafts(snapshot)
+            self._push_tables()
             if proposal is not None:
                 draft, dlen = proposal
                 window, n, next_tok, self.logits, self.cache, \
@@ -1239,13 +1423,16 @@ class ServingEngine:
 
     def _sync_stats(self) -> None:
         """Refresh the gauges ServingStats carries alongside its
-        counters: compile-cache sizes and block-pool occupancy."""
+        counters: compile-cache sizes and block-pool occupancy. The pool
+        is the only KV storage, so the gauges report in every mode —
+        resident pages are slot reservations plus trie tenancy."""
         self.stats.prefill_compiles = self._prefill_compiles
         self.stats.admit_cache_size = len(self._admits)
-        if self._prefix_store is not None:
-            self.stats.pool_blocks_total = self._prefix_store.n_blocks
-            self.stats.pool_blocks_in_use = (
-                self._prefix_store.pool.used_blocks)
+        self.stats.pool_blocks_total = self.pool.n_blocks
+        self.stats.pool_blocks_in_use = self.pool.used_blocks
+        self.stats.pool_blocks_resident = self.pool.used_blocks
+        self.stats.kv_bytes_per_token = kv_blocks.kv_bytes_per_token(
+            self.cfg, self.kv_quant)
 
     def _book_token(self, i: int, slot: _Slot, tok: int,
                     now: float) -> Optional[Completion]:
@@ -1270,16 +1457,29 @@ class ServingEngine:
             return None
         if self._prefix_store is not None:
             # RadixAttention semantics: the finished row's DECODED
-            # tokens join the trie too (their KV is already in the row
-            # — every committed token's KV landed before the row went
-            # inactive), so a follow-up turn whose prompt extends this
-            # conversation reuses reply blocks, not just prompt blocks.
+            # tokens join the trie too (their KV is already in the
+            # slot's own pool pages — every committed token's KV landed
+            # before the row went inactive), so a follow-up turn whose
+            # prompt extends this conversation reuses reply blocks, not
+            # just prompt blocks. Pure ownership transfer: full blocks
+            # the trie lacks adopt this slot's pages in place; the
+            # partial tail block (and any dedup-losing duplicates) are
+            # freed by _free_owned below.
             full = np.concatenate([
                 req.prompt, np.asarray(slot.tokens, np.int32)])
-            self._prefix_store.insert_from_row(
-                full, self.cache.k, self.cache.v, i,
-                known_path=slot.path)
+            bs = self.block_size
+            owned_map = {
+                o: int(self._tables[i, o // bs])
+                for o in range(len(slot.path) * bs,
+                               (full.size // bs) * bs, bs)
+            }
+            _, adopted = self._prefix_store.trie.insert_owned(
+                full, owned_map, known_path=slot.path)
+            for o in adopted:
+                slot.owned.remove(owned_map[o])
         self._release_pins(slot)
+        self._free_owned(slot)
+        self._clear_table_row(i)
         comp = Completion(
             rid=req.rid, tokens=slot.tokens,
             finish_reason="eos" if done_eos else "length",

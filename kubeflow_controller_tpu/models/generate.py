@@ -536,99 +536,296 @@ def prefill_into_slot(
     )
 
 
-def init_block_pool(
-    cfg: TransformerConfig, n_blocks: int, block_size: int,
-) -> Tuple[jax.Array, jax.Array]:
-    """Shared KV page pool for prefix caching (dataplane/kv_blocks.py):
-    ``n_blocks`` pages of ``block_size`` tokens each, all layers in one
-    array so a whole page moves in one gather/scatter."""
+# ---------------------------------------------------------------------------
+# Paged KV: the block pool IS the KV storage (vLLM PagedAttention /
+# SGLang RadixAttention semantics). Every kernel below reads and writes
+# pool pages through a per-slot block table — there is no per-slot
+# contiguous row, so a radix-cache hit is a table entry (refcount++ on
+# the host, zero device bytes moved) and retirement publishes pages that
+# are already in place. The contiguous SlotKVCache kernels above survive
+# as the bit-exactness reference the paged tests pin against.
+# ---------------------------------------------------------------------------
+
+
+class PagedKVCache(NamedTuple):
+    """Block-table-indexed KV for continuous batching: the pool's
+    ``[L, n_blocks, block_size, KVH, D]`` pages are the ONLY KV storage,
+    and each slot reads/writes through its row of ``tables``.
+
+    ``tables[slot, i]`` is the pool page backing the slot's logical
+    columns ``[i*bs, (i+1)*bs)``, or the sentinel ``n_blocks``
+    (unallocated): sentinel reads clamp into finite garbage the
+    ``length`` mask never lets through, sentinel writes drop. A slot's
+    logical row is ``tables.shape[1] * block_size`` columns wide — the
+    gathered view is cut to exactly that width, so the fp paged kernels
+    run the contiguous kernels' math on identical shapes and identical
+    bytes (bitwise-equal outputs whenever ``block_size`` divides the
+    reference row width; pinned by the kernel-equivalence tests).
+
+    ``kv_quant="int8"`` pools store pages as int8 with per-(page row,
+    head) fp32 symmetric scales — quantize-on-write in the scatter,
+    dequantize-in-gather in the view — and carry ``None`` scales in fp
+    mode (``None`` is an empty pytree leaf, so jit/donation treat both
+    layouts uniformly)."""
+
+    k: jax.Array          # [L, n_blocks, bs, KVH, D] cfg.dtype | int8
+    v: jax.Array
+    k_scale: Optional[jax.Array]   # [L, n_blocks, bs, KVH] f32 | None
+    v_scale: Optional[jax.Array]
+    tables: jax.Array     # [B, max_blocks] int32 — sentinel = n_blocks
+    length: jax.Array     # [B] int32 — valid positions per slot
+    active: jax.Array     # [B] bool — slot is decoding (length advances)
+
+
+def init_paged_cache(
+    cfg: TransformerConfig, n_slots: int, max_blocks: int,
+    n_blocks: int, block_size: int, kv_quant: str = "",
+) -> PagedKVCache:
+    """A zeroed pool of ``n_blocks`` pages plus all-sentinel tables for
+    ``n_slots`` slots of ``max_blocks`` pages each."""
     shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads,
              cfg.head_dim)
-    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+    if kv_quant == "int8":
+        k = jnp.zeros(shape, jnp.int8)
+        v = jnp.zeros(shape, jnp.int8)
+        k_scale = jnp.zeros(shape[:-1], jnp.float32)
+        v_scale = jnp.zeros(shape[:-1], jnp.float32)
+    elif kv_quant:
+        raise ValueError(f"unknown kv_quant {kv_quant!r} (want '' or 'int8')")
+    else:
+        k = jnp.zeros(shape, cfg.dtype)
+        v = jnp.zeros(shape, cfg.dtype)
+        k_scale = None
+        v_scale = None
+    return PagedKVCache(
+        k=k, v=v, k_scale=k_scale, v_scale=v_scale,
+        tables=jnp.full((n_slots, max_blocks), n_blocks, jnp.int32),
+        length=jnp.zeros((n_slots,), jnp.int32),
+        active=jnp.zeros((n_slots,), bool),
+    )
 
 
-def copy_blocks_into_slot(
-    cache: SlotKVCache,
-    pool_k: jax.Array,          # [L, n_blocks, bs, KVH, D]
-    pool_v: jax.Array,
-    block_ids: jax.Array,       # [max_blocks] int32 — PADDED to capacity
-    n_tokens: jax.Array,        # [] int32 — real cached-prefix length
-    slot: jax.Array,            # [] int32
-) -> SlotKVCache:
-    """Install a cached prefix: gather ``block_ids``' pages and write
-    them contiguously from column 0 of slot ``slot``'s row.
+def _kv_quantize(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric int8 for KV pages: per-(token, head) scales over the
+    head_dim axis (``[..., KVH, D] -> int8 same shape + f32 [..., KVH]``).
+    Finer than the per-(page, head) granularity a weight would get, and
+    deliberately so: the pool is append-only (each page row is written
+    exactly once), so per-row scales quantize every token against its
+    own amax with no read-modify-write requantisation of already-
+    committed neighbours — the error per token is fixed at write time
+    and never drifts."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-30) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
 
-    ``block_ids`` is padded to the slot's full page capacity (pad value:
-    any valid id) so the copy compiles ONCE — the pad pages land as
-    garbage beyond ``n_tokens``, unreachable by the row's
-    ``arange <= pos`` mask and overwritten in order by the suffix
-    prefill/decode, the same discipline stale-tenant KV already obeys.
-    ``length[slot] = n_tokens``; the slot stays INACTIVE — it is
-    mid-admission until the suffix prefill completes.
-    """
-    L, _, bs, kvh, d = pool_k.shape
-    mb = block_ids.shape[0]
-    span = mb * bs
-    if span > cache.k.shape[2]:
-        raise ValueError(
-            f"{mb} pages x {bs} tokens exceeds slot capacity "
-            f"{cache.k.shape[2]}"
+
+def _pool_write(pool, scale, idx, val):
+    """Scatter ``val`` into pool pages at ``idx`` (an index tuple whose
+    page-id component may hold the drop sentinel), quantizing on write
+    when the pool is int8. Returns the updated (pool, scale)."""
+    if scale is None:
+        return pool.at[idx].set(val.astype(pool.dtype), mode="drop"), None
+    q, s = _kv_quantize(val)
+    return (pool.at[idx].set(q, mode="drop"),
+            scale.at[idx].set(s, mode="drop"))
+
+
+def _decode_layer_paged(
+    cfg: TransformerConfig,
+    lp: Params,
+    x: jax.Array,               # [B, 1, D_model]
+    pos: jax.Array,             # [B] int32 — per-slot write position
+    layer: jax.Array,           # [] int32 layer index into the pool
+    cache: PagedKVCache,
+):
+    """``_decode_layer_slots`` reading and writing the block pool through
+    per-slot tables: row b scatters its new k/v into page
+    ``tables[b, pos[b] // bs]`` at page row ``pos[b] % bs`` (sentinel
+    pages drop the write), then attends over the table-gathered view of
+    its pages — the same einsum/mask/softmax ops at the same width on
+    the same bytes, so the fp path is bitwise the contiguous kernel."""
+    from kubeflow_controller_tpu.ops.attention import paged_kv_view
+
+    b = x.shape[0]
+    hd = cfg.head_dim
+    dt = cfg.dtype
+    n_blocks, bs = cache.k.shape[1], cache.k.shape[2]
+    mb = cache.tables.shape[1]
+    width = mb * bs
+
+    h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ _w(lp, "wq", dt)).reshape(b, 1, cfg.n_heads, hd)
+    k = (h @ _w(lp, "wk", dt)).reshape(b, 1, cfg.n_kv_heads, hd)
+    v = (h @ _w(lp, "wv", dt)).reshape(b, 1, cfg.n_kv_heads, hd)
+    positions = pos[:, None]                     # [B, 1]
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    bi = jnp.clip(pos // bs, 0, mb - 1)
+    blk = jnp.take_along_axis(cache.tables, bi[:, None], axis=1)[:, 0]
+    # Inactive rows drop their write: a retired slot's table row stays
+    # on device until the host's next push, and its pages may already be
+    # freed, re-allocated, or published — the contiguous kernel's
+    # harmless scratch write would be a cross-slot corruption here.
+    blk = jnp.where(cache.active & (pos < width), blk, n_blocks)
+    off = pos % bs
+    k_pool, k_scale = _pool_write(
+        cache.k, cache.k_scale, (layer, blk, off), k[:, 0])
+    v_pool, v_scale = _pool_write(
+        cache.v, cache.v_scale, (layer, blk, off), v[:, 0])
+    k_cache = paged_kv_view(
+        k_pool[layer], cache.tables, width,
+        scale=None if k_scale is None else k_scale[layer],
+        out_dtype=dt)                            # [B, width, KVH, D]
+    v_cache = paged_kv_view(
+        v_pool[layer], cache.tables, width,
+        scale=None if v_scale is None else v_scale[layer],
+        out_dtype=dt)
+
+    rep = cfg.n_heads // cfg.n_kv_heads
+    qg = q.reshape(b, 1, cfg.n_kv_heads, rep, hd)
+    s = jnp.einsum(
+        "bqgrd,bkgd->bgrqk", qg, k_cache,
+        preferred_element_type=jnp.float32,
+    ) * (hd ** -0.5)                             # [B, G, rep, 1, S]
+    valid = jnp.arange(width)[None, :] <= pos[:, None]       # [B, S]
+    s = jnp.where(valid[:, None, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    attn = jnp.einsum(
+        "bgrqk,bkgd->bqgrd", p, v_cache
+    ).reshape(b, 1, -1)
+    x = x + attn @ _w(lp, "wo", dt)
+
+    h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.moe_experts:
+        x = x + _moe_decode_ffn(cfg, lp, h)
+    else:
+        gate = jax.nn.silu(h @ _w(lp, "w_gate", dt))
+        up = h @ _w(lp, "w_up", dt)
+        x = x + (gate * up) @ _w(lp, "w_down", dt)
+    return x, k_pool, v_pool, k_scale, v_scale
+
+
+def decode_step_paged(
+    cfg: TransformerConfig,
+    params: Params,
+    tokens: jax.Array,          # [B, 1] int32
+    cache: PagedKVCache,
+) -> Tuple[jax.Array, PagedKVCache]:
+    """``decode_step_slots`` over the paged pool: one token for every
+    slot at its own position, appends landing in each slot's tail page
+    in place. ``length`` advances only on active slots; tables are
+    read-only here (the host owns them)."""
+    x = params["embed"].astype(cfg.dtype)[tokens]     # [B, 1, D]
+    pos = cache.length
+
+    def body(layer, state):
+        x, k, v, ks, vs = state
+        lp = jax.tree.map(
+            lambda p: lax.dynamic_index_in_dim(p, layer, keepdims=False),
+            params["layers"],
         )
-    pk = pool_k[:, block_ids].reshape(L, 1, span, kvh, d)
-    pv = pool_v[:, block_ids].reshape(L, 1, span, kvh, d)
-    k = lax.dynamic_update_slice(
-        cache.k, pk.astype(cache.k.dtype), (0, slot, 0, 0, 0))
-    v = lax.dynamic_update_slice(
-        cache.v, pv.astype(cache.v.dtype), (0, slot, 0, 0, 0))
-    return SlotKVCache(
-        k=k, v=v,
-        length=cache.length.at[slot].set(n_tokens),
-        active=cache.active.at[slot].set(False),
+        c = cache._replace(k=k, v=v, k_scale=ks, v_scale=vs)
+        return _decode_layer_paged(cfg, lp, x, pos, layer, c)
+
+    x, k, v, ks, vs = lax.fori_loop(
+        0, cfg.n_layers, body,
+        (x, cache.k, cache.v, cache.k_scale, cache.v_scale),
+    )
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = _head_logits(cfg, params, x[:, 0])
+    return logits, cache._replace(
+        k=k, v=v, k_scale=ks, v_scale=vs,
+        length=jnp.where(cache.active, pos + 1, pos),
+    )
+
+
+def prefill_into_paged(
+    cfg: TransformerConfig,
+    params: Params,
+    prompt: jax.Array,          # [1, S] int32 — ONE request's prompt
+    cache: PagedKVCache,
+    slot: jax.Array,            # [] int32 — destination slot
+) -> Tuple[jax.Array, PagedKVCache]:
+    """``prefill_into_slot`` for the paged pool: block-prefill the
+    prompt (the identical fused forward — identical logits and KV bytes)
+    and scatter the S positions into the pages of slot ``slot``'s table.
+    ``length[slot] = S``, ``active[slot] = True``; every other slot's
+    pages are untouched. Compiles once per prompt length."""
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            f"prefill_into_paged admits one request (got batch "
+            f"{prompt.shape[0]})"
+        )
+    n_blocks, bs = cache.k.shape[1], cache.k.shape[2]
+    mb = cache.tables.shape[1]
+    s = prompt.shape[1]
+    if s > mb * bs:
+        raise ValueError(
+            f"prompt {s} exceeds slot capacity {mb * bs}"
+        )
+    logits, mini = prefill(
+        cfg, params, prompt, init_kv_cache(cfg, 1, s))
+    trow = cache.tables[slot]                    # [mb]
+    cols = jnp.arange(s, dtype=jnp.int32)
+    blk = trow[jnp.clip(cols // bs, 0, mb - 1)]  # s <= mb*bs checked above
+    off = cols % bs
+    k, k_scale = _pool_write(
+        cache.k, cache.k_scale, (slice(None), blk, off), mini.k[:, 0])
+    v, v_scale = _pool_write(
+        cache.v, cache.v_scale, (slice(None), blk, off), mini.v[:, 0])
+    return logits, cache._replace(
+        k=k, v=v, k_scale=k_scale, v_scale=v_scale,
+        length=cache.length.at[slot].set(s),
+        active=cache.active.at[slot].set(True),
     )
 
 
 @jax.jit
-def _copy_row_into_blocks(pool_k, pool_v, cache_k, cache_v, row, ids,
-                          starts, cols):
+def _scatter_row_into_pool(pool_k, pool_v, k_scale, v_scale,
+                           cache_k, cache_v, row, ids, cols):
     rk = cache_k[:, row]                         # [L, S, KVH, D]
     rv = cache_v[:, row]
     bk = rk[:, cols]                             # [L, m, bs, KVH, D]
     bv = rv[:, cols]
-    pool_k = pool_k.at[:, ids].set(bk.astype(pool_k.dtype), mode="drop")
-    pool_v = pool_v.at[:, ids].set(bv.astype(pool_v.dtype), mode="drop")
-    return pool_k, pool_v
+    pool_k, k_scale = _pool_write(pool_k, k_scale, (slice(None), ids), bk)
+    pool_v, v_scale = _pool_write(pool_v, v_scale, (slice(None), ids), bv)
+    return pool_k, pool_v, k_scale, v_scale
 
 
-def copy_row_into_blocks(
-    pool_k: jax.Array,
-    pool_v: jax.Array,
-    cache_k: jax.Array,         # [L, B, S, KVH, D] — slot cache OR KVCache
-    cache_v: jax.Array,
+def scatter_row_into_pool(
+    cache: PagedKVCache,
+    ext_k: jax.Array,           # [L, B, S, KVH, D] — an EXTERNAL cache
+    ext_v: jax.Array,
     row: int,
-    ids,                        # page ids, one per new block
+    ids,                        # page ids, one per full block
     starts,                     # token offset of each block in the row
     block_size: int,
-) -> Tuple[jax.Array, jax.Array]:
-    """Snapshot full blocks OUT of a cache row into pool pages (the
-    insert path after a prefill completes, or external registration of a
-    ``generate_from_cache`` session). The id/start lists are padded to
-    the next power of two with an out-of-range page id, which the
-    ``mode="drop"`` scatter discards — compile count stays O(log) in the
-    number of pages per insert, not linear."""
+) -> PagedKVCache:
+    """Ingest full blocks from an external contiguous cache row into
+    pool pages — the multi-turn ``register_prefix`` path, where a
+    ``generate_from_cache`` session's KV enters the pool from outside.
+    This is the ONE copying path left: the serving flow itself never
+    copies KV (admission is pointer assembly, retirement publishes pages
+    in place). Quantizes on write for int8 pools. The id/start lists pad
+    to the next power of two with a dropped sentinel id, so compile
+    count stays O(log) in pages per ingest."""
     m = 1
     while m < len(ids):
         m *= 2
-    sentinel = pool_k.shape[1]                   # OOB -> dropped
+    sentinel = cache.k.shape[1]                  # OOB -> dropped
     ids_arr = np.full((m,), sentinel, np.int32)
     ids_arr[:len(ids)] = ids
     starts_arr = np.zeros((m,), np.int32)
     starts_arr[:len(starts)] = starts
     cols = (starts_arr[:, None]
             + np.arange(block_size, dtype=np.int32)[None, :])
-    return _copy_row_into_blocks(
-        pool_k, pool_v, cache_k, cache_v, jnp.asarray(row, jnp.int32),
-        jnp.asarray(ids_arr), jnp.asarray(starts_arr), jnp.asarray(cols),
+    k, v, ks, vs = _scatter_row_into_pool(
+        cache.k, cache.v, cache.k_scale, cache.v_scale,
+        ext_k, ext_v, jnp.asarray(row, jnp.int32),
+        jnp.asarray(ids_arr), jnp.asarray(cols),
     )
+    return cache._replace(k=k, v=v, k_scale=ks, v_scale=vs)
 
 
 def prefill_chunk_into_slot(
@@ -749,6 +946,121 @@ def prefill_chunk_into_slot(
         k=k, v=v,
         length=cache.length.at[slot].set(offset + n_real),
         active=cache.active,
+    )
+
+
+def prefill_chunk_paged(
+    cfg: TransformerConfig,
+    params: Params,
+    toks: jax.Array,            # [1, W] int32 — chunk, PADDED to W
+    cache: PagedKVCache,
+    slot: jax.Array,            # [] int32
+    offset: jax.Array,          # [] int32 — absolute start position
+    n_real: jax.Array,          # [] int32 — real (un-padded) chunk length
+) -> Tuple[jax.Array, PagedKVCache]:
+    """``prefill_chunk_into_slot`` over the paged pool: the chunk
+    attends to the table-gathered view of the slot's prior pages (a
+    shared radix prefix reads IN PLACE — no copy ever ran) plus
+    intra-chunk causal, and its k/v scatter straight into the slot's
+    own pages at absolute columns ``offset + [0, W)``. Same bucketing
+    and padding discipline, same math at the same width — the fp path
+    is bitwise the contiguous kernel."""
+    if toks.shape[0] != 1:
+        raise ValueError(
+            f"prefill_chunk_paged admits one request (got batch "
+            f"{toks.shape[0]})"
+        )
+    from kubeflow_controller_tpu.ops.attention import paged_kv_view
+
+    b, w = toks.shape
+    dt = cfg.dtype
+    hd = cfg.head_dim
+    n_blocks, bs = cache.k.shape[1], cache.k.shape[2]
+    mb = cache.tables.shape[1]
+    width = mb * bs
+    rep = cfg.n_heads // cfg.n_kv_heads
+    trow = cache.tables[slot]                    # [mb]
+    kc_row = paged_kv_view(
+        cache.k, trow, width, scale=cache.k_scale, out_dtype=dt,
+    )                                            # [L, width, KVH, D]
+    vc_row = paged_kv_view(
+        cache.v, trow, width, scale=cache.v_scale, out_dtype=dt,
+    )
+
+    x = params["embed"].astype(dt)[toks]         # [1, W, D]
+    positions = offset + jnp.broadcast_to(
+        jnp.arange(w, dtype=jnp.int32), (b, w))
+    if cfg.moe_experts:
+        moe_cfg = cfg.replace(
+            moe_capacity_factor=float(cfg.moe_experts) / cfg.moe_top_k
+        )
+    cache_cols = jnp.arange(width, dtype=jnp.int32)
+    causal = (
+        jnp.arange(w, dtype=jnp.int32)[:, None]
+        >= jnp.arange(w, dtype=jnp.int32)[None, :]
+    )                                            # [W, W]
+
+    def body(x, layer_in):
+        lp, kc, vc = layer_in                    # kc [width, KVH, D]
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ _w(lp, "wq", dt)).reshape(b, w, cfg.n_heads, hd)
+        k = (h @ _w(lp, "wk", dt)).reshape(b, w, cfg.n_kv_heads, hd)
+        v = (h @ _w(lp, "wv", dt)).reshape(b, w, cfg.n_kv_heads, hd)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        qg = q.reshape(b, w, cfg.n_kv_heads, rep, hd)
+        scale = hd ** -0.5
+        s_cache = jnp.einsum(
+            "bqgrd,kgd->bgrqk", qg, kc,
+            preferred_element_type=jnp.float32,
+        ) * scale                                # [1,G,rep,W,width]
+        s_cache = jnp.where(
+            (cache_cols < offset)[None, None, None, None, :],
+            s_cache, -1e30,
+        )
+        s_new = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, k,
+            preferred_element_type=jnp.float32,
+        ) * scale                                # [1,G,rep,W,W]
+        s_new = jnp.where(causal[None, None, None], s_new, -1e30)
+        p = jax.nn.softmax(
+            jnp.concatenate([s_cache, s_new], axis=-1), axis=-1
+        ).astype(dt)
+        attn = (
+            jnp.einsum("bgrqk,kgd->bqgrd", p[..., :width], vc)
+            + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., width:], v)
+        ).reshape(b, w, -1)
+        x = x + attn @ _w(lp, "wo", dt)
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        if cfg.moe_experts:
+            down, _aux = tfm._moe_ffn(moe_cfg, _dense_lp(lp, dt), h2)
+            x = x + down
+        else:
+            gate = jax.nn.silu(h2 @ _w(lp, "w_gate", dt))
+            up = h2 @ _w(lp, "w_up", dt)
+            x = x + (gate * up) @ _w(lp, "w_down", dt)
+        return x, (k[0], v[0])                   # [W, KVH, D]
+
+    x, (k_new, v_new) = lax.scan(
+        body, x, (params["layers"], kc_row, vc_row))
+    # Scatter the chunk's k/v into the slot's pages at absolute columns
+    # offset + [0, W); pad columns past the table span (or landing on a
+    # sentinel entry) drop instead of clamping onto live pages.
+    wcols = offset + jnp.arange(w, dtype=jnp.int32)
+    blk = trow[jnp.clip(wcols // bs, 0, mb - 1)]
+    blk = jnp.where(wcols < width, blk, n_blocks)
+    woff = wcols % bs
+    k, k_scale = _pool_write(
+        cache.k, cache.k_scale, (slice(None), blk, woff), k_new)
+    v, v_scale = _pool_write(
+        cache.v, cache.v_scale, (slice(None), blk, woff), v_new)
+    x_last = lax.dynamic_slice(
+        x, (0, n_real - 1, 0), (1, 1, x.shape[-1]))[:, 0]
+    logits = _head_logits(
+        cfg, params, rmsnorm(x_last, params["final_norm"], cfg.norm_eps))
+    return logits, cache._replace(
+        k=k, v=v, k_scale=k_scale, v_scale=v_scale,
+        length=cache.length.at[slot].set(offset + n_real),
     )
 
 
@@ -910,6 +1222,142 @@ def verify_step_slots(
         all_logits, idx[:, None, None], axis=1)[:, 0]
     return window, n, new_logits, SlotKVCache(
         k=k_all, v=v_all, length=pos0 + n, active=cache.active)
+
+
+def verify_step_paged(
+    cfg: TransformerConfig,
+    params: Params,
+    draft: jax.Array,           # [B, K] int32 — proposed continuations
+    draft_len: jax.Array,       # [B] int32 in [0, K] — valid drafts/row
+    logits: jax.Array,          # [B, vocab] — carried last-position logits
+    cache: PagedKVCache,
+    eos: jax.Array,             # [B] int32 — per-row EOS id (-1 = none)
+    max_commit: jax.Array,      # [B] int32 — commit budget cap, >= 1
+) -> Tuple[jax.Array, jax.Array, jax.Array, PagedKVCache]:
+    """``verify_step_slots`` over the paged pool: the K+1 verify window
+    attends to each slot's table-gathered page view, and ONLY the
+    accepted positions' k/v scatter into the slot's own pages (rejected
+    and padded positions map to the drop sentinel — rollback is still
+    by never committing). Acceptance, budget/EOS truncation, and the
+    carried logits are the contiguous verifier's code verbatim, so the
+    fp paged path commits the bitwise-identical stream."""
+    from kubeflow_controller_tpu.ops.attention import paged_kv_view
+
+    b, k_draft = draft.shape
+    w = k_draft + 1
+    dt = cfg.dtype
+    hd = cfg.head_dim
+    n_blocks, bs = cache.k.shape[1], cache.k.shape[2]
+    mb = cache.tables.shape[1]
+    width = mb * bs
+    rep = cfg.n_heads // cfg.n_kv_heads
+    pos0 = cache.length                              # [B]
+    kview = paged_kv_view(
+        cache.k, cache.tables, width, scale=cache.k_scale, out_dtype=dt,
+    )                                                # [L, B, width, KVH, D]
+    vview = paged_kv_view(
+        cache.v, cache.tables, width, scale=cache.v_scale, out_dtype=dt,
+    )
+
+    t0 = logits.argmax(-1).astype(jnp.int32)
+    window = jnp.concatenate(
+        [t0[:, None], draft.astype(jnp.int32)], axis=1)   # [B, W]
+
+    x = params["embed"].astype(dt)[window]           # [B, W, D]
+    positions = pos0[:, None] + jnp.broadcast_to(
+        jnp.arange(w, dtype=jnp.int32), (b, w))
+    if cfg.moe_experts:
+        moe_cfg = cfg.replace(
+            moe_capacity_factor=float(cfg.moe_experts) / cfg.moe_top_k
+        )
+    cache_cols = jnp.arange(width, dtype=jnp.int32)
+    causal = (
+        jnp.arange(w, dtype=jnp.int32)[:, None]
+        >= jnp.arange(w, dtype=jnp.int32)[None, :]
+    )                                                # [W, W]
+
+    def body(x, layer_in):
+        lp, kc, vc = layer_in                        # kc [B,width,KVH,D]
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ _w(lp, "wq", dt)).reshape(b, w, cfg.n_heads, hd)
+        k = (h @ _w(lp, "wk", dt)).reshape(b, w, cfg.n_kv_heads, hd)
+        v = (h @ _w(lp, "wv", dt)).reshape(b, w, cfg.n_kv_heads, hd)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        qg = q.reshape(b, w, cfg.n_kv_heads, rep, hd)
+        scale = hd ** -0.5
+        s_cache = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, kc,
+            preferred_element_type=jnp.float32,
+        ) * scale                                    # [B,G,rep,W,width]
+        s_cache = jnp.where(
+            (cache_cols[None, :] < pos0[:, None])[:, None, None, None, :],
+            s_cache, -1e30,
+        )
+        s_new = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, k,
+            preferred_element_type=jnp.float32,
+        ) * scale                                    # [B,G,rep,W,W]
+        s_new = jnp.where(causal[None, None, None], s_new, -1e30)
+        p = jax.nn.softmax(
+            jnp.concatenate([s_cache, s_new], axis=-1), axis=-1
+        ).astype(dt)
+        attn = (
+            jnp.einsum("bgrqk,bkgd->bqgrd", p[..., :width], vc)
+            + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., width:], v)
+        ).reshape(b, w, -1)
+        x = x + attn @ _w(lp, "wo", dt)
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        if cfg.moe_experts:
+            down, _aux = tfm._moe_ffn(moe_cfg, _dense_lp(lp, dt), h2)
+            x = x + down
+        else:
+            gate = jax.nn.silu(h2 @ _w(lp, "w_gate", dt))
+            up = h2 @ _w(lp, "w_up", dt)
+            x = x + (gate * up) @ _w(lp, "w_down", dt)
+        return x, (k, v)                             # [B, W, KVH, D]
+
+    x, (k_win, v_win) = lax.scan(
+        body, x, (params["layers"], kview, vview))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    all_logits = _head_logits(cfg, params, x)        # [B, W, vocab]
+
+    preds = all_logits.argmax(-1).astype(jnp.int32)  # [B, W]
+    ok = (
+        (window[:, 1:] == preds[:, :-1])
+        & (jnp.arange(k_draft, dtype=jnp.int32)[None, :]
+           < draft_len[:, None])
+    )
+    acc = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
+    n = 1 + acc                                      # [B], 1..K+1
+    n = jnp.minimum(n, jnp.maximum(max_commit, 1))
+    is_eos = (window == eos[:, None]) & (eos[:, None] >= 0)
+    eos_pos = jnp.argmax(is_eos, axis=1).astype(jnp.int32)
+    has_eos = is_eos.any(axis=1)
+    n = jnp.where(has_eos & (eos_pos < n), eos_pos + 1, n)
+    n = jnp.where(cache.active, n, 0).astype(jnp.int32)
+
+    # Commit KV for accepted positions only: columns length + [0, n)
+    # resolve to (page, page row) through the slot's table; rejected,
+    # padded, and inactive positions resolve to the drop sentinel.
+    wcols = pos0[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    commit = jnp.arange(w, dtype=jnp.int32)[None, :] < n[:, None]
+    blk = jnp.take_along_axis(
+        cache.tables, jnp.clip(wcols // bs, 0, mb - 1), axis=1)  # [B, W]
+    blk = jnp.where(commit & (wcols < width), blk, n_blocks)
+    woff = wcols % bs
+    # k_win [L, B, W, KVH, D] scatters at [:, blk, woff].
+    k_all, k_scale = _pool_write(
+        cache.k, cache.k_scale, (slice(None), blk, woff), k_win)
+    v_all, v_scale = _pool_write(
+        cache.v, cache.v_scale, (slice(None), blk, woff), v_win)
+
+    idx = jnp.clip(n - 1, 0, k_draft)
+    new_logits = jnp.take_along_axis(
+        all_logits, idx[:, None, None], axis=1)[:, 0]
+    return window, n, new_logits, cache._replace(
+        k=k_all, v=v_all, k_scale=k_scale, v_scale=v_scale,
+        length=pos0 + n)
 
 
 def _check_cache_capacity(cache: KVCache, new_tokens: int, what: str) -> None:

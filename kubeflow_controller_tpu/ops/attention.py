@@ -98,6 +98,51 @@ def apply_rope_tables(x: jax.Array, rope_tables) -> jax.Array:
     ).astype(x.dtype)
 
 
+def paged_kv_view(
+    pool: jax.Array,            # [*lead, n_blocks, bs, KVH, D]
+    tables: jax.Array,          # [*T, mb] int32 page ids
+    width: int,
+    scale: Optional[jax.Array] = None,   # [*lead, n_blocks, bs, KVH]
+    out_dtype=None,
+) -> jax.Array:
+    """Gather a dense KV view out of a block pool through block tables —
+    the paged-attention primitive (vLLM PagedAttention semantics, XLA
+    gather path; a Pallas kernel that keeps the view in VMEM tiles is the
+    natural next rung and would slot in behind this same signature).
+
+    ``tables[..., i]`` names the pool page backing logical columns
+    ``[i*bs, (i+1)*bs)``; the result is ``[*lead, *T, width, KVH, D]`` —
+    pages concatenated in table order, cut to ``width`` columns so the
+    view's shape (and therefore every downstream reduction order) exactly
+    matches the contiguous cache it replaces. Sentinel ids (``>=
+    n_blocks``, the unallocated-entry marker) clamp into the last page:
+    the garbage they read is finite (pool pages are zero-initialised and
+    only ever hold finite KV), sits beyond the caller's ``length`` mask,
+    and multiplies a softmax weight of exactly 0 — it never changes a
+    bit of output.
+
+    ``scale`` (int8 pools): per-(page row, head) symmetric scales,
+    applied in fp32 before the cast to ``out_dtype`` — the dequantize
+    rides the gather the same way weight-only int8 rides the matmul
+    operand read."""
+    *lead, n_blocks, bsz, kvh, d = pool.shape
+    nlead = len(lead)
+    mb = tables.shape[-1]
+    view = jnp.take(pool, tables, axis=nlead, mode="clip")
+    view = view.reshape(
+        tuple(lead) + tables.shape[:-1] + (mb * bsz, kvh, d)
+    )[..., :width, :, :]
+    if scale is not None:
+        sv = jnp.take(scale, tables, axis=nlead, mode="clip")
+        sv = sv.reshape(
+            tuple(lead) + tables.shape[:-1] + (mb * bsz, kvh)
+        )[..., :width, :]
+        view = view.astype(jnp.float32) * sv[..., None]
+    if out_dtype is not None:
+        view = view.astype(out_dtype)
+    return view
+
+
 def mha(
     q: jax.Array,
     k: jax.Array,

@@ -11,8 +11,9 @@ Two layers, matching the design split in ``dataplane/kv_blocks.py``:
 
 2. **Engine integration**: with the prefix cache ON, greedy outputs are
    BIT-IDENTICAL to the cache-off bucketed engine under slot churn and
-   under pool-eviction pressure (the copy-into-slot design makes this
-   hold by construction — these tests are the tripwire); every
+   under pool-eviction pressure (the paged design makes this hold by
+   construction — slot tables alias trie pages, the gathered view runs
+   the same math on the same bytes — these tests are the tripwire); every
    retirement path (eos, length, cancel, deadline, drain) releases its
    block pins; the multi-turn ``register_prefix`` path makes turn N+1
    reuse turn N's session KV; and the exact-mode admit memo stays
@@ -235,19 +236,34 @@ def test_prefix_cache_bit_exact_under_churn(cfg, params):
 
 
 def test_prefix_cache_bit_exact_under_eviction_pressure(cfg, params):
-    """A pool far too small for the workload forces constant LRU
-    eviction; outputs must STILL be bit-identical — eviction can only
-    lower the hit rate, never corrupt a stream (pool pages are copied
-    into slots, never aliased by them)."""
+    """Churn under eviction pressure: the pool is the ONLY KV storage
+    now, sized here so live slot reservations fit but the trie's
+    tenancy cannot — every few admissions must evict cold leaves to
+    assemble a reservation, and some admissions fail outright and
+    requeue. Outputs must STILL be bit-identical to cache-off: slot
+    tables alias trie pages by design, so this is the regression test
+    for the eviction-pin rule (a page referenced by any live table must
+    never return to the free list while that table can be dispatched).
+
+    The workload publishes ~19 distinct blocks through a 14-page pool,
+    so eviction provably ran; the terminal leak sweep then proves every
+    tenancy unwound exactly once despite the churn."""
     kw = dict(n_slots=3, max_seq=32, prefill_mode="bucketed",
               block_size=4)
     reqs = _shared_prefix_requests(cfg, 8)
     off, _ = _run(cfg, params, reqs, **kw)
+    # Worst-case reservation: ceil((17 prompt + 7 new) / 4) = 6 pages;
+    # 14 holds two such slots plus scraps — the third admission has to
+    # evict or wait, and retirement-published chains get evicted long
+    # before the run ends (8 requests * ~2 distinct tail/reply blocks
+    # + 3 shared prefix blocks > 14).
     on, eng = _run(cfg, params, reqs, prefix_cache=True,
-                   kv_pool_blocks=4, **kw)
+                   kv_pool_blocks=14, **kw)
     assert on == off
-    assert eng.stats.pool_blocks_total == 4
-    assert eng.stats.pool_blocks_in_use <= 4
+    assert eng.stats.prefix_hit_tokens > 0          # cache still hit
+    assert eng.stats.pool_blocks_total == 14
+    assert eng.stats.pool_blocks_in_use <= 14
+    _assert_no_leaked_pins(eng)
 
 
 def _assert_no_leaked_pins(eng):

@@ -1,0 +1,256 @@
+"""Paged-attention kernel equivalence (ISSUE 8 tentpole tripwires).
+
+The paged kernels (``models/generate.py``: ``decode_step_paged``,
+``prefill_chunk_paged``, ``verify_step_paged``, ``prefill_into_paged``)
+gather a dense KV view out of the block pool through per-slot tables
+(``ops/attention.py:paged_kv_view``) and then run the contiguous
+kernels' einsum/mask/softmax code VERBATIM at the same width. When the
+table span equals the contiguous row width, the gathered view holds
+identical bytes in identical shapes — so the fp paged path must be
+BITWISE identical to the contiguous SlotKVCache path, which survives in
+the codebase precisely as this reference. These tests pin that, plus
+the int8 error model: int8 pages + per-(row, head) fp32 scales are a
+bounded perturbation of the KV bytes (per element <= amax/254 at write
+time, never requantized), so logits stay close and greedy streams agree
+on a long prefix but are NOT guaranteed bit-equal (docs/serving.md
+"int8 KV error model").
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_controller_tpu.dataplane.kv_blocks import blocks_for_budget
+from kubeflow_controller_tpu.dataplane.serving_engine import (
+    Request, ServingEngine,
+)
+from kubeflow_controller_tpu.models import generate as gen
+from kubeflow_controller_tpu.models import transformer as tfm
+
+MAX_SEQ = 32
+BS = 4                       # block_size
+MB = MAX_SEQ // BS           # table width (pages per slot)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tfm.tiny_config()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return gen.inference_params(cfg, tfm.init_params(cfg, jax.random.key(0)))
+
+
+def _prompts(cfg, sizes, seed=7):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, cfg.vocab_size, s).astype(np.int32)
+            for s in sizes]
+
+
+def _setup(cfg, params, prompts, kv_quant=""):
+    """Contiguous and paged caches prefilled with the same prompts, the
+    paged one through a shuffled (non-identity) table layout so the test
+    actually exercises the indirection."""
+    b = len(prompts)
+    slot_cache = gen.init_slot_cache(cfg, b, MAX_SEQ)
+    paged = gen.init_paged_cache(cfg, b, MB, b * MB + 3, BS, kv_quant)
+    rng = np.random.default_rng(11)
+    tables = rng.permutation(b * MB).astype(np.int32).reshape(b, MB)
+    paged = paged._replace(tables=jnp.asarray(tables))
+    logits_c = logits_p = None
+    lc_rows, lp_rows = [], []
+    for i, pr in enumerate(prompts):
+        s = jnp.asarray(i, jnp.int32)
+        lc, slot_cache = gen.prefill_into_slot(
+            cfg, params, jnp.asarray(pr[None]), slot_cache, s)
+        lp, paged = gen.prefill_into_paged(
+            cfg, params, jnp.asarray(pr[None]), paged, s)
+        lc_rows.append(np.asarray(lc))
+        lp_rows.append(np.asarray(lp))
+    logits_c = jnp.asarray(np.concatenate(lc_rows, axis=0))
+    logits_p = jnp.asarray(np.concatenate(lp_rows, axis=0))
+    return slot_cache, paged, logits_c, logits_p
+
+
+def test_paged_decode_bitwise_matches_contiguous(cfg, params):
+    prompts = _prompts(cfg, [5, 8, 11])
+    slot_cache, paged, logits_c, logits_p = _setup(cfg, params, prompts)
+    assert np.array_equal(np.asarray(logits_c), np.asarray(logits_p))
+    for _ in range(10):
+        toks = logits_c.argmax(-1).astype(jnp.int32)
+        toks_p = logits_p.argmax(-1).astype(jnp.int32)
+        assert np.array_equal(np.asarray(toks), np.asarray(toks_p))
+        logits_c, slot_cache = gen.decode_step_slots(
+            cfg, params, toks[:, None], slot_cache)
+        logits_p, paged = gen.decode_step_paged(
+            cfg, params, toks_p[:, None], paged)
+        assert np.array_equal(np.asarray(logits_c), np.asarray(logits_p))
+    assert np.array_equal(np.asarray(slot_cache.length),
+                          np.asarray(paged.length))
+
+
+def test_paged_chunk_prefill_bitwise_matches_contiguous(cfg, params):
+    """Chunked prefill on the absolute block grid, chunk by chunk, then
+    a decode tail — the bucketed engine's exact call pattern."""
+    (prompt,) = _prompts(cfg, [14], seed=3)
+    slot_cache = gen.init_slot_cache(cfg, 2, MAX_SEQ)
+    paged = gen.init_paged_cache(cfg, 2, MB, 2 * MB, BS, "")
+    tables = np.arange(2 * MB, dtype=np.int32).reshape(2, MB)[::-1].copy()
+    paged = paged._replace(tables=jnp.asarray(tables))
+    slot = jnp.asarray(1, jnp.int32)
+    off = 0
+    while off < prompt.size:
+        w_real = min(BS, prompt.size - off)
+        w = BS
+        if w_real < BS:
+            w = 1
+            while w < w_real:
+                w *= 2
+        buf = np.zeros((1, w), np.int32)
+        buf[0, :w_real] = prompt[off:off + w_real]
+        lc, slot_cache = gen.prefill_chunk_into_slot(
+            cfg, params, jnp.asarray(buf), slot_cache, slot,
+            jnp.asarray(off, jnp.int32), jnp.asarray(w_real, jnp.int32))
+        lp, paged = gen.prefill_chunk_paged(
+            cfg, params, jnp.asarray(buf), paged, slot,
+            jnp.asarray(off, jnp.int32), jnp.asarray(w_real, jnp.int32))
+        assert np.array_equal(np.asarray(lc), np.asarray(lp))
+        off += w_real
+    slot_cache = slot_cache._replace(
+        active=slot_cache.active.at[1].set(True))
+    paged = paged._replace(active=paged.active.at[1].set(True))
+    logits_c, logits_p = lc, lp
+    full_c = jnp.zeros((2, cfg.vocab_size), jnp.float32).at[1].set(lc[0])
+    full_p = jnp.zeros((2, cfg.vocab_size), jnp.float32).at[1].set(lp[0])
+    for _ in range(6):
+        # Only row 1 is live; row 0 diverges BY DESIGN — the paged
+        # kernel sentinels writes on inactive rows (stale-table safety)
+        # while the contiguous one still writes, and the engine discards
+        # inactive-row logits either way.
+        toks = full_c.argmax(-1).astype(jnp.int32)
+        assert int(toks[1]) == int(full_p.argmax(-1)[1])
+        full_c, slot_cache = gen.decode_step_slots(
+            cfg, params, toks[:, None], slot_cache)
+        full_p, paged = gen.decode_step_paged(
+            cfg, params, toks[:, None], paged)
+        assert np.array_equal(np.asarray(full_c)[1], np.asarray(full_p)[1])
+
+
+def test_paged_verify_bitwise_matches_contiguous(cfg, params):
+    """The fused draft-verify step: window, accepted counts, carried
+    logits, and the POST-verify decode (i.e. the committed KV bytes)
+    must all match bitwise."""
+    prompts = _prompts(cfg, [6, 9], seed=5)
+    slot_cache, paged, logits_c, logits_p = _setup(cfg, params, prompts)
+    rng = np.random.default_rng(2)
+    k = 3
+    draft = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, k)), jnp.int32)
+    dlen = jnp.asarray([k, 2], jnp.int32)
+    eos = jnp.asarray([-1, -1], jnp.int32)
+    max_commit = jnp.asarray([8, 8], jnp.int32)
+    wc, nc, lc, slot_cache = gen.verify_step_slots(
+        cfg, params, draft, dlen, logits_c, slot_cache, eos, max_commit)
+    wp, np_, lp, paged = gen.verify_step_paged(
+        cfg, params, draft, dlen, logits_p, paged, eos, max_commit)
+    assert np.array_equal(np.asarray(wc), np.asarray(wp))
+    assert np.array_equal(np.asarray(nc), np.asarray(np_))
+    assert np.array_equal(np.asarray(lc), np.asarray(lp))
+    assert np.array_equal(np.asarray(slot_cache.length),
+                          np.asarray(paged.length))
+    toks = lc.argmax(-1).astype(jnp.int32)
+    lc2, _ = gen.decode_step_slots(cfg, params, toks[:, None], slot_cache)
+    lp2, _ = gen.decode_step_paged(cfg, params, toks[:, None], paged)
+    assert np.array_equal(np.asarray(lc2), np.asarray(lp2))
+
+
+def test_int8_paged_bounded_error(cfg, params):
+    """int8 KV is a bounded perturbation, not an exact representation:
+    decode logits must stay close to fp (the error model docs/serving.md
+    documents) and greedy argmax must agree on the vast majority of
+    steps — but bit-equality is NOT asserted, because it does not
+    hold."""
+    prompts = _prompts(cfg, [5, 8, 11])
+    _, paged_fp, _, logits_fp = _setup(cfg, params, prompts, kv_quant="")
+    _, paged_q, _, logits_q = _setup(cfg, params, prompts,
+                                     kv_quant="int8")
+    agree = total = 0
+    for _ in range(10):
+        toks_fp = logits_fp.argmax(-1).astype(jnp.int32)
+        toks_q = logits_q.argmax(-1).astype(jnp.int32)
+        agree += int((np.asarray(toks_fp) == np.asarray(toks_q)).sum())
+        total += toks_fp.shape[0]
+        scale = float(jnp.max(jnp.abs(logits_fp))) + 1e-6
+        err = float(jnp.max(jnp.abs(logits_fp - logits_q))) / scale
+        assert err < 0.25, f"int8 KV logits drifted {err:.3f} of range"
+        # Feed BOTH the fp stream's token: per-step error stays the
+        # representation error instead of compounding token divergence.
+        logits_fp, paged_fp = gen.decode_step_paged(
+            cfg, params, toks_fp[:, None], paged_fp)
+        logits_q, paged_q = gen.decode_step_paged(
+            cfg, params, toks_fp[:, None], paged_q)
+    assert agree / total >= 0.8, f"greedy agreement {agree}/{total}"
+
+
+def test_int8_capacity_ratio_ge_1_5(cfg):
+    """The acceptance gate's arithmetic half: at a fixed HBM budget,
+    int8 pages admit >= 1.5x the pool pages (2D/(D+4) = 1.6 at the tiny
+    config's head_dim 16 with bf16 fp pages)."""
+    budget = 8 << 20
+    fp = blocks_for_budget(cfg, BS, budget, "")
+    q = blocks_for_budget(cfg, BS, budget, "int8")
+    assert fp > 0
+    assert q / fp >= 1.5
+
+
+def test_int8_engine_finish_reasons_match_fp(cfg, params):
+    """Engine-level int8 gate: same workload, fp vs int8 KV pool —
+    every request must finish for the same reason with the same token
+    COUNT (budget retirement is length-based, so the int8 stream's
+    token divergence must never change scheduling semantics)."""
+    rng = np.random.default_rng(0)
+    reqs = [
+        Request(rid=i,
+                prompt=rng.integers(0, cfg.vocab_size, 10 + i).astype(
+                    np.int32),
+                max_new_tokens=6)
+        for i in range(5)
+    ]
+    kw = dict(n_slots=2, max_seq=MAX_SEQ, prefill_mode="bucketed",
+              block_size=BS)
+    eng_fp = ServingEngine(cfg, params, **kw)
+    fp = {c.rid: c for c in eng_fp.run([Request(**vars(r)) for r in reqs])}
+    eng_q = ServingEngine(cfg, params, kv_quant="int8", **kw)
+    q = {c.rid: c for c in eng_q.run([Request(**vars(r)) for r in reqs])}
+    assert fp.keys() == q.keys()
+    for rid in fp:
+        assert fp[rid].finish_reason == q[rid].finish_reason
+        assert len(fp[rid].tokens) == len(q[rid].tokens)
+    assert eng_q.stats.kv_bytes_per_token < eng_fp.stats.kv_bytes_per_token
+
+
+def test_prefix_hit_is_zero_copy(cfg, params):
+    """Two waves of the same prompts through one prefix-cache engine:
+    wave 2 must take the pointer-assembly path — prefix_zero_copy_tokens
+    counts every hit token, equal to prefix_hit_tokens by construction
+    (the counter that replaced the copy-based accounting)."""
+    rng = np.random.default_rng(4)
+    shared = rng.integers(0, cfg.vocab_size, 12)
+    reqs = [
+        Request(rid=i, prompt=np.concatenate(
+            [shared, rng.integers(0, cfg.vocab_size, 3 + i)]).astype(
+                np.int32),
+            max_new_tokens=4)
+        for i in range(3)
+    ]
+    eng = ServingEngine(cfg, params, n_slots=2, max_seq=MAX_SEQ,
+                        prefill_mode="bucketed", block_size=BS,
+                        prefix_cache=True)
+    eng.run(list(reqs))
+    wave2 = [Request(rid=10 + r.rid, prompt=r.prompt,
+                     max_new_tokens=r.max_new_tokens) for r in reqs]
+    eng.run(wave2)
+    assert eng.stats.prefix_hit_tokens > 0
+    assert (eng.stats.prefix_zero_copy_tokens
+            == eng.stats.prefix_hit_tokens)
