@@ -51,6 +51,7 @@ from kubeflow_controller_tpu.dataplane.dist import (
 from kubeflow_controller_tpu.models import generate as gen
 from kubeflow_controller_tpu.models import transformer as tfm
 from kubeflow_controller_tpu.dataplane.entrypoints.lm import CONFIGS
+from kubeflow_controller_tpu.parallel import mesh as mesh_lib
 
 logger = logging.getLogger("tpujob.serve_lm")
 
@@ -147,6 +148,8 @@ def serve(
     speculative: bool = False,
     draft_k: int = 4,
     proposer: str = "prompt",
+    tp: int = 1,
+    mesh_devices: str = "",
     stop=None,
 ) -> Dict[str, float]:
     """``stop`` is a ``threading.Event`` (e.g. from
@@ -164,6 +167,25 @@ def serve(
 
     ctx = ctx or ProcessContext.from_env()
     cfg = CONFIGS[config]()
+    # Tensor-parallel serving (docs/serving.md "Tensor-parallel
+    # serving"): validate the head split BEFORE loading weights or
+    # building an engine — a bad --tp should fail in milliseconds with
+    # the divisibility message, not after checkpoint restore.
+    mesh = None
+    if tp > 1:
+        import jax
+
+        gen.check_tp_heads(cfg, tp)
+        devs = None
+        if mesh_devices:
+            all_devs = jax.devices()
+            devs = [all_devs[int(i)] for i in mesh_devices.split(",")]
+        mesh = mesh_lib.serving_mesh(tp, devs)
+        if turns > 1 and not prefix_cache:
+            raise ValueError(
+                "tp > 1 serves through the continuous-batching engine; "
+                "the contiguous multi-turn path (--turns without "
+                "--prefix-cache) is single-chip only")
     params, restored_step = _load_params(cfg, model_dir or ctx.model_dir)
     params = gen.inference_params(cfg, params, quant=quant)
     prompts = _read_prompts(input_file, cfg.vocab_size, batch, prompt_len)
@@ -200,6 +222,7 @@ def serve(
             prefix_cache=prefix_cache, block_size=block_size,
             kv_hbm_budget_mb=kv_pool_mb, kv_quant=kv_quant, paged=paged,
             spec_decode=speculative, draft_k=draft_k, proposer=proposer,
+            tp=tp, mesh=mesh,
         )
         prompts_np = np.asarray(prompts)
         completions = []
@@ -259,6 +282,7 @@ def serve(
             block_size=block_size, kv_hbm_budget_mb=kv_pool_mb,
             kv_quant=kv_quant, paged=paged,
             spec_decode=speculative, draft_k=draft_k, proposer=proposer,
+            tp=tp, mesh=mesh,
         )
         prompts_np = np.asarray(prompts)
         history = [list(map(int, prompts_np[i])) for i in range(b)]
@@ -453,7 +477,23 @@ def main(argv=None) -> int:
                    help="draft source: prompt = n-gram lookup in the "
                         "request's own context; radix = walk the "
                         "--prefix-cache trie (requires --prefix-cache)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel width: shard KV heads, the "
+                        "paged pool, and the serving weights across tp "
+                        "devices on one 1-D ICI mesh; greedy streams "
+                        "stay bit-identical to tp=1 and pooled KV "
+                        "capacity at fixed per-device HBM scales ~tp x "
+                        "(requires n_kv_heads %% tp == 0)")
+    p.add_argument("--mesh", default="",
+                   help="comma-separated device indices to build the "
+                        "serving mesh from (e.g. '0,1,2,3'; default: "
+                        "the first --tp visible devices)")
     args = p.parse_args(argv)
+    if args.tp > 1:
+        try:
+            gen.check_tp_heads(CONFIGS[args.config](), args.tp)
+        except ValueError as e:
+            p.error(str(e))
     ctx = initialize_from_env()
     # Two-strike SIGTERM/SIGINT drain (util/signals.py, signals.go:26-40
     # parity): first signal sets the stop event — the engine drains and
@@ -490,6 +530,8 @@ def main(argv=None) -> int:
         speculative=args.speculative,
         draft_k=args.draft_k,
         proposer=args.proposer,
+        tp=args.tp,
+        mesh_devices=args.mesh,
         stop=stop,
     )
     if metrics["interrupted"]:

@@ -48,34 +48,47 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
-def kv_bytes_per_token(cfg, kv_quant: str = "") -> int:
-    """HBM bytes one token's K+V occupies across all layers.
+def kv_bytes_per_token(cfg, kv_quant: str = "", tp: int = 1) -> int:
+    """HBM bytes one token's K+V occupies across all layers *per device*.
 
     fp pages: ``2 * L * KVH * D * itemsize``. int8 pages add a fp32
     scale per (token row, head, layer, k/v): ``2 * L * KVH * (D + 4)``.
+
+    ``tp`` > 1: the pool's KVH axis is sharded over the tensor-parallel
+    mesh, so each device stores ``KVH / tp`` heads — per-device bytes
+    drop by exactly ``tp`` and a fixed per-device budget admits ``tp``
+    times the tokens.
     """
     import jax.numpy as jnp
 
+    if tp < 1 or cfg.n_kv_heads % tp:
+        raise ValueError(
+            f"kv_bytes_per_token: n_kv_heads={cfg.n_kv_heads} not "
+            f"divisible by tp={tp}"
+        )
     if kv_quant == "int8":
         per_head = cfg.head_dim * 1 + 4
     elif not kv_quant or kv_quant == "none":
         per_head = cfg.head_dim * jnp.dtype(cfg.dtype).itemsize
     else:
         raise ValueError(f"unknown kv_quant {kv_quant!r}")
-    return 2 * cfg.n_layers * cfg.n_kv_heads * per_head
+    return 2 * cfg.n_layers * (cfg.n_kv_heads // tp) * per_head
 
 
 def blocks_for_budget(
     cfg, block_size: int, budget_bytes: int, kv_quant: str = "",
+    tp: int = 1,
 ) -> int:
-    """How many KV pages fit in ``budget_bytes`` of HBM for this model.
+    """How many KV pages fit in ``budget_bytes`` of *per-device* HBM.
 
     One page holds k AND v for ``block_size`` tokens across all layers;
     int8 pages account their fp32 dequant scales too, which is what
     makes the paged+int8 capacity gain an honest apples-to-apples
-    number.
+    number. Under tensor parallelism (``tp`` > 1) a page's KVH axis is
+    split across the mesh, so the same per-device budget holds ``tp``
+    times the pages — pooled capacity scales linearly with chips.
     """
-    per_block = block_size * kv_bytes_per_token(cfg, kv_quant)
+    per_block = block_size * kv_bytes_per_token(cfg, kv_quant, tp)
     return max(0, int(budget_bytes) // per_block)
 
 

@@ -100,6 +100,14 @@ class ServingStats:
     pool_blocks_in_use: int = 0
     pool_blocks_resident: int = 0
     kv_bytes_per_token: int = 0
+    # Tensor-parallel serving (docs/serving.md "Tensor-parallel
+    # serving"): ``tp`` is the mesh width, ``pool_blocks_per_shard``
+    # the page count each device's pool shard holds (== total — the
+    # KVH axis is split, not the page axis), ``kv_hbm_per_device_mb``
+    # the per-device HBM the resident pool actually occupies.
+    tp: int = 1
+    pool_blocks_per_shard: int = 0
+    kv_hbm_per_device_mb: float = 0.0
     # Speculative decoding (docs/serving.md "Speculative decoding"):
     # ``draft_proposed`` counts draft tokens sent to the verifier,
     # ``draft_accepted`` those that committed (acceptance_rate is their
@@ -175,6 +183,9 @@ class ServingStats:
             "pool_blocks_in_use": float(self.pool_blocks_in_use),
             "pool_blocks_resident": float(self.pool_blocks_resident),
             "kv_bytes_per_token": float(self.kv_bytes_per_token),
+            "tp": float(self.tp),
+            "pool_blocks_per_shard": float(self.pool_blocks_per_shard),
+            "kv_hbm_per_device_mb": float(self.kv_hbm_per_device_mb),
             "draft_proposed": float(self.draft_proposed),
             "draft_accepted": float(self.draft_accepted),
             "acceptance_rate": self.acceptance_rate,

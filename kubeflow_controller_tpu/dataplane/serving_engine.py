@@ -99,6 +99,17 @@ greedy outputs are bit-equal with the cache on or off BY CONSTRUCTION
 pages as int8 with per-(page row, head) fp32 scales — dequantized
 inside the attention gather — roughly doubling concurrent slots per
 HBM byte at a documented bounded output error (docs/serving.md).
+
+Tensor-parallel serving (docs/serving.md "Tensor-parallel serving"):
+``tp=N`` shards the pool's KV-head axis across an N-chip 1-D mesh
+(``parallel.mesh.serving_mesh``) and runs every paged kernel under
+``shard_map`` — each shard computes its contiguous KV-head group with
+unchanged per-shard math, so fp greedy streams stay bitwise those of
+one chip BY CONSTRUCTION (pinned by tests/test_tp_serving.py) while
+per-device KV bytes drop by N: a fixed per-device HBM budget admits N
+times the pool pages. The scheduler is mesh-blind — block tables,
+lengths, logits, and every host decision replicate, so all host logic
+in this file is byte-for-byte the single-chip path.
 """
 
 from __future__ import annotations
@@ -120,6 +131,8 @@ from kubeflow_controller_tpu.models import generate as gen
 from kubeflow_controller_tpu.models.transformer import (
     Params, TransformerConfig,
 )
+from kubeflow_controller_tpu.parallel import mesh as mesh_lib
+from kubeflow_controller_tpu.parallel import sharding as sharding_lib
 
 
 class Rejected(Exception):
@@ -320,6 +333,8 @@ class ServingEngine:
         proposer: object = "prompt",
         spec_patience: int = 2,
         spec_cooldown_max: int = 256,
+        tp: int = 1,
+        mesh=None,
     ):
         self.cfg = cfg
         self.params = params
@@ -383,6 +398,29 @@ class ServingEngine:
             raise ValueError(
                 f"kv_quant must be 'none' or 'int8' (got {kv_quant!r})")
         self.kv_quant = kv_quant
+        # Tensor-parallel serving: resolve the mesh FIRST (an explicit
+        # mesh wins; else a 1-D tp mesh over the first tp devices; tp<=1
+        # means no mesh at all — the single-chip engine runs today's
+        # exact unsharded code path). With a mesh, weights place
+        # storage-sharded (per-device weight HBM ~1/tp; the kernels
+        # declare them replicated and XLA gathers at dispatch — bytes
+        # move, never change) and the pool places KVH-sharded.
+        if mesh is not None:
+            self._mesh = mesh
+            self.tp = gen.tp_size(mesh)
+        else:
+            self.tp = max(1, int(tp))
+            self._mesh = mesh_lib.serving_mesh(self.tp)
+        self._repl = None
+        if self._mesh is not None:
+            gen.check_tp_heads(cfg, self.tp)
+            wq = (params.get("layers", {}).get("wq")
+                  if isinstance(params, dict) else None)
+            w_quant = "int8" if isinstance(wq, tuple) else ""
+            self.params = sharding_lib.shard_serving_params(
+                cfg, params, self._mesh, w_quant)
+            self._repl = jax.sharding.NamedSharding(
+                self._mesh, jax.sharding.PartitionSpec())
         if not paged:
             raise ValueError(
                 "the contiguous engine path was removed in PR 8 — the "
@@ -395,9 +433,14 @@ class ServingEngine:
         # budget admits more slots) > one full context per slot.
         if kv_pool_blocks is None:
             if kv_hbm_budget_mb is not None:
+                # The budget is PER DEVICE: under tp the pool's KVH axis
+                # is sharded, each page costs 1/tp the bytes per chip,
+                # and capacity at fixed per-device HBM scales ~linearly
+                # with the mesh.
                 kv_pool_blocks = kv_blocks.blocks_for_budget(
                     cfg, self.block_size,
-                    int(kv_hbm_budget_mb * (1 << 20)), kv_quant)
+                    int(kv_hbm_budget_mb * (1 << 20)), kv_quant,
+                    tp=self.tp)
             elif prefix_cache:
                 # One full context per slot for live reservations PLUS
                 # an equal allowance for trie tenancy — matching the PR 5
@@ -463,6 +506,8 @@ class ServingEngine:
         self.cache = gen.init_paged_cache(
             cfg, n_slots, self._max_blocks, self._kv_pool_blocks,
             self.block_size, kv_quant)
+        if self._mesh is not None:
+            self.cache = gen.shard_paged_cache(self.cache, self._mesh)
         # Host-owned block tables, the scheduler's source of truth for
         # which pool pages each slot reads/writes. Mirrored to the
         # device (_push_tables) before every dispatch that could read
@@ -470,16 +515,22 @@ class ServingEngine:
         self._tables = np.full(
             (n_slots, self._max_blocks), self._kv_pool_blocks, np.int32)
         self._tables_dirty = False
-        self.logits = jnp.zeros((n_slots, cfg.vocab_size), jnp.float32)
+        # Per-slot reserved page span (0 = free), maintained by
+        # admission / _clear_table_row: its max (pow2-rounded) is the
+        # gather width the next dispatch actually needs — the
+        # occupancy-capped paged view (ops/attention.py:paged_kv_view).
+        self._slot_blocks = np.zeros(n_slots, np.int64)
+        self.logits = self._replicate(
+            jnp.zeros((n_slots, cfg.vocab_size), jnp.float32))
         # Per-slot retirement rule, kept ON DEVICE so the fused step can
         # flip `active` itself: eos id (-1 = none), token budget, tokens
         # emitted so far.
-        self.eos = jnp.full((n_slots,), -1, jnp.int32)
-        self.budget = jnp.zeros((n_slots,), jnp.int32)
-        self.emitted = jnp.zeros((n_slots,), jnp.int32)
+        self.eos = self._replicate(jnp.full((n_slots,), -1, jnp.int32))
+        self.budget = self._replicate(jnp.zeros((n_slots,), jnp.int32))
+        self.emitted = self._replicate(jnp.zeros((n_slots,), jnp.int32))
         self.slots: List[Optional[_Slot]] = [None] * n_slots
         self.queue: deque[_Queued] = deque()
-        self.stats = ServingStats(n_slots=n_slots)
+        self.stats = ServingStats(n_slots=n_slots, tp=self.tp)
         # One-deep dispatch pipeline: (tokens device array, snapshot of
         # self.slots at dispatch, host-active count at dispatch).
         self._pending = None
@@ -490,55 +541,67 @@ class ServingEngine:
         self._done_buf: List[Completion] = []
         self._draining = False
 
-        # ONE compiled, fused step for the whole engine lifetime: a
-        # chunk of ``decode_chunk`` (sample token from carried logits ->
-        # decode it -> retire finished rows) micro-steps scanned in one
+        # ONE compiled, fused step per GATHER WIDTH: a chunk of
+        # ``decode_chunk`` (sample token from carried logits -> decode
+        # it -> retire finished rows) micro-steps scanned in one
         # dispatch, so the per-jit-call overhead amortizes over K tokens
         # per slot (multi-step scheduling). A single dispatch plus one
         # [K, B]-int32 fetch per scheduling quantum is the entire
-        # per-chunk host<->device traffic. Admission compiles once per
-        # distinct prompt length.
+        # per-chunk host<->device traffic. The view width (the paged
+        # gather's column count) is the live slots' max reserved span
+        # rounded to a power of two, so the memo holds O(log max_blocks)
+        # compiled variants for the engine's lifetime and every variant
+        # commits the bitwise-identical stream (masked columns are
+        # exact zeros — ops/attention.py:paged_kv_view). Admission
+        # compiles once per distinct prompt length.
         chunk = self.decode_chunk
+        mesh_ = self._mesh
 
-        def _micro(carry, key, eos, budget, params):
-            logits, cache, emitted = carry
-            if temperature <= 0.0:
-                toks = logits.argmax(-1).astype(jnp.int32)
-            else:
-                filtered = gen._filter_logits(
-                    logits / temperature, top_k=top_k, top_p=top_p
-                )
-                toks = jax.random.categorical(key, filtered, axis=-1)
-            was_active = cache.active
-            new_logits, cache = gen.decode_step_paged(
-                cfg, params, toks[:, None], cache)
-            # On-device retirement: this token IS decoded (the stream
-            # includes EOS), then the row goes inactive for every later
-            # micro-step until readmission. Its later chunk tokens are
-            # garbage the host discards by the same EOS/budget rule.
-            emitted = jnp.where(was_active, emitted + 1, emitted)
-            done = was_active & ((toks == eos) | (emitted >= budget))
-            cache = cache._replace(active=cache.active & ~done)
-            return (new_logits, cache, emitted), toks
+        def _make_step(vw):
+            def _micro(carry, key, eos, budget, params):
+                logits, cache, emitted = carry
+                if temperature <= 0.0:
+                    toks = logits.argmax(-1).astype(jnp.int32)
+                else:
+                    filtered = gen._filter_logits(
+                        logits / temperature, top_k=top_k, top_p=top_p
+                    )
+                    toks = jax.random.categorical(key, filtered, axis=-1)
+                was_active = cache.active
+                new_logits, cache = gen.decode_step_paged(
+                    cfg, params, toks[:, None], cache, mesh=mesh_,
+                    view_width=vw)
+                # On-device retirement: this token IS decoded (the
+                # stream includes EOS), then the row goes inactive for
+                # every later micro-step until readmission. Its later
+                # chunk tokens are garbage the host discards by the
+                # same EOS/budget rule.
+                emitted = jnp.where(was_active, emitted + 1, emitted)
+                done = was_active & ((toks == eos) | (emitted >= budget))
+                cache = cache._replace(active=cache.active & ~done)
+                return (new_logits, cache, emitted), toks
 
-        def _step(params, logits, cache, eos, budget, emitted, key):
-            def body(carry, k):
-                return _micro(carry, k, eos, budget, params)
+            def _step(params, logits, cache, eos, budget, emitted, key):
+                def body(carry, k):
+                    return _micro(carry, k, eos, budget, params)
 
-            keys = (None if temperature <= 0.0
-                    else jax.random.split(key, chunk))
-            (logits, cache, emitted), toks = jax.lax.scan(
-                body, (logits, cache, emitted), keys, length=chunk)
-            # next_tok: what each row's NEXT sampled token will be (the
-            # carried logits' argmax) — spec mode feeds it to the draft
-            # proposer; plain mode never fetches it.
-            next_tok = logits.argmax(-1).astype(jnp.int32)
-            return toks, next_tok, logits, cache, emitted
+                keys = (None if temperature <= 0.0
+                        else jax.random.split(key, chunk))
+                (logits, cache, emitted), toks = jax.lax.scan(
+                    body, (logits, cache, emitted), keys, length=chunk)
+                # next_tok: what each row's NEXT sampled token will be
+                # (the carried logits' argmax) — spec mode feeds it to
+                # the draft proposer; plain mode never fetches it.
+                next_tok = logits.argmax(-1).astype(jnp.int32)
+                return toks, next_tok, logits, cache, emitted
 
-        # Donating the carried logits / cache / emitted lets XLA update
-        # the KV pool in place instead of copying it every step (~30%
-        # off the per-step dispatch on CPU tiny config).
-        self._step_fn = jax.jit(_step, donate_argnums=(1, 2, 5))
+            # Donating the carried logits / cache / emitted lets XLA
+            # update the KV pool in place instead of copying it every
+            # step (~30% off the per-step dispatch on CPU tiny config).
+            return jax.jit(_step, donate_argnums=(1, 2, 5))
+
+        self._make_step = _make_step
+        self._step_fns: Dict[int, Callable] = {}
 
         # Speculative step: verify the host-proposed draft window in one
         # fused forward (generate.verify_step_slots), commit the
@@ -551,26 +614,36 @@ class ServingEngine:
         if self.spec_decode:
             k_draft = self.draft_k
 
-            def _spec(params, logits, cache, eos, budget, emitted,
-                      draft, dlen):
-                max_commit = jnp.maximum(budget - emitted, 1)
-                window, n, new_logits, cache = gen.verify_step_paged(
-                    cfg, params, draft, dlen, logits, cache, eos,
-                    max_commit)
-                emitted = emitted + n          # n = 0 on inactive rows
-                in_commit = (jnp.arange(k_draft + 1, dtype=jnp.int32)
-                             [None, :] < n[:, None])
-                committed_eos = (
-                    (window == eos[:, None]) & (eos[:, None] >= 0)
-                    & in_commit
-                ).any(axis=1)
-                done = cache.active & (committed_eos
-                                       | (emitted >= budget))
-                cache = cache._replace(active=cache.active & ~done)
-                next_tok = new_logits.argmax(-1).astype(jnp.int32)
-                return window, n, next_tok, new_logits, cache, emitted
+            def _make_spec():
+                # Verify always gathers the FULL table span. The K+1-wide
+                # verify attention is a real matmul whose width-W
+                # reduction XLA tiles differently at different W — unlike
+                # the decode matvec, trailing exactly-zero masked terms
+                # do NOT leave the partial sums bitwise-unchanged. Verify
+                # fires only on spec quanta, so the capped gather stays
+                # where it pays: the hot decode path.
+                def _spec(params, logits, cache, eos, budget, emitted,
+                          draft, dlen):
+                    max_commit = jnp.maximum(budget - emitted, 1)
+                    window, n, new_logits, cache = gen.verify_step_paged(
+                        cfg, params, draft, dlen, logits, cache, eos,
+                        max_commit, mesh=mesh_)
+                    emitted = emitted + n      # n = 0 on inactive rows
+                    in_commit = (jnp.arange(k_draft + 1, dtype=jnp.int32)
+                                 [None, :] < n[:, None])
+                    committed_eos = (
+                        (window == eos[:, None]) & (eos[:, None] >= 0)
+                        & in_commit
+                    ).any(axis=1)
+                    done = cache.active & (committed_eos
+                                           | (emitted >= budget))
+                    cache = cache._replace(active=cache.active & ~done)
+                    next_tok = new_logits.argmax(-1).astype(jnp.int32)
+                    return window, n, next_tok, new_logits, cache, emitted
 
-            self._spec_fn = jax.jit(_spec, donate_argnums=(1, 2, 5))
+                return jax.jit(_spec, donate_argnums=(1, 2, 5))
+
+            self._spec_step = _make_spec()
         # Exact-mode per-length admission memo, LRU-bounded (satellite of
         # the compile-explosion fix: even the fallback path cannot grow
         # without limit).
@@ -601,17 +674,23 @@ class ServingEngine:
             (self.n_slots, self._max_blocks), self._kv_pool_blocks,
             np.int32)
         self._tables_dirty = False
+        self._slot_blocks = np.zeros(self.n_slots, np.int64)
         self.cache = gen.init_paged_cache(
             self.cfg, self.n_slots, self._max_blocks,
             self._kv_pool_blocks, self.block_size, self.kv_quant)
-        self.logits = jnp.zeros((self.n_slots, self.cfg.vocab_size),
-                                jnp.float32)
-        self.eos = jnp.full((self.n_slots,), -1, jnp.int32)
-        self.budget = jnp.zeros((self.n_slots,), jnp.int32)
-        self.emitted = jnp.zeros((self.n_slots,), jnp.int32)
+        if self._mesh is not None:
+            self.cache = gen.shard_paged_cache(self.cache, self._mesh)
+        self.logits = self._replicate(
+            jnp.zeros((self.n_slots, self.cfg.vocab_size), jnp.float32))
+        self.eos = self._replicate(
+            jnp.full((self.n_slots,), -1, jnp.int32))
+        self.budget = self._replicate(
+            jnp.zeros((self.n_slots,), jnp.int32))
+        self.emitted = self._replicate(
+            jnp.zeros((self.n_slots,), jnp.int32))
         self.slots = [None] * self.n_slots
         self.queue.clear()
-        self.stats = ServingStats(n_slots=self.n_slots)
+        self.stats = ServingStats(n_slots=self.n_slots, tp=self.tp)
         self._pending = None
         self._step_idx = 0
         self._rids = set()
@@ -650,7 +729,8 @@ class ServingEngine:
             self.cache = gen.scatter_row_into_pool(
                 self.cache, cache.k, cache.v, row,
                 [node.block for node, _ in new],
-                [off for _, off in new], self.block_size)
+                [off for _, off in new], self.block_size,
+                mesh=self._mesh)
         return len(path) * self.block_size
 
     # -- request intake --------------------------------------------------
@@ -740,6 +820,15 @@ class ServingEngine:
 
     # -- block-table plumbing --------------------------------------------
 
+    def _replicate(self, x):
+        """Commit a host-produced device array to the serving mesh,
+        replicated (no-op on the single-chip engine). Keeps every
+        non-pool array on the SAME device set as the sharded pool so
+        jit never sees inputs committed to conflicting devices."""
+        if self._repl is None:
+            return x
+        return jax.device_put(x, self._repl)
+
     def _push_tables(self) -> None:
         """Mirror the host block tables to the device cache. Called
         before EVERY dispatch that could read them; a no-op while clean.
@@ -749,8 +838,41 @@ class ServingEngine:
         if not self._tables_dirty:
             return
         self.cache = self.cache._replace(
-            tables=jnp.asarray(self._tables.copy()))
+            tables=self._replicate(jnp.asarray(self._tables.copy())))
         self._tables_dirty = False
+
+    def _view_width(self) -> int:
+        """Gather width the next dispatch needs: the max page span any
+        live slot has RESERVED (set at admission, cleared at
+        retirement — reservations cover the slot's whole prompt+budget
+        lifetime, so positions never outrun the view), rounded up to
+        the next power of two on the block grid so the compiled-step
+        memo stays O(log max_blocks). Narrower views gather fewer pool
+        pages per step — the dominant per-step HBM read on
+        short-context traffic — and commit the bitwise-identical
+        stream (ops/attention.py:paged_kv_view)."""
+        mb = int(self._slot_blocks.max()) if self.n_slots else 1
+        nb = 1
+        while nb < mb:
+            nb *= 2
+        nb = max(1, min(nb, self._max_blocks))
+        return nb * self.block_size
+
+    def _step_fn(self, params, logits, cache, eos, budget, emitted, key):
+        """Dispatch the fused decode chunk compiled for the current
+        view width (compile-on-first-use per width)."""
+        vw = self._view_width()
+        fn = self._step_fns.get(vw)
+        if fn is None:
+            fn = self._step_fns[vw] = self._make_step(vw)
+        return fn(params, logits, cache, eos, budget, emitted, key)
+
+    def _spec_fn(self, params, logits, cache, eos, budget, emitted,
+                 draft, dlen):
+        """Dispatch the fused draft-verify step (always full table
+        width — see _make_spec for why verify is never view-capped)."""
+        return self._spec_step(params, logits, cache, eos, budget,
+                               emitted, draft, dlen)
 
     def _blocks_needed(self, prompt_size: int, max_new: int) -> int:
         """Pages covering the request's whole prompt+budget span."""
@@ -782,6 +904,7 @@ class ServingEngine:
         row's ``active`` bit is already clear by every path that gets
         here, and the paged kernels write nothing on inactive rows."""
         self._tables[i] = self._kv_pool_blocks
+        self._slot_blocks[i] = 0
         self._tables_dirty = True
 
     def _retire_slot(self, i: int, slot: _Slot, reason: str,
@@ -841,11 +964,12 @@ class ServingEngine:
             self._admits.move_to_end(s)
             return fn
         cfg = self.cfg
+        mesh_ = self._mesh
 
         def admit(params, prompt, cache, logits_buf, eos, budget,
                   emitted, slot, eos_val, budget_val):
             row_logits, cache = gen.prefill_into_paged(
-                cfg, params, prompt, cache, slot)
+                cfg, params, prompt, cache, slot, mesh=mesh_)
             logits_buf = jax.lax.dynamic_update_slice(
                 logits_buf, row_logits.astype(logits_buf.dtype),
                 (slot, 0))
@@ -871,11 +995,13 @@ class ServingEngine:
         if fn is not None:
             return fn
         cfg = self.cfg
+        mesh_ = self._mesh
 
         def chunk(params, toks, cache, logits_buf, eos, budget, emitted,
                   slot, offset, n_real, eos_val, budget_val, activate):
             row_logits, cache = gen.prefill_chunk_paged(
-                cfg, params, toks, cache, slot, offset, n_real)
+                cfg, params, toks, cache, slot, offset, n_real,
+                mesh=mesh_)
             logits_buf = jax.lax.dynamic_update_slice(
                 logits_buf, row_logits.astype(logits_buf.dtype),
                 (slot, 0))
@@ -973,6 +1099,7 @@ class ServingEngine:
             row[:] = self._kv_pool_blocks
             row[:len(path)] = [n.block for n in path]
             row[len(path):needed] = owned
+            self._slot_blocks[slot] = needed
             self._tables_dirty = True
             if self.prefill_mode == "exact":
                 self._push_tables()
@@ -1433,6 +1560,15 @@ class ServingEngine:
         self.stats.pool_blocks_resident = self.pool.used_blocks
         self.stats.kv_bytes_per_token = kv_blocks.kv_bytes_per_token(
             self.cfg, self.kv_quant)
+        # Per-device view of the same pool: each shard holds every page
+        # at 1/tp the bytes (the KVH axis is what's split), so blocks
+        # per shard equals the total and the HBM gauge divides by tp.
+        self.stats.tp = self.tp
+        self.stats.pool_blocks_per_shard = self.pool.n_blocks
+        self.stats.kv_hbm_per_device_mb = (
+            self.pool.n_blocks * self.block_size
+            * kv_blocks.kv_bytes_per_token(self.cfg, self.kv_quant,
+                                           self.tp) / (1 << 20))
 
     def _book_token(self, i: int, slot: _Slot, tok: int,
                     now: float) -> Optional[Completion]:

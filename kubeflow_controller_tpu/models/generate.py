@@ -15,12 +15,15 @@ training or on a single chip with no mesh at all.
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_controller_tpu.models import transformer as tfm
 from kubeflow_controller_tpu.models.transformer import (
@@ -631,6 +634,86 @@ def _pool_write(pool, scale, idx, val):
             scale.at[idx].set(s, mode="drop"))
 
 
+# -- tensor-parallel serving placement --------------------------------
+#
+# The paged kernels run under shard_map on a 1-D "tp" mesh
+# (parallel.mesh.serving_mesh): the pool's KVH axis is split across
+# shards, every host-visible table/length/active array and the logits
+# are replicated, and weights are DECLARED replicated (in_specs P()) so
+# XLA all-gathers the NamedSharding-stored shards at dispatch — data
+# movement only, never different bytes. Per shard the kernels compute
+# the FULL q/k/v projections + rope (bitwise the 1-chip values, every
+# input being replicated), slice the shard's contiguous KV-head group,
+# run the contiguous attention math on it unchanged (GQA attention is
+# independent per KV head; the per-element dot products over head_dim
+# and the softmax over positions never see the head count), and
+# all_gather the head outputs — an exact concatenation. fp greedy is
+# therefore bit-identical to the 1-chip engine by construction, the
+# same argument PR 8 used for paging (pinned by tests/test_tp_serving).
+
+_TP_POOL_SPEC = P(None, None, None, "tp", None)   # [L, nb, bs, KVH, D]
+_TP_SCALE_SPEC = P(None, None, None, "tp")        # [L, nb, bs, KVH]
+
+
+def tp_size(mesh: Optional[Mesh]) -> int:
+    """The tp-axis extent of ``mesh`` (1 when mesh is None)."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get("tp", 1))
+
+
+def check_tp_heads(cfg: TransformerConfig, tp: int) -> None:
+    """Refuse non-divisible head counts BEFORE any XLA sharding error:
+    KV heads split across the tp axis, so ``n_kv_heads % tp`` must be 0
+    (which also divides ``n_heads`` — GQA requires n_kv_heads | n_heads)."""
+    if tp > 1 and cfg.n_kv_heads % tp:
+        raise ValueError(
+            f"tensor-parallel serving shards KV heads across tp, so "
+            f"n_kv_heads must be divisible by tp (n_kv_heads="
+            f"{cfg.n_kv_heads}, tp={tp}). Pick tp from the divisors of "
+            f"n_kv_heads, or reshape the model."
+        )
+    if tp > 1 and cfg.moe_experts:
+        raise ValueError(
+            "tensor-parallel serving does not support MoE configs yet "
+            "(expert dispatch is mesh-size-dependent at trace time)"
+        )
+
+
+def paged_cache_specs(cache: PagedKVCache) -> PagedKVCache:
+    """PartitionSpecs for a :class:`PagedKVCache` on a serving mesh: k/v
+    pools (and int8 scales) split on the KVH axis, tables/length/active
+    replicated — the host scheduler keeps operating on full tables."""
+    return PagedKVCache(
+        k=_TP_POOL_SPEC, v=_TP_POOL_SPEC,
+        k_scale=None if cache.k_scale is None else _TP_SCALE_SPEC,
+        v_scale=None if cache.v_scale is None else _TP_SCALE_SPEC,
+        tables=P(), length=P(), active=P(),
+    )
+
+
+def shard_paged_cache(cache: PagedKVCache, mesh: Mesh) -> PagedKVCache:
+    """Place a paged cache onto the serving mesh (KVH-split pools,
+    replicated tables). Safe to call on an already-placed cache."""
+    specs = paged_cache_specs(cache)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.tree.map(jax.device_put, cache, shardings)
+
+
+def _tp_slice_heads(x: jax.Array, g_local: int, axis: int) -> jax.Array:
+    """This shard's contiguous KV-head group: an exact dynamic_slice of
+    the replicated full-head tensor at ``axis_index('tp') * g_local``."""
+    kvh0 = lax.axis_index("tp") * g_local
+    return lax.dynamic_slice_in_dim(x, kvh0, g_local, axis=axis)
+
+
+def _replicated_specs(tree) -> object:
+    return jax.tree.map(lambda _: P(), tree)
+
+
 def _decode_layer_paged(
     cfg: TransformerConfig,
     lp: Params,
@@ -638,6 +721,8 @@ def _decode_layer_paged(
     pos: jax.Array,             # [B] int32 — per-slot write position
     layer: jax.Array,           # [] int32 layer index into the pool
     cache: PagedKVCache,
+    tp_shards: int = 1,
+    view_width: Optional[int] = None,
 ):
     """``_decode_layer_slots`` reading and writing the block pool through
     per-slot tables: row b scatters its new k/v into page
@@ -653,6 +738,9 @@ def _decode_layer_paged(
     n_blocks, bs = cache.k.shape[1], cache.k.shape[2]
     mb = cache.tables.shape[1]
     width = mb * bs
+    # The gathered view (and its masks) may be capped to the engine's
+    # live occupancy; pool WRITES always guard against the full span.
+    vw = width if view_width is None else min(view_width, width)
 
     h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
     q = (h @ _w(lp, "wq", dt)).reshape(b, 1, cfg.n_heads, hd)
@@ -661,6 +749,16 @@ def _decode_layer_paged(
     positions = pos[:, None]                     # [B, 1]
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
+    rep = cfg.n_heads // cfg.n_kv_heads
+    g = cfg.n_kv_heads
+    qg = q.reshape(b, 1, g, rep, hd)
+    if tp_shards > 1:
+        # Full projections above are replicated (bitwise the 1-chip
+        # values); keep only this shard's KV-head group from here on.
+        g = cfg.n_kv_heads // tp_shards
+        qg = _tp_slice_heads(qg, g, axis=2)
+        k = _tp_slice_heads(k, g, axis=2)
+        v = _tp_slice_heads(v, g, axis=2)
     bi = jnp.clip(pos // bs, 0, mb - 1)
     blk = jnp.take_along_axis(cache.tables, bi[:, None], axis=1)[:, 0]
     # Inactive rows drop their write: a retired slot's table row stays
@@ -674,26 +772,27 @@ def _decode_layer_paged(
     v_pool, v_scale = _pool_write(
         cache.v, cache.v_scale, (layer, blk, off), v[:, 0])
     k_cache = paged_kv_view(
-        k_pool[layer], cache.tables, width,
+        k_pool[layer], cache.tables, vw,
         scale=None if k_scale is None else k_scale[layer],
-        out_dtype=dt)                            # [B, width, KVH, D]
+        out_dtype=dt)                            # [B, vw, KVH, D]
     v_cache = paged_kv_view(
-        v_pool[layer], cache.tables, width,
+        v_pool[layer], cache.tables, vw,
         scale=None if v_scale is None else v_scale[layer],
         out_dtype=dt)
 
-    rep = cfg.n_heads // cfg.n_kv_heads
-    qg = q.reshape(b, 1, cfg.n_kv_heads, rep, hd)
     s = jnp.einsum(
         "bqgrd,bkgd->bgrqk", qg, k_cache,
         preferred_element_type=jnp.float32,
     ) * (hd ** -0.5)                             # [B, G, rep, 1, S]
-    valid = jnp.arange(width)[None, :] <= pos[:, None]       # [B, S]
+    valid = jnp.arange(vw)[None, :] <= pos[:, None]          # [B, S]
     s = jnp.where(valid[:, None, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1).astype(dt)
-    attn = jnp.einsum(
-        "bgrqk,bkgd->bqgrd", p, v_cache
-    ).reshape(b, 1, -1)
+    attn = jnp.einsum("bgrqk,bkgd->bqgrd", p, v_cache)
+    if tp_shards > 1:
+        # Exact concatenation of the shards' head-group outputs: the
+        # (g, rep, hd) flattening below then matches the 1-chip layout.
+        attn = lax.all_gather(attn, "tp", axis=2, tiled=True)
+    attn = attn.reshape(b, 1, -1)
     x = x + attn @ _w(lp, "wo", dt)
 
     h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -706,16 +805,14 @@ def _decode_layer_paged(
     return x, k_pool, v_pool, k_scale, v_scale
 
 
-def decode_step_paged(
+def _decode_step_paged_impl(
     cfg: TransformerConfig,
     params: Params,
     tokens: jax.Array,          # [B, 1] int32
     cache: PagedKVCache,
+    tp_shards: int = 1,
+    view_width: Optional[int] = None,
 ) -> Tuple[jax.Array, PagedKVCache]:
-    """``decode_step_slots`` over the paged pool: one token for every
-    slot at its own position, appends landing in each slot's tail page
-    in place. ``length`` advances only on active slots; tables are
-    read-only here (the host owns them)."""
     x = params["embed"].astype(cfg.dtype)[tokens]     # [B, 1, D]
     pos = cache.length
 
@@ -726,7 +823,8 @@ def decode_step_paged(
             params["layers"],
         )
         c = cache._replace(k=k, v=v, k_scale=ks, v_scale=vs)
-        return _decode_layer_paged(cfg, lp, x, pos, layer, c)
+        return _decode_layer_paged(cfg, lp, x, pos, layer, c,
+                                   tp_shards, view_width)
 
     x, k, v, ks, vs = lax.fori_loop(
         0, cfg.n_layers, body,
@@ -740,40 +838,72 @@ def decode_step_paged(
     )
 
 
-def prefill_into_paged(
+def decode_step_paged(
     cfg: TransformerConfig,
     params: Params,
-    prompt: jax.Array,          # [1, S] int32 — ONE request's prompt
+    tokens: jax.Array,          # [B, 1] int32
     cache: PagedKVCache,
-    slot: jax.Array,            # [] int32 — destination slot
+    mesh: Optional[Mesh] = None,
+    view_width: Optional[int] = None,
 ) -> Tuple[jax.Array, PagedKVCache]:
-    """``prefill_into_slot`` for the paged pool: block-prefill the
-    prompt (the identical fused forward — identical logits and KV bytes)
-    and scatter the S positions into the pages of slot ``slot``'s table.
-    ``length[slot] = S``, ``active[slot] = True``; every other slot's
-    pages are untouched. Compiles once per prompt length."""
-    if prompt.shape[0] != 1:
-        raise ValueError(
-            f"prefill_into_paged admits one request (got batch "
-            f"{prompt.shape[0]})"
-        )
+    """``decode_step_slots`` over the paged pool: one token for every
+    slot at its own position, appends landing in each slot's tail page
+    in place. ``length`` advances only on active slots; tables are
+    read-only here (the host owns them).
+
+    ``mesh`` (a ``serving_mesh``): run under shard_map with the pool's
+    KVH axis split across tp — per-shard math unchanged, head outputs
+    all-gathered exactly, fp greedy bitwise the 1-chip kernel.
+    ``view_width``: cap the gathered view to the caller's live
+    occupancy (see ``paged_kv_view``); writes still span the full
+    table."""
+    tp = tp_size(mesh)
+    if tp <= 1:
+        return _decode_step_paged_impl(
+            cfg, params, tokens, cache, 1, view_width)
+    check_tp_heads(cfg, tp)
+    fn = shard_map(
+        functools.partial(_decode_step_paged_impl, cfg,
+                          tp_shards=tp, view_width=view_width),
+        mesh=mesh,
+        in_specs=(_replicated_specs(params), P(), paged_cache_specs(cache)),
+        out_specs=(P(), paged_cache_specs(cache)),
+        check_rep=False,
+    )
+    return fn(params, tokens, cache)
+
+
+def _prefill_into_paged_impl(
+    cfg: TransformerConfig,
+    params: Params,
+    prompt: jax.Array,          # [1, S] int32
+    cache: PagedKVCache,
+    slot: jax.Array,            # [] int32
+    tp_shards: int = 1,
+) -> Tuple[jax.Array, PagedKVCache]:
     n_blocks, bs = cache.k.shape[1], cache.k.shape[2]
     mb = cache.tables.shape[1]
     s = prompt.shape[1]
-    if s > mb * bs:
-        raise ValueError(
-            f"prompt {s} exceeds slot capacity {mb * bs}"
-        )
     logits, mini = prefill(
         cfg, params, prompt, init_kv_cache(cfg, 1, s))
+    row_k = mini.k[:, 0]                         # [L, S, KVH, D]
+    row_v = mini.v[:, 0]
+    if tp_shards > 1:
+        # The fused prefill above ran replicated — identical logits and
+        # KV bytes on every shard; each shard scatters only its own
+        # KV-head slice into its pool shard (quantize-on-write commutes
+        # with the head slice: scales are per-(token, head)).
+        g = cfg.n_kv_heads // tp_shards
+        row_k = _tp_slice_heads(row_k, g, axis=2)
+        row_v = _tp_slice_heads(row_v, g, axis=2)
     trow = cache.tables[slot]                    # [mb]
     cols = jnp.arange(s, dtype=jnp.int32)
     blk = trow[jnp.clip(cols // bs, 0, mb - 1)]  # s <= mb*bs checked above
     off = cols % bs
     k, k_scale = _pool_write(
-        cache.k, cache.k_scale, (slice(None), blk, off), mini.k[:, 0])
+        cache.k, cache.k_scale, (slice(None), blk, off), row_k)
     v, v_scale = _pool_write(
-        cache.v, cache.v_scale, (slice(None), blk, off), mini.v[:, 0])
+        cache.v, cache.v_scale, (slice(None), blk, off), row_v)
     return logits, cache._replace(
         k=k, v=v, k_scale=k_scale, v_scale=v_scale,
         length=cache.length.at[slot].set(s),
@@ -781,16 +911,79 @@ def prefill_into_paged(
     )
 
 
-@jax.jit
-def _scatter_row_into_pool(pool_k, pool_v, k_scale, v_scale,
-                           cache_k, cache_v, row, ids, cols):
+def prefill_into_paged(
+    cfg: TransformerConfig,
+    params: Params,
+    prompt: jax.Array,          # [1, S] int32 — ONE request's prompt
+    cache: PagedKVCache,
+    slot: jax.Array,            # [] int32 — destination slot
+    mesh: Optional[Mesh] = None,
+) -> Tuple[jax.Array, PagedKVCache]:
+    """``prefill_into_slot`` for the paged pool: block-prefill the
+    prompt (the identical fused forward — identical logits and KV bytes)
+    and scatter the S positions into the pages of slot ``slot``'s table.
+    ``length[slot] = S``, ``active[slot] = True``; every other slot's
+    pages are untouched. Compiles once per prompt length. ``mesh``: see
+    :func:`decode_step_paged`."""
+    if prompt.shape[0] != 1:
+        raise ValueError(
+            f"prefill_into_paged admits one request (got batch "
+            f"{prompt.shape[0]})"
+        )
+    mb, bs = cache.tables.shape[1], cache.k.shape[2]
+    s = prompt.shape[1]
+    if s > mb * bs:
+        raise ValueError(
+            f"prompt {s} exceeds slot capacity {mb * bs}"
+        )
+    tp = tp_size(mesh)
+    if tp <= 1:
+        return _prefill_into_paged_impl(cfg, params, prompt, cache, slot)
+    check_tp_heads(cfg, tp)
+    fn = shard_map(
+        functools.partial(_prefill_into_paged_impl, cfg, tp_shards=tp),
+        mesh=mesh,
+        in_specs=(_replicated_specs(params), P(), paged_cache_specs(cache),
+                  P()),
+        out_specs=(P(), paged_cache_specs(cache)),
+        check_rep=False,
+    )
+    return fn(params, prompt, cache, slot)
+
+
+def _scatter_row_impl(pool_k, pool_v, k_scale, v_scale,
+                      cache_k, cache_v, row, ids, cols, tp_shards=1):
     rk = cache_k[:, row]                         # [L, S, KVH, D]
     rv = cache_v[:, row]
+    if tp_shards > 1:
+        g = pool_k.shape[-2]                     # pool shard's local KVH
+        rk = _tp_slice_heads(rk, g, axis=2)
+        rv = _tp_slice_heads(rv, g, axis=2)
     bk = rk[:, cols]                             # [L, m, bs, KVH, D]
     bv = rv[:, cols]
     pool_k, k_scale = _pool_write(pool_k, k_scale, (slice(None), ids), bk)
     pool_v, v_scale = _pool_write(pool_v, v_scale, (slice(None), ids), bv)
     return pool_k, pool_v, k_scale, v_scale
+
+
+_scatter_row_into_pool = jax.jit(_scatter_row_impl, static_argnums=(9,))
+
+
+@functools.lru_cache(maxsize=16)
+def _scatter_row_tp_fn(mesh: Mesh, tp: int, has_scale: bool):
+    """Compiled tp ingest: the external row is replicated in, each shard
+    keeps its KV-head slice (sized by its local pool shard) and scatters
+    into its own pages. Memoized per mesh so repeat ingests reuse the
+    executable."""
+    scale_spec = _TP_SCALE_SPEC if has_scale else None
+    inner = functools.partial(_scatter_row_impl, tp_shards=tp)
+    return jax.jit(shard_map(
+        inner, mesh=mesh,
+        in_specs=(_TP_POOL_SPEC, _TP_POOL_SPEC, scale_spec, scale_spec,
+                  P(), P(), P(), P(), P()),
+        out_specs=(_TP_POOL_SPEC, _TP_POOL_SPEC, scale_spec, scale_spec),
+        check_rep=False,
+    ))
 
 
 def scatter_row_into_pool(
@@ -801,6 +994,7 @@ def scatter_row_into_pool(
     ids,                        # page ids, one per full block
     starts,                     # token offset of each block in the row
     block_size: int,
+    mesh: Optional[Mesh] = None,
 ) -> PagedKVCache:
     """Ingest full blocks from an external contiguous cache row into
     pool pages — the multi-turn ``register_prefix`` path, where a
@@ -809,7 +1003,8 @@ def scatter_row_into_pool(
     copies KV (admission is pointer assembly, retirement publishes pages
     in place). Quantizes on write for int8 pools. The id/start lists pad
     to the next power of two with a dropped sentinel id, so compile
-    count stays O(log) in pages per ingest."""
+    count stays O(log) in pages per ingest. ``mesh``: see
+    :func:`decode_step_paged`."""
     m = 1
     while m < len(ids):
         m *= 2
@@ -820,7 +1015,12 @@ def scatter_row_into_pool(
     starts_arr[:len(starts)] = starts
     cols = (starts_arr[:, None]
             + np.arange(block_size, dtype=np.int32)[None, :])
-    k, v, ks, vs = _scatter_row_into_pool(
+    tp = tp_size(mesh)
+    if tp <= 1:
+        fn = _scatter_row_into_pool
+    else:
+        fn = _scatter_row_tp_fn(mesh, tp, cache.k_scale is not None)
+    k, v, ks, vs = fn(
         cache.k, cache.v, cache.k_scale, cache.v_scale,
         ext_k, ext_v, jnp.asarray(row, jnp.int32),
         jnp.asarray(ids_arr), jnp.asarray(cols),
@@ -949,7 +1149,7 @@ def prefill_chunk_into_slot(
     )
 
 
-def prefill_chunk_paged(
+def _prefill_chunk_paged_impl(
     cfg: TransformerConfig,
     params: Params,
     toks: jax.Array,            # [1, W] int32 — chunk, PADDED to W
@@ -957,19 +1157,8 @@ def prefill_chunk_paged(
     slot: jax.Array,            # [] int32
     offset: jax.Array,          # [] int32 — absolute start position
     n_real: jax.Array,          # [] int32 — real (un-padded) chunk length
+    tp_shards: int = 1,
 ) -> Tuple[jax.Array, PagedKVCache]:
-    """``prefill_chunk_into_slot`` over the paged pool: the chunk
-    attends to the table-gathered view of the slot's prior pages (a
-    shared radix prefix reads IN PLACE — no copy ever ran) plus
-    intra-chunk causal, and its k/v scatter straight into the slot's
-    own pages at absolute columns ``offset + [0, W)``. Same bucketing
-    and padding discipline, same math at the same width — the fp path
-    is bitwise the contiguous kernel."""
-    if toks.shape[0] != 1:
-        raise ValueError(
-            f"prefill_chunk_paged admits one request (got batch "
-            f"{toks.shape[0]})"
-        )
     from kubeflow_controller_tpu.ops.attention import paged_kv_view
 
     b, w = toks.shape
@@ -979,6 +1168,8 @@ def prefill_chunk_paged(
     mb = cache.tables.shape[1]
     width = mb * bs
     rep = cfg.n_heads // cfg.n_kv_heads
+    g_local = (cfg.n_kv_heads // tp_shards if tp_shards > 1
+               else cfg.n_kv_heads)
     trow = cache.tables[slot]                    # [mb]
     kc_row = paged_kv_view(
         cache.k, trow, width, scale=cache.k_scale, out_dtype=dt,
@@ -1009,6 +1200,10 @@ def prefill_chunk_paged(
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         qg = q.reshape(b, w, cfg.n_kv_heads, rep, hd)
+        if tp_shards > 1:
+            qg = _tp_slice_heads(qg, g_local, axis=2)
+            k = _tp_slice_heads(k, g_local, axis=2)
+            v = _tp_slice_heads(v, g_local, axis=2)
         scale = hd ** -0.5
         s_cache = jnp.einsum(
             "bqgrd,kgd->bgrqk", qg, kc,
@@ -1029,7 +1224,10 @@ def prefill_chunk_paged(
         attn = (
             jnp.einsum("bgrqk,kgd->bqgrd", p[..., :width], vc)
             + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., width:], v)
-        ).reshape(b, w, -1)
+        )
+        if tp_shards > 1:
+            attn = lax.all_gather(attn, "tp", axis=2, tiled=True)
+        attn = attn.reshape(b, w, -1)
         x = x + attn @ _w(lp, "wo", dt)
         h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         if cfg.moe_experts:
@@ -1062,6 +1260,46 @@ def prefill_chunk_paged(
         k=k, v=v, k_scale=k_scale, v_scale=v_scale,
         length=cache.length.at[slot].set(offset + n_real),
     )
+
+
+def prefill_chunk_paged(
+    cfg: TransformerConfig,
+    params: Params,
+    toks: jax.Array,            # [1, W] int32 — chunk, PADDED to W
+    cache: PagedKVCache,
+    slot: jax.Array,            # [] int32
+    offset: jax.Array,          # [] int32 — absolute start position
+    n_real: jax.Array,          # [] int32 — real (un-padded) chunk length
+    mesh: Optional[Mesh] = None,
+) -> Tuple[jax.Array, PagedKVCache]:
+    """``prefill_chunk_into_slot`` over the paged pool: the chunk
+    attends to the table-gathered view of the slot's prior pages (a
+    shared radix prefix reads IN PLACE — no copy ever ran) plus
+    intra-chunk causal, and its k/v scatter straight into the slot's
+    own pages at absolute columns ``offset + [0, W)``. Same bucketing
+    and padding discipline, same math at the same width — the fp path
+    is bitwise the contiguous kernel. ``mesh``: see
+    :func:`decode_step_paged` (the slot's page view and k/v scatter are
+    per-shard; the chunk's logits come out replicated)."""
+    if toks.shape[0] != 1:
+        raise ValueError(
+            f"prefill_chunk_paged admits one request (got batch "
+            f"{toks.shape[0]})"
+        )
+    tp = tp_size(mesh)
+    if tp <= 1:
+        return _prefill_chunk_paged_impl(
+            cfg, params, toks, cache, slot, offset, n_real)
+    check_tp_heads(cfg, tp)
+    fn = shard_map(
+        functools.partial(_prefill_chunk_paged_impl, cfg, tp_shards=tp),
+        mesh=mesh,
+        in_specs=(_replicated_specs(params), P(), paged_cache_specs(cache),
+                  P(), P(), P()),
+        out_specs=(P(), paged_cache_specs(cache)),
+        check_rep=False,
+    )
+    return fn(params, toks, cache, slot, offset, n_real)
 
 
 def verify_step_slots(
@@ -1224,7 +1462,7 @@ def verify_step_slots(
         k=k_all, v=v_all, length=pos0 + n, active=cache.active)
 
 
-def verify_step_paged(
+def _verify_step_paged_impl(
     cfg: TransformerConfig,
     params: Params,
     draft: jax.Array,           # [B, K] int32 — proposed continuations
@@ -1233,14 +1471,9 @@ def verify_step_paged(
     cache: PagedKVCache,
     eos: jax.Array,             # [B] int32 — per-row EOS id (-1 = none)
     max_commit: jax.Array,      # [B] int32 — commit budget cap, >= 1
+    tp_shards: int = 1,
+    view_width: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, PagedKVCache]:
-    """``verify_step_slots`` over the paged pool: the K+1 verify window
-    attends to each slot's table-gathered page view, and ONLY the
-    accepted positions' k/v scatter into the slot's own pages (rejected
-    and padded positions map to the drop sentinel — rollback is still
-    by never committing). Acceptance, budget/EOS truncation, and the
-    carried logits are the contiguous verifier's code verbatim, so the
-    fp paged path commits the bitwise-identical stream."""
     from kubeflow_controller_tpu.ops.attention import paged_kv_view
 
     b, k_draft = draft.shape
@@ -1250,13 +1483,16 @@ def verify_step_paged(
     n_blocks, bs = cache.k.shape[1], cache.k.shape[2]
     mb = cache.tables.shape[1]
     width = mb * bs
+    vw = width if view_width is None else min(view_width, width)
     rep = cfg.n_heads // cfg.n_kv_heads
+    g_local = (cfg.n_kv_heads // tp_shards if tp_shards > 1
+               else cfg.n_kv_heads)
     pos0 = cache.length                              # [B]
     kview = paged_kv_view(
-        cache.k, cache.tables, width, scale=cache.k_scale, out_dtype=dt,
-    )                                                # [L, B, width, KVH, D]
+        cache.k, cache.tables, vw, scale=cache.k_scale, out_dtype=dt,
+    )                                                # [L, B, vw, KVH, D]
     vview = paged_kv_view(
-        cache.v, cache.tables, width, scale=cache.v_scale, out_dtype=dt,
+        cache.v, cache.tables, vw, scale=cache.v_scale, out_dtype=dt,
     )
 
     t0 = logits.argmax(-1).astype(jnp.int32)
@@ -1270,14 +1506,14 @@ def verify_step_paged(
         moe_cfg = cfg.replace(
             moe_capacity_factor=float(cfg.moe_experts) / cfg.moe_top_k
         )
-    cache_cols = jnp.arange(width, dtype=jnp.int32)
+    cache_cols = jnp.arange(vw, dtype=jnp.int32)
     causal = (
         jnp.arange(w, dtype=jnp.int32)[:, None]
         >= jnp.arange(w, dtype=jnp.int32)[None, :]
     )                                                # [W, W]
 
     def body(x, layer_in):
-        lp, kc, vc = layer_in                        # kc [B,width,KVH,D]
+        lp, kc, vc = layer_in                        # kc [B,vw,KVH,D]
         h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
         q = (h @ _w(lp, "wq", dt)).reshape(b, w, cfg.n_heads, hd)
         k = (h @ _w(lp, "wk", dt)).reshape(b, w, cfg.n_kv_heads, hd)
@@ -1285,11 +1521,15 @@ def verify_step_paged(
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         qg = q.reshape(b, w, cfg.n_kv_heads, rep, hd)
+        if tp_shards > 1:
+            qg = _tp_slice_heads(qg, g_local, axis=2)
+            k = _tp_slice_heads(k, g_local, axis=2)
+            v = _tp_slice_heads(v, g_local, axis=2)
         scale = hd ** -0.5
         s_cache = jnp.einsum(
             "bqgrd,bkgd->bgrqk", qg, kc,
             preferred_element_type=jnp.float32,
-        ) * scale                                    # [B,G,rep,W,width]
+        ) * scale                                    # [B,G,rep,W,vw]
         s_cache = jnp.where(
             (cache_cols[None, :] < pos0[:, None])[:, None, None, None, :],
             s_cache, -1e30,
@@ -1303,9 +1543,12 @@ def verify_step_paged(
             jnp.concatenate([s_cache, s_new], axis=-1), axis=-1
         ).astype(dt)
         attn = (
-            jnp.einsum("bgrqk,bkgd->bqgrd", p[..., :width], vc)
-            + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., width:], v)
-        ).reshape(b, w, -1)
+            jnp.einsum("bgrqk,bkgd->bqgrd", p[..., :vw], vc)
+            + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., vw:], v)
+        )
+        if tp_shards > 1:
+            attn = lax.all_gather(attn, "tp", axis=2, tiled=True)
+        attn = attn.reshape(b, w, -1)
         x = x + attn @ _w(lp, "wo", dt)
         h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         if cfg.moe_experts:
@@ -1358,6 +1601,45 @@ def verify_step_paged(
     return window, n, new_logits, cache._replace(
         k=k_all, v=v_all, k_scale=k_scale, v_scale=v_scale,
         length=pos0 + n)
+
+
+def verify_step_paged(
+    cfg: TransformerConfig,
+    params: Params,
+    draft: jax.Array,           # [B, K] int32 — proposed continuations
+    draft_len: jax.Array,       # [B] int32 in [0, K] — valid drafts/row
+    logits: jax.Array,          # [B, vocab] — carried last-position logits
+    cache: PagedKVCache,
+    eos: jax.Array,             # [B] int32 — per-row EOS id (-1 = none)
+    max_commit: jax.Array,      # [B] int32 — commit budget cap, >= 1
+    mesh: Optional[Mesh] = None,
+    view_width: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, PagedKVCache]:
+    """``verify_step_slots`` over the paged pool: the K+1 verify window
+    attends to each slot's table-gathered page view, and ONLY the
+    accepted positions' k/v scatter into the slot's own pages (rejected
+    and padded positions map to the drop sentinel — rollback is still
+    by never committing). Acceptance, budget/EOS truncation, and the
+    carried logits are the contiguous verifier's code verbatim, so the
+    fp paged path commits the bitwise-identical stream. ``mesh`` /
+    ``view_width``: see :func:`decode_step_paged` — acceptance runs on
+    replicated logits, so every shard commits the same ``n``."""
+    tp = tp_size(mesh)
+    if tp <= 1:
+        return _verify_step_paged_impl(
+            cfg, params, draft, draft_len, logits, cache, eos,
+            max_commit, 1, view_width)
+    check_tp_heads(cfg, tp)
+    fn = shard_map(
+        functools.partial(_verify_step_paged_impl, cfg,
+                          tp_shards=tp, view_width=view_width),
+        mesh=mesh,
+        in_specs=(_replicated_specs(params), P(), P(), P(),
+                  paged_cache_specs(cache), P(), P()),
+        out_specs=(P(), P(), P(), paged_cache_specs(cache)),
+        check_rep=False,
+    )
+    return fn(params, draft, draft_len, logits, cache, eos, max_commit)
 
 
 def _check_cache_capacity(cache: KVCache, new_tokens: int, what: str) -> None:

@@ -124,9 +124,27 @@ def paged_kv_view(
     ``scale`` (int8 pools): per-(page row, head) symmetric scales,
     applied in fp32 before the cast to ``out_dtype`` — the dequantize
     rides the gather the same way weight-only int8 rides the matmul
-    operand read."""
+    operand read.
+
+    ``width`` caps the GATHER, not just the slice: only the
+    ``ceil(width / bs)`` leading table entries are dereferenced, so a
+    caller that knows its live occupancy (the serving engine tracks the
+    max reserved span across slots) materializes a view sized for the
+    actual traffic instead of the worst-case ``mb * bs`` — the dominant
+    per-step HBM cost on short-context batches. Entries past the cap are
+    by construction sentinels or pages the ``length``/position masks
+    exclude; the view itself is a strict prefix of the full view (bitwise
+    equal bytes). Whether downstream OUTPUTS stay bitwise depends on the
+    consumer's reduction shape: the single-token decode matvec reduces
+    width sequentially and is bitwise at any cap (pinned by
+    tests/test_paged_attention.py); a multi-row matmul like the fused
+    verify gets retiled per width and drifts ~1 ulp, which is why the
+    serving engine caps only the decode path."""
     *lead, n_blocks, bsz, kvh, d = pool.shape
     nlead = len(lead)
+    nb = -(-width // bsz)
+    if nb < tables.shape[-1]:
+        tables = tables[..., :nb]
     mb = tables.shape[-1]
     view = jnp.take(pool, tables, axis=nlead, mode="clip")
     view = view.reshape(

@@ -143,6 +143,32 @@ def make_multislice_mesh(
     return Mesh(arr, AXES)
 
 
+def serving_mesh(
+    tp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Optional[Mesh]:
+    """1-D tensor-parallel mesh for the serving engine: ``tp`` devices on
+    the fastest links (ICI — tp is the innermost axis precisely so its
+    collectives stay intra-slice), every other axis 1.
+
+    The serving engine shards KV heads and the paged pool's KVH axis over
+    ``tp`` and replicates everything host-visible (block tables, lengths,
+    logits), so the scheduler never notices the mesh. Returns ``None`` for
+    ``tp <= 1``: the single-chip engine runs the exact unsharded code path,
+    not a degenerate 1-device mesh — bit-exactness baselines compare
+    against real single-chip traces.
+    """
+    if tp <= 1:
+        return None
+    devs = list(devices) if devices is not None else jax.devices()
+    if tp > len(devs):
+        raise ValueError(
+            f"serving_mesh: tp={tp} exceeds the {len(devs)} visible "
+            f"devices"
+        )
+    return make_mesh(MeshConfig(dp=1, tp=tp), devs[:tp])
+
+
 def mesh_for_context(
     ctx, config: Optional[MeshConfig] = None,
     devices: Optional[Sequence[jax.Device]] = None,

@@ -76,6 +76,64 @@ def shard_params(params: Any, shardings: Any) -> Any:
     )
 
 
+def serving_param_shardings(
+    cfg: Any, mesh: Mesh, quant: str = "",
+) -> Any:
+    """NamedShardings for ``models.generate.inference_params`` trees on a
+    serving mesh: each weight keeps its training-time PartitionSpec
+    (``transformer.param_specs`` / ``generate.inference_param_specs``)
+    with mesh axes that don't divide the dimension dropped to replicated.
+
+    Dropping instead of erroring matters for serving: the tp axis must
+    shard attention/MLP projections (that's the HBM win), but a tiny
+    model's vocab or d_ff may not divide tp — those weights replicate and
+    the engine still runs. The per-shard attention kernels declare their
+    weights replicated (``in_specs=P()``) anyway and let XLA all-gather
+    the stored shards at dispatch, which moves bytes but never changes
+    them — the storage sharding halves per-device weight HBM per tp
+    doubling while greedy outputs stay bitwise those of one chip."""
+    from kubeflow_controller_tpu.models import generate as gen
+
+    specs = gen.inference_param_specs(cfg, quant)
+
+    def fit(spec: P, shape: Tuple[int, ...]) -> NamedSharding:
+        parts = list(spec) + [None] * (len(shape) - len(spec))
+        out = []
+        for dim, part in zip(shape, parts[:len(shape)]):
+            names = part if isinstance(part, tuple) else (
+                () if part is None else (part,))
+            size = 1
+            for n in names:
+                size *= mesh.shape.get(n, 1)
+            out.append(part if size > 1 and dim % size == 0 else None)
+        return NamedSharding(mesh, P(*out))
+
+    def place(spec, leaf):
+        # A quantized weight is a plain (q_int8, scale) tuple whose spec
+        # is a plain (weight_spec, scale_spec) tuple; a PartitionSpec is
+        # ALSO a tuple subclass, so discriminate on the spec's type.
+        if isinstance(spec, tuple) and not isinstance(spec, P):
+            s_w, s_s = spec
+            return (fit(s_w, leaf[0].shape), fit(s_s, leaf[1].shape))
+        return fit(spec, leaf.shape)
+
+    def shardings_for(params: Any) -> Any:
+        return jax.tree.map(
+            place, specs, params,
+            is_leaf=lambda x: isinstance(x, tuple),
+        )
+
+    return shardings_for
+
+
+def shard_serving_params(cfg: Any, params: Any, mesh: Mesh,
+                         quant: str = "") -> Any:
+    """Place a serving param tree tp-sharded onto ``mesh`` (see
+    :func:`serving_param_shardings`)."""
+    shardings = serving_param_shardings(cfg, mesh, quant)(params)
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
 def opt_state_shardings(
     tx: Any, params: Any, param_shardings: Any, mesh: Mesh
 ) -> Any:

@@ -230,6 +230,93 @@ def test_int8_engine_finish_reasons_match_fp(cfg, params):
     assert eng_q.stats.kv_bytes_per_token < eng_fp.stats.kv_bytes_per_token
 
 
+def test_paged_view_width_cap_bitwise(cfg, params):
+    """The occupancy-capped gather (ops/attention.py:paged_kv_view with
+    ``width`` below the full table span) must be bitwise-invisible on
+    the paths the engine caps: the gathered bytes are a strict prefix
+    of the full view, and the single-token decode matvec reduces its
+    width sequentially, so trailing exactly-zero masked terms change
+    nothing. The K+1-wide verify matmul does NOT share that property —
+    XLA tiles its width reduction differently per W, reassociating the
+    sum (~1 ulp drift) — which is why the engine always verifies at
+    full width (serving_engine._make_spec); the verify leg here pins
+    the decision-level contract (same window/accept/commit) a capped
+    verify would have to meet, not logits bitwiseness it can't."""
+    from kubeflow_controller_tpu.ops.attention import paged_kv_view
+
+    prompts = _prompts(cfg, [5, 8, 11])
+    _, paged, _, logits_full = _setup(cfg, params, prompts)
+
+    # Raw view equality: capped gather == full gather's leading columns.
+    full = np.asarray(paged_kv_view(paged.k[0], paged.tables, MAX_SEQ))
+    for vw in (BS, 2 * BS, MAX_SEQ):
+        capped = np.asarray(paged_kv_view(paged.k[0], paged.tables, vw))
+        assert np.array_equal(capped, full[:, :vw])
+
+    # Decode: every pow2 width covering the live occupancy (16 tokens
+    # covers prompt 11 + 5 decode steps) commits identical logits.
+    logits_capped = logits_full
+    paged_capped = paged
+    for _ in range(5):
+        toks = logits_full.argmax(-1).astype(jnp.int32)
+        assert np.array_equal(
+            np.asarray(toks),
+            np.asarray(logits_capped.argmax(-1).astype(jnp.int32)))
+        logits_full, paged = gen.decode_step_paged(
+            cfg, params, toks[:, None], paged)
+        logits_capped, paged_capped = gen.decode_step_paged(
+            cfg, params, toks[:, None], paged_capped, view_width=16)
+        assert np.array_equal(np.asarray(logits_full),
+                              np.asarray(logits_capped))
+
+    # Verify through a capped view: identical accept/commit decisions
+    # and committed cache state; logits agree to reassociation noise
+    # only (the documented reason the engine never caps this path).
+    rng = np.random.default_rng(6)
+    draft = jnp.asarray(rng.integers(0, cfg.vocab_size, (3, 3)), jnp.int32)
+    dlen = jnp.asarray([3, 2, 3], jnp.int32)
+    eos = jnp.full((3,), -1, jnp.int32)
+    mc = jnp.full((3,), 8, jnp.int32)
+    wf, nf, lf, paged = gen.verify_step_paged(
+        cfg, params, draft, dlen, logits_full, paged, eos, mc)
+    wc, nc, lc, paged_capped = gen.verify_step_paged(
+        cfg, params, draft, dlen, logits_capped, paged_capped, eos, mc,
+        view_width=MAX_SEQ // 2)
+    assert np.array_equal(np.asarray(wf), np.asarray(wc))
+    assert np.array_equal(np.asarray(nf), np.asarray(nc))
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(lc),
+                               rtol=1e-6, atol=1e-6)
+    assert np.array_equal(np.asarray(paged.length),
+                          np.asarray(paged_capped.length))
+
+
+def test_engine_view_width_tracks_occupancy(cfg, params):
+    """The engine's gather width follows its max reserved span: small
+    requests dispatch through a narrow view, and retirement shrinks it
+    back — while the streams stay the full-width streams (pinned by
+    the bitwise tests above and tests/test_tp_serving.py)."""
+    rng = np.random.default_rng(9)
+    eng = ServingEngine(cfg, params, n_slots=2, max_seq=MAX_SEQ,
+                        prefill_mode="bucketed", block_size=BS)
+    assert eng._view_width() == BS          # idle: minimum width
+    eng.submit(Request(rid=0, prompt=rng.integers(
+        0, cfg.vocab_size, 5).astype(np.int32), max_new_tokens=2))
+    eng.step()
+    # 5 + 2 tokens -> 2 pages -> pow2 span of 2 pages.
+    assert eng._view_width() == 2 * BS
+    eng.submit(Request(rid=1, prompt=rng.integers(
+        0, cfg.vocab_size, 20).astype(np.int32), max_new_tokens=8))
+    eng.step()
+    # 20 + 8 tokens -> 7 pages -> pow2 rounds to the full 8-page span.
+    assert eng._view_width() == MAX_SEQ
+    for _ in range(40):
+        eng.step()
+        if eng.idle:
+            break
+    assert eng.idle
+    assert eng._view_width() == BS          # all reservations cleared
+
+
 def test_prefix_hit_is_zero_copy(cfg, params):
     """Two waves of the same prompts through one prefix-cache engine:
     wave 2 must take the pointer-assembly path — prefix_zero_copy_tokens
