@@ -3,9 +3,9 @@
 
 PY ?= python
 
-.PHONY: all native test test-fast test-tp bench bench-cp bench-serve \
-	bench-overload bench-prefix bench-fleet bench-spec bench-paged \
-	bench-tp clean stamp
+.PHONY: all native test test-fast test-tp test-obs bench bench-cp \
+	bench-serve bench-overload bench-prefix bench-fleet bench-spec \
+	bench-paged bench-tp bench-obs clean stamp
 
 # Build-stamp analog of the reference's ldflags version injection
 # (/root/reference/Makefile:23-26): export the sha for build_version().
@@ -22,6 +22,12 @@ test: native
 
 test-fast:
 	$(PY) -m pytest tests/ -q -x -m "not slow"
+
+# Observability guard: the obs package (tracer, metrics registry,
+# reservoir) plus the instrumented-plane tests — span conservation,
+# no-op tracer bit-identity, flush-on-every-exit-path.
+test-obs:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs.py -q
 
 # Sharded-engine guard: the tensor-parallel serving tests on the forced
 # 8-virtual-device CPU mesh (tests/conftest.py sets the same flag for
@@ -70,9 +76,12 @@ bench-prefix:
 # rolling restart; gates on request conservation, at-most-once delivery,
 # >=0.8 goodput retention, >=1.5x affinity hit-rate, and zero rollout
 # drops — see benchmarks/RESULTS.md and docs/lmservice.md. --smoke keeps
-# it tier-1 sized; drop it for the full sweep.
+# it tier-1 sized; drop it for the full sweep. --trace shares one
+# Tracer across router + replica engines + controller and gates on the
+# exported file stitching a request's hops together by rid.
 bench-fleet:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_bench.py --smoke \
+		--trace /tmp/fleet_trace.json \
 		--json benchmarks/fleet_bench_summary.json
 
 # Speculative-decoding benchmark: radix drafting on repeat traffic
@@ -103,6 +112,17 @@ bench-paged:
 bench-tp:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/tp_bench.py \
 		--json benchmarks/tp_bench_summary.json
+
+# Observability overhead benchmark: greedy outputs asserted
+# bit-identical across tracer-off/tracer-on engines before timing;
+# gates on <=1% TPOT p50 drift between two identical tracer-off
+# engines (noise floor), <=5% with tracing on, a Perfetto-valid
+# exported trace, and span conservation (every submitted rid ->
+# exactly one retire event whose finish_reason matches the
+# Completion) — see benchmarks/RESULTS.md and docs/observability.md.
+bench-obs:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/obs_bench.py \
+		--json benchmarks/obs_bench_summary.json
 
 clean:
 	$(MAKE) -C csrc clean

@@ -194,6 +194,12 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--smoke", action="store_true",
                    help="small fast config for CI")
+    p.add_argument("--trace", default="",
+                   help="write one Chrome trace covering router, "
+                        "replica engines, and controller spans to this "
+                        "path; adds a stitched-trace gate (a request's "
+                        "router dispatch + engine lifecycle + retire "
+                        "must share a rid in the exported file)")
     p.add_argument("--json", default="", help="also write the summary here")
     args = p.parse_args(argv)
     if args.smoke:
@@ -223,11 +229,20 @@ def main(argv=None) -> int:
     budgets = [int(x) for x in args.budgets.split(",")]
     max_seq = args.shared_len + args.tail_max + max(budgets) + args.block_size
 
+    # ONE tracer shared by every engine, every router, and the
+    # controller runtime: spans from all hops land in one ring keyed by
+    # rid, so the export is a single stitched fleet trace.
+    tracer = None
+    if args.trace:
+        from kubeflow_controller_tpu.obs.trace import Tracer
+        tracer = Tracer(capacity=1 << 20, path=args.trace)
+
     def mk_engine():
         return ServingEngine(
             cfg, params, n_slots=args.slots, max_seq=max_seq,
             prefill_mode="bucketed", block_size=args.block_size,
             prefix_cache=True, max_queue=args.max_queue,
+            tracer=tracer,
         )
 
     warm = make_fleet_requests(
@@ -258,7 +273,7 @@ def main(argv=None) -> int:
     def run_affinity_leg(affinity: bool) -> Dict[str, float]:
         router = FleetRouter(clock=time.perf_counter,
                              block_size=args.block_size,
-                             affinity=affinity)
+                             affinity=affinity, tracer=tracer)
         factory = pool.factory(router)
         for r in range(args.replicas):
             router.add_replica(f"replica-{r}", factory(f"replica-{r}"))
@@ -288,7 +303,7 @@ def main(argv=None) -> int:
 
     def fresh_runtime():
         rt = LocalRuntime(default_policy=PodRunPolicy(
-            start_delay=0.2, run_duration=1e9))
+            start_delay=0.2, run_duration=1e9), tracer=tracer)
         svc = types.LMService(
             metadata=ObjectMeta(name="fleet", namespace=ns),
             spec=types.LMServiceSpec(
@@ -309,7 +324,7 @@ def main(argv=None) -> int:
     def run_traffic(chaos_kills: int, seed: int):
         rt = fresh_runtime()
         router = FleetRouter(clock=time.perf_counter,
-                             block_size=args.block_size)
+                             block_size=args.block_size, tracer=tracer)
         factory = pool.factory(router)
         sync_fleet_from_pods(router, pods_of(rt), factory)
         assert len(router.replicas) == args.replicas
@@ -375,7 +390,7 @@ def main(argv=None) -> int:
 
     # -- leg 4: rolling restart, zero drops -------------------------------
     router = FleetRouter(clock=time.perf_counter,
-                         block_size=args.block_size)
+                         block_size=args.block_size, tracer=tracer)
     factory = pool.factory(router)
     for r in range(args.replicas):
         router.add_replica(f"replica-{r}", factory(f"replica-{r}"))
@@ -402,6 +417,41 @@ def main(argv=None) -> int:
         "at_most_once": chaos_run["duplicate_completions"] == 0,
         "rollout_zero_drop": rollout_zero_drop,
     }
+    obs = {}
+    if tracer is not None:
+        from kubeflow_controller_tpu.obs.trace import load_chrome_trace
+
+        tracer.flush()
+        doc = load_chrome_trace(args.trace)     # raises on malformed
+        # Stitched-trace gate: at least one request whose ROUTER
+        # dispatch span, ENGINE lifecycle spans, and terminal retire
+        # event all share a rid in the one exported file — the
+        # cross-process causal chain the shared tracer exists for.
+        by_rid: Dict[str, set] = {}
+        cats_seen = set()
+        for ev in doc["traceEvents"]:
+            if ev.get("ph") == "M":
+                continue
+            cats_seen.add(ev.get("cat"))
+            rid = ev.get("args", {}).get("rid")
+            if rid is not None:
+                by_rid.setdefault(rid, set()).add(
+                    (ev.get("cat"), ev["name"]))
+        stitched = sum(
+            1 for names in by_rid.values()
+            if ("router", "dispatch") in names
+            and (("dataplane", "queue_wait") in names
+                 or ("dataplane", "admit") in names)
+            and ("dataplane", "retire") in names)
+        gates["trace_stitched"] = stitched > 0
+        gates["trace_has_control_plane"] = "control" in cats_seen
+        obs = {
+            "trace_file": args.trace,
+            "spans_recorded": tracer.spans_recorded,
+            "spans_dropped": tracer.spans_dropped,
+            "stitched_requests": stitched,
+            "tracks": sorted(c for c in cats_seen if c),
+        }
     out = {
         "metric": "fleet_chaos_goodput_retention",
         "value": round(retention, 3),
@@ -421,6 +471,7 @@ def main(argv=None) -> int:
         "baseline": baseline,
         "chaos": chaos_run,
         "rollout": rollout_counts,
+        "observability": obs,
         "workload": {
             "replicas": args.replicas, "slots": args.slots,
             "block_size": args.block_size,

@@ -1,0 +1,278 @@
+"""Observability overhead + trace-validity benchmark.
+
+The tracing contract (docs/observability.md) makes two promises this
+bench holds the code to:
+
+* **zero-cost off**: an engine built with ``tracer=None`` takes no
+  extra clock reads and no span bookkeeping — every instrumentation
+  site is guarded by ``if self._tracer is not None``. Gate: two
+  identical tracer-off engines, interleaved best-of-repeats, TPOT p50
+  ratio within ``--max-off-drift`` (default 1%). This is the harness
+  noise floor — if two IDENTICAL engines drift more than this, the
+  tracing-on gate below would be meaningless.
+* **low-cost on**: with a ``Tracer`` attached, every request grows a
+  full causal span tree (submit -> queue_wait -> admit ->
+  prefill_chunk xN -> decode_quantum -> retire) and the TPOT p50
+  regression vs tracer-off stays within ``--max-on-drift`` (default
+  5%).
+
+Greedy outputs are asserted BIT-IDENTICAL across all three engines
+before any timing is reported (spec_bench.py discipline): a tracer
+that perturbed decode would be a correctness bug, not an overhead.
+
+A separate single-run leg exports the trace and checks:
+
+* the file is valid Chrome trace JSON (``load_chrome_trace`` — the
+  invariants Perfetto relies on);
+* **span conservation**: every submitted rid produced exactly one
+  terminal ``retire`` event, and its ``finish_reason`` attr matches
+  the engine's returned :class:`Completion`.
+
+Prints one JSON object; with ``--json`` also writes it to a file. Run
+via ``make bench-obs``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np
+
+
+def workload(cfg, n_requests: int, prompt_len: int, max_new: int,
+             seed: int):
+    from kubeflow_controller_tpu.dataplane.serving_engine import Request
+
+    rng = np.random.default_rng(seed)
+    return [
+        Request(rid=i,
+                prompt=rng.integers(0, cfg.vocab_size, prompt_len).astype(
+                    np.int32),
+                max_new_tokens=max_new)
+        for i in range(n_requests)
+    ]
+
+
+def _reqs(requests):
+    from kubeflow_controller_tpu.dataplane.serving_engine import Request
+
+    return [Request(rid=r.rid, prompt=np.array(r.prompt),
+                    max_new_tokens=r.max_new_tokens, eos_id=r.eos_id)
+            for r in requests]
+
+
+class _ResetRunner:
+    """Cold-per-repeat timing (spec_bench idiom): reset between
+    repeats; the repeats of the compared engines are interleaved so
+    host drift hits all of them."""
+
+    def __init__(self, cfg, params, requests, **engine_kw):
+        from kubeflow_controller_tpu.dataplane.serving_engine import (
+            ServingEngine,
+        )
+
+        self.requests = requests
+        self.engine = ServingEngine(cfg, params, **engine_kw)
+        self.engine.run(_reqs(requests))          # warmup: compile + run
+        self.runs = []
+
+    def time(self) -> None:
+        self.engine.reset()
+        t0 = time.perf_counter()
+        completions = self.engine.run(_reqs(self.requests))
+        wall = time.perf_counter() - t0
+        self.runs.append((wall, completions, self.engine.stats))
+
+    def best(self):
+        wall, completions, _ = min(self.runs, key=lambda r: r[0])
+        # Best-of-repeats TPOT p50 (spec_bench rationale): decode work
+        # is deterministic, so scheduler noise only ever INFLATES
+        # inter-token gaps; the repeat minima of interleaved engines
+        # are the least-noise comparison.
+        tpot = min(s.summary()["tpot_p50_ms"] for _, _, s in self.runs)
+        return {c.rid: list(c.tokens) for c in completions}, wall, tpot
+
+
+def conservation_check(cfg, params, requests, trace_path, engine_kw):
+    """One fresh engine + fresh tracer, one run, exported and audited:
+    submitted rids == retired rids (exactly once each), finish_reason
+    attrs agree with the returned Completions."""
+    from kubeflow_controller_tpu.dataplane.serving_engine import (
+        ServingEngine,
+    )
+    from kubeflow_controller_tpu.obs.trace import Tracer, load_chrome_trace
+
+    tracer = Tracer(path=trace_path)
+    engine = ServingEngine(cfg, params, tracer=tracer, **engine_kw)
+    comps = engine.run(_reqs(requests))
+    tracer.flush()
+    doc = load_chrome_trace(trace_path)         # raises on malformed
+
+    submits: Dict[str, int] = {}
+    retires: Dict[str, List[str]] = {}
+    span_names = set()
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") == "M":
+            continue
+        span_names.add(ev["name"])
+        rid = ev.get("args", {}).get("rid")
+        if ev["name"] == "submit":
+            submits[rid] = submits.get(rid, 0) + 1
+        elif ev["name"] == "retire":
+            retires.setdefault(rid, []).append(
+                ev.get("args", {}).get("finish_reason"))
+
+    want = {str(c.rid): c.finish_reason for c in comps}
+    errors = []
+    if set(submits) != set(want):
+        errors.append(
+            f"submit rids {sorted(submits)} != completed {sorted(want)}")
+    for rid, reason in want.items():
+        got = retires.get(rid, [])
+        if len(got) != 1:
+            errors.append(f"rid {rid}: {len(got)} retire events (want 1)")
+        elif got[0] != reason:
+            errors.append(
+                f"rid {rid}: retire reason {got[0]!r} != "
+                f"Completion {reason!r}")
+    extra = set(retires) - set(want)
+    if extra:
+        errors.append(f"retire events for unknown rids {sorted(extra)}")
+    required = {"submit", "queue_wait", "admit", "prefill_chunk",
+                "decode_quantum", "retire"}
+    missing = required - span_names
+    if missing:
+        errors.append(f"span taxonomy missing {sorted(missing)}")
+    return {
+        "events": sum(1 for e in doc["traceEvents"]
+                      if e.get("ph") != "M"),
+        "span_names": sorted(span_names),
+        "spans_recorded": tracer.spans_recorded,
+        "spans_dropped": tracer.spans_dropped,
+        "errors": errors,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="tiny")
+    p.add_argument("--requests", type=int, default=12)
+    p.add_argument("--prompt-len", type=int, default=24)
+    p.add_argument("--max-new", type=int, default=128)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--block-size", type=int, default=8)
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-off-drift", type=float, default=0.01,
+                   help="allowed TPOT p50 ratio between two identical "
+                        "tracer-off engines (harness noise floor)")
+    p.add_argument("--max-on-drift", type=float, default=0.05,
+                   help="allowed TPOT p50 regression, tracing on vs off")
+    p.add_argument("--trace", default="/tmp/obs_bench_trace.json",
+                   help="where the conservation leg writes its trace")
+    p.add_argument("--json", default="", help="also write the summary here")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from kubeflow_controller_tpu.dataplane.entrypoints.lm import CONFIGS
+    from kubeflow_controller_tpu.models import generate as gen
+    from kubeflow_controller_tpu.models import transformer as tfm
+    from kubeflow_controller_tpu.obs.telemetry import reset_registry
+    from kubeflow_controller_tpu.obs.trace import Tracer
+
+    reset_registry()        # bench isolation from any prior importer
+    cfg = CONFIGS[args.config]()
+    params = gen.inference_params(
+        cfg, tfm.init_params(cfg, jax.random.key(0)))
+    reqs = workload(cfg, args.requests, args.prompt_len, args.max_new,
+                    args.seed)
+    engine_kw = dict(n_slots=args.slots,
+                     max_seq=args.prompt_len + args.max_new,
+                     prefill_mode="bucketed", block_size=args.block_size)
+
+    # Tracer ring sized so the timed repeats never wrap: drops would
+    # make the on-leg cheaper than real tracing.
+    tracer = Tracer(capacity=1 << 20)
+    base = _ResetRunner(cfg, params, reqs, **engine_kw)
+    off = _ResetRunner(cfg, params, reqs, tracer=None, **engine_kw)
+    on = _ResetRunner(cfg, params, reqs, tracer=tracer, **engine_kw)
+    for _ in range(args.repeats):        # interleaved: drift hits all
+        base.time()
+        off.time()
+        on.time()
+    base_out, base_wall, base_tpot = base.best()
+    off_out, off_wall, off_tpot = off.best()
+    on_out, on_wall, on_tpot = on.best()
+
+    # Bit-exactness BEFORE timing is reported: tracing must never
+    # perturb decode.
+    mism = [r for r in base_out
+            if base_out[r] != off_out.get(r) or base_out[r] != on_out.get(r)]
+    outputs_match = not mism
+
+    off_ratio = off_tpot / base_tpot if base_tpot else 1.0
+    on_ratio = on_tpot / base_tpot if base_tpot else 1.0
+
+    cons = conservation_check(cfg, params, reqs, args.trace, engine_kw)
+
+    out = {
+        "metric": "tracing_on_tpot_p50_ratio",
+        "value": round(on_ratio, 4),
+        "unit": "x tracer-on vs tracer-off TPOT p50 (1.0 = free)",
+        "outputs_match": outputs_match,
+        "requests": args.requests,
+        "prompt_len": args.prompt_len,
+        "max_new": args.max_new,
+        "repeats": args.repeats,
+        "off_tpot_p50_ms": round(base_tpot, 3),
+        "off2_tpot_p50_ms": round(off_tpot, 3),
+        "on_tpot_p50_ms": round(on_tpot, 3),
+        "off_drift_ratio": round(off_ratio, 4),
+        "on_drift_ratio": round(on_ratio, 4),
+        "timed_spans_recorded": tracer.spans_recorded,
+        "timed_spans_dropped": tracer.spans_dropped,
+        "trace_file": args.trace,
+        "trace_events": cons["events"],
+        "trace_span_names": cons["span_names"],
+        "conservation_errors": cons["errors"],
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+    if mism:
+        print(f"OUTPUT MISMATCH across tracer legs: rids {mism[:8]}")
+        return 1
+    if cons["errors"]:
+        print("SPAN CONSERVATION FAILED:")
+        for e in cons["errors"]:
+            print(f"  - {e}")
+        return 1
+    if tracer.spans_dropped:
+        print(f"TIMED TRACER WRAPPED: {tracer.spans_dropped} dropped "
+              f"(on-leg timing untrustworthy; raise capacity)")
+        return 1
+    if off_ratio > 1.0 + args.max_off_drift:
+        print(f"NOISE FLOOR TOO HIGH: off/off ratio {off_ratio:.4f} > "
+              f"{1.0 + args.max_off_drift:.4f}")
+        return 1
+    if on_ratio > 1.0 + args.max_on_drift:
+        print(f"TRACING OVERHEAD ABOVE TARGET: {on_ratio:.4f} > "
+              f"{1.0 + args.max_on_drift:.4f}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -55,6 +55,7 @@ from kubeflow_controller_tpu.controller.claim import claim_objects
 from kubeflow_controller_tpu.controller.expectations import ControllerExpectations
 from kubeflow_controller_tpu.controller.informer import Informer
 from kubeflow_controller_tpu.controller.workqueue import RateLimitingQueue
+from kubeflow_controller_tpu.obs.telemetry import registry
 from kubeflow_controller_tpu.tpu import naming
 from kubeflow_controller_tpu.tpu.plan import Plan, plan_job
 from kubeflow_controller_tpu.updater import compute_status
@@ -91,6 +92,11 @@ class ControllerOptions:
     # Wall-clock requeue cadence while a backoff is pending (now_fn may be
     # a simulated clock, so the queue polls and re-checks it).
     backoff_poll: float = 0.05
+    # Optional control-plane tracer (docs/observability.md): workqueue
+    # enqueue->dequeue latency, per-key sync spans (outcome-tagged, the
+    # noop short-circuit included), and requeue/backoff events, all on
+    # the "control" track keyed by workqueue key. None = zero overhead.
+    tracer: Optional[object] = None
 
 
 @dataclass
@@ -146,6 +152,11 @@ class Controller:
         # Sim-clock backoff deadlines (key -> now_fn deadline); see
         # _requeue_after / _kick_sim_backoffs.
         self._sim_backoffs: Dict[str, float] = {}
+        # Earliest pending enqueue time per key (tracer clock units),
+        # stamped by the informer handlers and popped by _process — the
+        # enqueue->dequeue latency span. setdefault/pop are single
+        # bytecode dict ops, safe across informer + worker threads.
+        self._enqueue_t: Dict[str, float] = {}
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
 
@@ -157,6 +168,14 @@ class Controller:
 
     # -- event handlers (informer side) -------------------------------------
 
+    def _note_enqueue(self, key: str) -> None:
+        """Stamp the key's earliest pending enqueue for the
+        enqueue->dequeue latency span (coalesced adds keep the FIRST
+        stamp — the latency a watch event actually waited)."""
+        tr = self.opts.tracer
+        if tr is not None:
+            self._enqueue_t.setdefault(key, tr.clock())
+
     def _on_job_event(self, ev: WatchEvent) -> None:
         key = f"{ev.obj.metadata.namespace}/{ev.obj.metadata.name}"
         if ev.type == EventType.DELETED:
@@ -164,6 +183,7 @@ class Controller:
             self.expectations.delete_expectations(key)
             with self._count_lock:
                 self._last_sync_fp.pop(key, None)
+        self._note_enqueue(key)
         self.queue.add(key)
 
     def _on_lmservice_event(self, ev: WatchEvent) -> None:
@@ -171,6 +191,7 @@ class Controller:
                f"{ev.obj.metadata.namespace}/{ev.obj.metadata.name}")
         if ev.type == EventType.DELETED:
             self.expectations.delete_expectations(key)
+        self._note_enqueue(key)
         self.queue.add(key)
 
     @staticmethod
@@ -204,6 +225,7 @@ class Controller:
                 self.expectations.creation_observed(key)
             elif ev.type == EventType.DELETED:
                 self.expectations.deletion_observed(key)
+            self._note_enqueue(key)
             self.queue.add(key)
 
     # -- lifecycle -----------------------------------------------------------
@@ -278,20 +300,35 @@ class Controller:
     def _process(self, key: str) -> None:
         import time as _time
 
+        tr = self.opts.tracer
+        if tr is not None:
+            t_enq = self._enqueue_t.pop(key, None)
+            if t_enq is not None:
+                tr.add_span("queue_wait", t_enq, tr.clock(),
+                            track="control", rid=key)
         trace = SyncTrace(key=key, start=self.opts.now_fn())
         t0 = _time.perf_counter()
+        t_s0 = tr.clock() if tr is not None else 0.0
         try:
             self.sync(key, trace)
         except Exception as e:  # requeue with backoff (controller.go:228-242)
             trace.error = f"{type(e).__name__}: {e}"
             logger.exception("sync %s failed", key)
             self.queue.add_rate_limited(key)
+            if tr is not None:
+                tr.add_event("requeue_backoff", track="control", rid=key,
+                             error=trace.error)
         else:
             self.queue.forget(key)
         finally:
             self.queue.done(key)
             trace.duration = self.opts.now_fn() - trace.start
             wall = _time.perf_counter() - t0
+            if tr is not None:
+                tr.add_span("sync", t_s0, tr.clock(), track="control",
+                            rid=key, outcome=trace.outcome,
+                            noop=trace.outcome == "noop-skip",
+                            error=trace.error)
             with self._count_lock:   # worker threads increment concurrently
                 self.sync_count += 1
                 # Wall-clock seconds spent INSIDE sync handlers — the
@@ -301,6 +338,8 @@ class Controller:
                 # under the simulated clock.
                 self.sync_wall_s += wall
             self.traces.append(trace)
+            registry().counter("syncs", "control").inc()
+            registry().histogram("sync_wall_s", "control").observe(wall)
 
     # -- the sync handler ----------------------------------------------------
 

@@ -150,6 +150,7 @@ def serve(
     proposer: str = "prompt",
     tp: int = 1,
     mesh_devices: str = "",
+    trace: str = "",
     stop=None,
 ) -> Dict[str, float]:
     """``stop`` is a ``threading.Event`` (e.g. from
@@ -157,15 +158,22 @@ def serve(
     engine drains within ``drain_grace_s``, partial completions are
     written to ``output_file`` with their finish reasons, and the
     metrics JSONL still flushes — SIGTERM/preemption loses the tail of
-    each stream, not the run's artifacts."""
+    each stream, not the run's artifacts.
+
+    ``trace`` names a Chrome-trace JSON output path: the engine records
+    per-request lifecycle spans (docs/observability.md) and the file is
+    flushed on EVERY exit — normal completion, SIGTERM drain, and
+    engine errors alike — so it always parses in Perfetto."""
     import jax
 
     from kubeflow_controller_tpu.dataplane import metrics as metrics_mod
     from kubeflow_controller_tpu.dataplane.serving_engine import (
         Rejected, Request, ServingEngine,
     )
+    from kubeflow_controller_tpu.obs.trace import Tracer
 
     ctx = ctx or ProcessContext.from_env()
+    tracer = Tracer(path=trace) if trace else None
     cfg = CONFIGS[config]()
     # Tensor-parallel serving (docs/serving.md "Tensor-parallel
     # serving"): validate the head split BEFORE loading weights or
@@ -222,7 +230,7 @@ def serve(
             prefix_cache=prefix_cache, block_size=block_size,
             kv_hbm_budget_mb=kv_pool_mb, kv_quant=kv_quant, paged=paged,
             spec_decode=speculative, draft_k=draft_k, proposer=proposer,
-            tp=tp, mesh=mesh,
+            tp=tp, mesh=mesh, tracer=tracer,
         )
         prompts_np = np.asarray(prompts)
         completions = []
@@ -282,7 +290,7 @@ def serve(
             block_size=block_size, kv_hbm_budget_mb=kv_pool_mb,
             kv_quant=kv_quant, paged=paged,
             spec_decode=speculative, draft_k=draft_k, proposer=proposer,
-            tp=tp, mesh=mesh,
+            tp=tp, mesh=mesh, tracer=tracer,
         )
         prompts_np = np.asarray(prompts)
         history = [list(map(int, prompts_np[i])) for i in range(b)]
@@ -388,6 +396,12 @@ def serve(
         "interrupted": float(interrupted),
     }
     out.update(serving)
+    if tracer is not None:
+        # Idempotent — the SIGTERM drain path already flushed through
+        # the engine; this covers the normal-completion exit.
+        tracer.flush()
+        out["spans_recorded"] = float(tracer.spans_recorded)
+        out["spans_dropped"] = float(tracer.spans_dropped)
     ml = metrics_mod.from_context(ctx)
     if ml is not None:
         # One summary line into the job's log_dir sink — the same JSONL
@@ -488,6 +502,11 @@ def main(argv=None) -> int:
                    help="comma-separated device indices to build the "
                         "serving mesh from (e.g. '0,1,2,3'; default: "
                         "the first --tp visible devices)")
+    p.add_argument("--trace", default="",
+                   help="write a Chrome-trace-event JSON of per-request "
+                        "lifecycle spans to this path (load it in "
+                        "Perfetto / chrome://tracing); empty = tracing "
+                        "off, zero overhead")
     args = p.parse_args(argv)
     if args.tp > 1:
         try:
@@ -532,6 +551,7 @@ def main(argv=None) -> int:
         proposer=args.proposer,
         tp=args.tp,
         mesh_devices=args.mesh,
+        trace=args.trace,
         stop=stop,
     )
     if metrics["interrupted"]:

@@ -16,20 +16,26 @@ docs/serving.md.
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional
 
 from kubeflow_controller_tpu.dataplane.dist import ProcessContext
+from kubeflow_controller_tpu.obs.telemetry import Reservoir, registry
+
+# Latency samples retained per series (exact percentiles below this,
+# sliding window above — docs/observability.md "Bounded reservoirs").
+SAMPLE_CAP = 4096
 
 
-def percentile(xs: List[float], p: float) -> float:
+def percentile(xs: Iterable[float], p: float) -> float:
     """Nearest-rank percentile (p in [0, 100]); 0.0 on empty input —
     serving summaries must stay JSON-clean even for an idle engine."""
-    if not xs:
-        return 0.0
     s = sorted(xs)
+    if not s:
+        return 0.0
     idx = min(len(s) - 1, max(0, int(round(p / 100.0 * (len(s) - 1)))))
     return s[idx]
 
@@ -68,9 +74,15 @@ class ServingStats:
     steps: int = 0
     active_slot_steps: int = 0
     queue_depth_max: int = 0
-    ttfts_s: List[float] = field(default_factory=list)
-    tpots_s: List[float] = field(default_factory=list)
-    queue_waits_s: List[float] = field(default_factory=list)
+    # Latency samples live in capped deterministic reservoirs, not bare
+    # lists: a long-lived fleet replica would otherwise grow three
+    # unbounded float lists forever. Below SAMPLE_CAP the reservoir IS
+    # the sample list (percentiles exact, bench gates unchanged); above
+    # it the window slides and ``samples_dropped`` reports the shed.
+    ttfts_s: Reservoir = field(default_factory=lambda: Reservoir(SAMPLE_CAP))
+    tpots_s: Reservoir = field(default_factory=lambda: Reservoir(SAMPLE_CAP))
+    queue_waits_s: Reservoir = field(
+        default_factory=lambda: Reservoir(SAMPLE_CAP))
     finish_reasons: Dict[str, int] = field(default_factory=dict)
     # Prefix-cache / prefill accounting (docs/serving.md "KV block
     # pool, prefix reuse, and prefill bucketing"): hit tokens are prompt
@@ -124,15 +136,34 @@ class ServingStats:
     spec_steps: int = 0
     spec_probe_steps: int = 0
     spec_step_tokens_hist: Dict[int, int] = field(default_factory=dict)
+    # Observability (docs/observability.md): span counters synced from
+    # the engine's tracer each step — 0/0 with tracing off.
+    spans_recorded: int = 0
+    spans_dropped: int = 0
 
     def record(self, completion) -> None:
         self.finished += 1
         reason = getattr(completion, "finish_reason", "")
         self.finish_reasons[reason] = self.finish_reasons.get(reason, 0) + 1
+        reg = registry()
+        reg.counter("requests_finished", "serving").inc()
+        reg.counter(f"finish_{reason or 'none'}", "serving").inc()
         if completion.ttft_s is not None:   # no token ever decoded: no TTFT
             self.ttfts_s.append(completion.ttft_s)
+            reg.histogram("ttft_s", "serving").observe(completion.ttft_s)
         if len(completion.tokens) > 1:
             self.tpots_s.append(completion.tpot_s)
+            reg.histogram("tpot_s", "serving").observe(completion.tpot_s)
+
+    def record_queue_wait(self, wait_s: float) -> None:
+        self.queue_waits_s.append(wait_s)
+        registry().histogram("queue_wait_s", "serving").observe(wait_s)
+
+    @property
+    def samples_dropped(self) -> int:
+        """Latency samples evicted from the capped reservoirs."""
+        return (self.ttfts_s.dropped + self.tpots_s.dropped
+                + self.queue_waits_s.dropped)
 
     @property
     def slot_utilization(self) -> float:
@@ -191,6 +222,9 @@ class ServingStats:
             "acceptance_rate": self.acceptance_rate,
             "spec_steps": float(self.spec_steps),
             "spec_probe_steps": float(self.spec_probe_steps),
+            "spans_recorded": float(self.spans_recorded),
+            "spans_dropped": float(self.spans_dropped),
+            "samples_dropped": float(self.samples_dropped),
         }
         # Flatten the committed-tokens histogram into stable scalar keys
         # (spec_step_tokens_1 .. spec_step_tokens_{K+1}) so the JSONL
@@ -211,11 +245,14 @@ class MetricsLogger:
 
     def write(self, step: int, scalars: Dict[str, float]) -> None:
         rec = {"ts": round(time.time(), 3), "step": step}
+        # Non-finite floats -> null: json.dumps would happily emit the
+        # bare tokens Infinity/-Infinity/NaN, which no strict JSON
+        # parser (jq, pandas read_json, browsers) accepts.
         rec.update({
-            k: (float(v) if v == v else None)    # NaN -> null, stays JSON
+            k: (fv if math.isfinite(fv := float(v)) else None)
             for k, v in scalars.items()
         })
-        self._f.write(json.dumps(rec) + "\n")
+        self._f.write(json.dumps(rec, allow_nan=False) + "\n")
 
     def close(self) -> None:
         self._f.close()

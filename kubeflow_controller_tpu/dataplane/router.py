@@ -60,6 +60,7 @@ import numpy as np
 
 from kubeflow_controller_tpu.controller.workqueue import backoff_delay
 from kubeflow_controller_tpu.dataplane.metrics import percentile
+from kubeflow_controller_tpu.obs.telemetry import registry
 from kubeflow_controller_tpu.dataplane.serving_engine import (
     Completion, Rejected, Request, ServingEngine,
 )
@@ -120,8 +121,14 @@ class FleetRouter:
         eject_after: int = 2,
         readmit_after: int = 2,
         ttft_window: int = 16,
+        tracer=None,
     ):
         self._clock = clock
+        # Optional obs.Tracer: dispatch/failover/park/outcome spans on
+        # the "router" track, keyed by rid — the same rid string the
+        # engines use, so a fleet request's hops stitch into one trace
+        # (share ONE tracer between the router and its engines).
+        self._tracer = tracer
         self.block_size = int(block_size)
         # affinity=False is the random-dispatch baseline the benchmark
         # compares against: deterministic pseudo-random by rid, no owner
@@ -308,6 +315,8 @@ class FleetRouter:
         if req is None or rid in self._outcomes:
             return
         tried = set(exclude)
+        tr = self._tracer
+        t0 = self._clock() if tr is not None else 0.0
         while True:
             h = self._route(req, frozenset(tried))
             if h is None:
@@ -315,13 +324,21 @@ class FleetRouter:
                 return
             try:
                 h.engine.submit(req)
-            except Rejected:
+            except Rejected as e:
                 # This replica said no (full/draining) — try the rest
                 # of the fleet before parking.
+                if tr is not None:
+                    tr.add_event("failover", track="router",
+                                 rid=str(rid), replica=h.name,
+                                 reason=e.reason)
                 tried.add(h.name)
                 continue
             self._assigned[rid] = h.name
             self._record_owner(req, h.name)
+            if tr is not None:
+                tr.add_span("dispatch", t0, self._clock(),
+                            track="router", rid=str(rid),
+                            replica=h.name, attempt=attempt)
             return
 
     def _park_or_shed(self, rid: int, attempt: int) -> None:
@@ -335,6 +352,10 @@ class FleetRouter:
         self.retries += 1
         delay = backoff_delay(
             self.retry_base_s, self.retry_max_s, rid, attempt)
+        if self._tracer is not None:
+            self._tracer.add_event(
+                "park", track="router", rid=str(rid),
+                attempt=attempt, delay_s=delay)
         self._parked.append(_Parked(
             due_t=self._clock() + delay, rid=rid, attempt=attempt + 1))
 
@@ -347,6 +368,10 @@ class FleetRouter:
         self._outcomes[rid] = (kind, payload)
         self._requests.pop(rid, None)
         self._assigned.pop(rid, None)
+        if self._tracer is not None:
+            self._tracer.add_event("fleet_outcome", track="router",
+                                   rid=str(rid), kind=kind)
+        registry().counter(f"outcome_{kind}", "router").inc()
 
     def _complete(self, comp: Completion) -> None:
         kind = ("cancelled" if comp.finish_reason == "cancelled"
@@ -419,9 +444,12 @@ class FleetRouter:
         if self.ttft_slo_ms is not None:
             # Only TTFTs recorded since the last check: an ejected
             # replica must be judged on what it does now, not on the
-            # backlog that got it ejected.
-            ttfts = h.engine.stats.ttfts_s[h.ttft_seen:]
-            h.ttft_seen = len(h.engine.stats.ttfts_s)
+            # backlog that got it ejected. The high-water mark is the
+            # reservoir's LOGICAL append count (``total``), not its
+            # length — the capped ring evicts old samples, and
+            # ``since()`` keeps the window exact across eviction.
+            ttfts = h.engine.stats.ttfts_s.since(h.ttft_seen)
+            h.ttft_seen = h.engine.stats.ttfts_s.total
             if ttfts:
                 window = ttfts[-self.ttft_window:]
                 if percentile(window, 99) * 1e3 > self.ttft_slo_ms:
@@ -496,6 +524,18 @@ class FleetRouter:
             "affinity_hits": float(self.affinity_hits),
             "prefix_hit_rate": self.prefix_hit_rate,
             "spec_acceptance_rate": self.spec_acceptance_rate,
+            # Observability counters ride in the fleet JSONL so a
+            # postmortem knows whether the trace it is reading is
+            # complete (spans_dropped > 0 means the ring wrapped).
+            "spans_recorded": float(
+                self._tracer.spans_recorded
+                if self._tracer is not None else 0),
+            "spans_dropped": float(
+                self._tracer.spans_dropped
+                if self._tracer is not None else 0),
+            "samples_dropped": float(sum(
+                h.engine.stats.samples_dropped
+                for h in self._replicas.values())),
         }
 
 

@@ -127,6 +127,8 @@ import numpy as np
 from kubeflow_controller_tpu.dataplane import kv_blocks
 from kubeflow_controller_tpu.dataplane import spec_decode as spec_decode_mod
 from kubeflow_controller_tpu.dataplane.metrics import MetricsLogger, ServingStats
+from kubeflow_controller_tpu.obs.telemetry import registry
+from kubeflow_controller_tpu.obs.trace import Tracer
 from kubeflow_controller_tpu.models import generate as gen
 from kubeflow_controller_tpu.models.transformer import (
     Params, TransformerConfig,
@@ -335,6 +337,7 @@ class ServingEngine:
         spec_cooldown_max: int = 256,
         tp: int = 1,
         mesh=None,
+        tracer: Optional[Tracer] = None,
     ):
         self.cfg = cfg
         self.params = params
@@ -502,6 +505,16 @@ class ServingEngine:
         # SIGTERM'd replica's metrics survive the process — the fleet
         # aggregates them from disk after the pod is gone.
         self._metrics = MetricsLogger(metrics_path) if metrics_path else None
+        # Optional lifecycle tracer (docs/observability.md). None is the
+        # default and costs ONE pointer comparison per instrumentation
+        # site — the hot loops take no extra clock reads and greedy
+        # outputs are bit-identical to an un-instrumented engine
+        # (asserted by benchmarks/obs_bench.py and tests/test_obs.py).
+        # When set, the tracer and the engine MUST share a clock so the
+        # retrospective request-lifecycle spans (stamped from the
+        # engine's own submit_t/admit_t/done_t readings) line up with
+        # the live engine-level spans in the exported timeline.
+        self._tracer = tracer
 
         self.cache = gen.init_paged_cache(
             cfg, n_slots, self._max_blocks, self._kv_pool_blocks,
@@ -777,6 +790,11 @@ class ServingEngine:
         self.stats.submitted += 1
         if len(self.queue) > self.stats.queue_depth_max:
             self.stats.queue_depth_max = len(self.queue)
+        if self._tracer is not None:
+            self._tracer.add_event(
+                "submit", now, rid=str(req.rid),
+                prompt_tokens=int(prompt.size),
+                max_new=int(req.max_new_tokens))
 
     def cancel(self, rid: int) -> bool:
         """Cancel a request by rid. A queued request is removed outright
@@ -803,10 +821,24 @@ class ServingEngine:
                 return True
         return False                      # retired between bookkeeping
 
+    def _record_completion(self, comp: Completion) -> None:
+        """The ONE funnel every Completion passes through — natural
+        retirement, policy retirement, queue sheds, and drain all end
+        here, so the stats and the trace agree by construction: exactly
+        one terminal ``retire`` span per submitted rid, whose
+        finish_reason matches the Completion (the span-conservation
+        gate in benchmarks/obs_bench.py)."""
+        self.stats.record(comp)
+        if self._tracer is not None:
+            self._tracer.add_event(
+                "retire", comp.done_t, rid=str(comp.rid),
+                finish_reason=comp.finish_reason,
+                n_tokens=len(comp.tokens))
+
     def _finish_completion(self, comp: Completion) -> None:
         """Record a policy-retirement completion and buffer it for the
         next step()'s return."""
-        self.stats.record(comp)
+        self._record_completion(comp)
         self._done_buf.append(comp)
 
     def _release_pins(self, slot: _Slot) -> None:
@@ -837,9 +869,12 @@ class ServingEngine:
         push."""
         if not self._tables_dirty:
             return
+        t0 = self._clock() if self._tracer is not None else 0.0
         self.cache = self.cache._replace(
             tables=self._replicate(jnp.asarray(self._tables.copy())))
         self._tables_dirty = False
+        if self._tracer is not None:
+            self._tracer.add_span("push_tables", t0, self._clock())
 
     def _view_width(self) -> int:
         """Gather width the next dispatch needs: the max page span any
@@ -931,7 +966,7 @@ class ServingEngine:
         self._rids.discard(slot.req.rid)
         self.cache = self.cache._replace(
             active=self.cache.active.at[i].set(False))
-        self.stats.record(comp)
+        self._record_completion(comp)
         return comp
 
     def _retire_due(self) -> List[Completion]:
@@ -1104,6 +1139,7 @@ class ServingEngine:
             if self.prefill_mode == "exact":
                 self._push_tables()
                 admit = self._admit_fn(req.prompt.size)
+                t_p0 = self._clock() if self._tracer is not None else 0.0
                 (self.cache, self.logits, self.eos, self.budget,
                  self.emitted) = admit(
                     self.params, jnp.asarray(req.prompt[None]),
@@ -1115,6 +1151,14 @@ class ServingEngine:
                         jnp.int32),
                     jnp.asarray(req.max_new_tokens, jnp.int32),
                 )
+                if self._tracer is not None:
+                    # Exact mode prefills the whole prompt in one shot;
+                    # record it as a single final chunk so the span
+                    # taxonomy is uniform across prefill modes.
+                    self._tracer.add_span(
+                        "prefill_chunk", t_p0, self._clock(),
+                        rid=str(req.rid), offset=0,
+                        width=int(req.prompt.size), final=True)
                 self.slots[slot] = _Slot(
                     req=req, submit_t=q.submit_t, admit_t=now,
                     deadline_t=q.deadline_t, spec_k=self.draft_k,
@@ -1133,7 +1177,13 @@ class ServingEngine:
                     ),
                 )
             self.stats.admitted += 1
-            self.stats.queue_waits_s.append(now - q.submit_t)
+            self.stats.record_queue_wait(now - q.submit_t)
+            if self._tracer is not None:
+                r = str(req.rid)
+                self._tracer.add_span("queue_wait", q.submit_t, now, rid=r)
+                self._tracer.add_event(
+                    "admit", now, rid=r, slot=slot,
+                    prefix_hit=int(matched), pages_reserved=int(needed))
 
     def _advance_prefills(self) -> None:
         """Run ONE prefill chunk for every slot mid-admission (Sarathi-
@@ -1163,6 +1213,7 @@ class ServingEngine:
             buf[0, :w_real] = tokens[off:off + w_real]
             fn = self._chunk_fn(w)
             self._push_tables()
+            t0 = self._clock() if self._tracer is not None else 0.0
             (self.cache, self.logits, self.eos, self.budget,
              self.emitted) = fn(
                 self.params, jnp.asarray(buf), self.cache, self.logits,
@@ -1174,6 +1225,14 @@ class ServingEngine:
                 jnp.asarray(p.budget_val, jnp.int32),
                 jnp.asarray(final),
             )
+            if self._tracer is not None:
+                # Dispatch time, not device time: the chunk call is
+                # async — what the span shows is the host cost of
+                # scheduling this prefill chunk in the quantum.
+                self._tracer.add_span(
+                    "prefill_chunk", t0, self._clock(),
+                    rid=str(slot.req.rid), offset=int(off),
+                    width=int(w), final=bool(final))
             self.stats.prefill_chunks += 1
             p.next_off = off + w_real
             if final:
@@ -1241,6 +1300,8 @@ class ServingEngine:
         """
         if self.spec_decode:
             return self._step_spec()
+        tr = self._tracer
+        t_q0 = self._clock() if tr is not None else 0.0
         finished: List[Completion] = list(self._done_buf)
         self._done_buf.clear()
         finished.extend(self._retire_due())
@@ -1260,16 +1321,23 @@ class ServingEngine:
                 self._step_idx += 1
                 key = jax.random.fold_in(self._rng, self._step_idx)
             self._push_tables()
+            t_d0 = self._clock() if tr is not None else 0.0
             toks, next_tok, self.logits, self.cache, self.emitted = (
                 self._step_fn(
                     self.params, self.logits, self.cache, self.eos,
                     self.budget, self.emitted, key))
+            if tr is not None:
+                tr.add_span("dispatch", t_d0, self._clock(),
+                            slots=n_decoding)
             dispatched = (toks, next_tok, snapshot, n_decoding)
 
         finished.extend(self._process_pending())
         self._pending = dispatched
         self._admit_waiting()
         self._advance_prefills()
+        if tr is not None:
+            tr.add_span("decode_quantum", t_q0, self._clock(),
+                        slots=n_decoding, finished=len(finished))
         self._sync_stats()
         return finished
 
@@ -1286,6 +1354,8 @@ class ServingEngine:
         clears the row's ``active`` bit before dispatch, the verifier
         commits nothing on inactive rows (``n = 0``), and neighbors'
         committed streams are untouched (row-independent math)."""
+        tr = self._tracer
+        t_q0 = self._clock() if tr is not None else 0.0
         finished: List[Completion] = list(self._done_buf)
         self._done_buf.clear()
         finished.extend(self._retire_due())
@@ -1329,16 +1399,24 @@ class ServingEngine:
                     if s is not None and self._spec_cooldown[i] > 0:
                         self._spec_cooldown[i] -= 1
                 self._push_tables()
+                t_d0 = self._clock() if tr is not None else 0.0
                 toks, next_tok, self.logits, self.cache, self.emitted = (
                     self._step_fn(
                         self.params, self.logits, self.cache, self.eos,
                         self.budget, self.emitted, None))
+                if tr is not None:
+                    tr.add_span("dispatch", t_d0, self._clock(),
+                                slots=sum(s is not None
+                                          for s in snapshot_p))
                 dispatched = (toks, next_tok, snapshot_p,
                               sum(s is not None for s in snapshot_p))
             finished.extend(self._process_pending())
             self._pending = dispatched
             self._admit_waiting()
             self._advance_prefills()
+            if tr is not None:
+                tr.add_span("decode_quantum", t_q0, self._clock(),
+                            spec=False, finished=len(finished))
             self._sync_stats()
             return finished
         finished.extend(self._process_pending())
@@ -1349,10 +1427,15 @@ class ServingEngine:
         n_decoding = sum(s is not None for s in snapshot)
         if n_decoding > 0:
             self.stats.spec_probe_steps += 1
+            t_p0 = self._clock() if tr is not None else 0.0
             proposal = self._propose_drafts(snapshot)
+            if tr is not None:
+                tr.add_span("spec_probe", t_p0, self._clock(),
+                            drafted=proposal is not None)
             self._push_tables()
             if proposal is not None:
                 draft, dlen = proposal
+                t_v0 = self._clock() if tr is not None else 0.0
                 window, n, next_tok, self.logits, self.cache, \
                     self.emitted = self._spec_fn(
                         self.params, self.logits, self.cache, self.eos,
@@ -1363,6 +1446,9 @@ class ServingEngine:
                 # extra device_get round-trip lands on the critical path.
                 window_np, n_np, next_np = jax.device_get(
                     (window, n, next_tok))
+                if tr is not None:
+                    tr.add_span("spec_verify", t_v0, self._clock(),
+                                draft_tokens=int(np.sum(dlen)))
                 finished.extend(self._book_spec(
                     snapshot, np.asarray(window_np), np.asarray(n_np),
                     np.asarray(next_np), dlen))
@@ -1377,6 +1463,9 @@ class ServingEngine:
                 self._pending = (toks, next_tok, snapshot, n_decoding)
         self._admit_waiting()
         self._advance_prefills()
+        if tr is not None:
+            tr.add_span("decode_quantum", t_q0, self._clock(),
+                        spec=True, finished=len(finished))
         self._sync_stats()
         return finished
 
@@ -1545,7 +1634,7 @@ class ServingEngine:
             else:
                 slot.next_tok = int(next_tok[i])
         for c in finished:
-            self.stats.record(c)
+            self._record_completion(c)
         return finished
 
     def _sync_stats(self) -> None:
@@ -1569,6 +1658,17 @@ class ServingEngine:
             self.pool.n_blocks * self.block_size
             * kv_blocks.kv_bytes_per_token(self.cfg, self.kv_quant,
                                            self.tp) / (1 << 20))
+        if self._tracer is not None:
+            self.stats.spans_recorded = self._tracer.spans_recorded
+            self.stats.spans_dropped = self._tracer.spans_dropped
+        # Publish the live gauges to the process registry too, so
+        # cross-subsystem consumers (fleet benches, autoscalers) read
+        # one snapshot instead of reaching into engine internals.
+        reg = registry()
+        reg.gauge("queue_depth", "serving").set(len(self.queue))
+        reg.gauge("pool_blocks_in_use", "serving").set(
+            self.pool.used_blocks)
+        reg.gauge("active_slots", "serving").set(self.n_active)
 
     def _book_token(self, i: int, slot: _Slot, tok: int,
                     now: float) -> Optional[Completion]:
@@ -1641,6 +1741,7 @@ class ServingEngine:
             return []
         toks_dev, next_dev, snapshot, _ = self._pending
         self._pending = None
+        t_g0 = self._clock() if self._tracer is not None else 0.0
         if self.spec_decode:
             # One transfer for both: this fetch blocks on the chunk, so
             # a second round-trip would land on the critical path.
@@ -1650,6 +1751,11 @@ class ServingEngine:
         else:
             toks_np = np.asarray(jax.device_get(toks_dev))   # [chunk, B]
             next_np = None
+        if self._tracer is not None:
+            # This fetch blocks on the previous dispatch, so its span IS
+            # the visible device time of that chunk.
+            self._tracer.add_span("device_get", t_g0, self._clock(),
+                                  chunk=int(toks_np.shape[0]))
         now = self._clock()
         self.stats.steps += toks_np.shape[0]
 
@@ -1667,7 +1773,7 @@ class ServingEngine:
                 slot.next_tok = int(next_np[i])
 
         for c in finished:
-            self.stats.record(c)
+            self._record_completion(c)
         return finished
 
     def drain(self, grace_s: float = 5.0) -> List[Completion]:
@@ -1694,7 +1800,7 @@ class ServingEngine:
                 rid=q.req.rid, tokens=[], finish_reason="shed",
                 submit_t=q.submit_t, first_token_t=None, done_t=now,
             )
-            self.stats.record(comp)
+            self._record_completion(comp)
             out.append(comp)
         deadline = now + grace_s
         while not self.idle and self._clock() < deadline:
@@ -1715,13 +1821,24 @@ class ServingEngine:
         # a replica does before the pod dies, and a buffered line lost
         # to SIGKILL is a request the fleet can't account for.
         self._sync_stats()
+        self._flush_observability(drained=1.0)
+        return out
+
+    def _flush_observability(self, **extra: float) -> None:
+        """Flush the metrics JSONL (with ``extra`` marker scalars) and
+        the trace buffer. Idempotent — the logger closes on first
+        flush, the tracer rewrites its file whole — and called from
+        EVERY exit path: drain (SIGTERM included), run() overrun
+        (DrainError), and serve_lm's finally. An exit that skipped this
+        would lose the run's postmortem record exactly when it matters."""
         if self._metrics is not None:
             scalars = self.stats.summary()
-            scalars["drained"] = 1.0
+            scalars.update(extra)
             self._metrics.write(self.stats.steps, scalars)
             self._metrics.close()
             self._metrics = None
-        return out
+        if self._tracer is not None:
+            self._tracer.flush()
 
     def run(
         self, requests: Sequence[Request], max_steps: int = 0,
@@ -1759,6 +1876,11 @@ class ServingEngine:
             if self.idle:
                 break
         if not self.idle:
+            # The overrun is an exit path too: flush the stats summary
+            # (tagged drain_error=1.0) and the trace before unwinding,
+            # or the run that most needs a postmortem leaves none.
+            self._sync_stats()
+            self._flush_observability(drain_error=1.0)
             raise DrainError(
                 f"engine did not drain in {max_steps} steps "
                 f"({self.n_active} active, {len(self.queue)} queued)",

@@ -1,0 +1,25 @@
+"""Cross-plane observability: lifecycle tracing + unified telemetry.
+
+Two small, dependency-free modules (docs/observability.md):
+
+* :mod:`~kubeflow_controller_tpu.obs.trace` — a low-overhead span
+  recorder (monotonic clock, parent links, bounded ring buffer,
+  thread-safe) with a Chrome-trace-event JSON exporter, so any serving
+  or control-plane run can be opened in Perfetto / ``chrome://tracing``.
+* :mod:`~kubeflow_controller_tpu.obs.telemetry` — a process-wide
+  metrics registry (Counter / Gauge / Histogram with fixed pow2
+  buckets, keyed by subsystem) plus the capped deterministic
+  :class:`Reservoir` that backs ``ServingStats`` percentile samples.
+
+Every producer takes ``tracer=None`` by default: a ``None`` tracer
+costs one pointer comparison per instrumentation site — the hot paths
+stay bit-identical and within noise of the un-instrumented build
+(gated by ``make bench-obs``).
+"""
+
+from kubeflow_controller_tpu.obs.trace import (  # noqa: F401
+    Span, Tracer, load_chrome_trace,
+)
+from kubeflow_controller_tpu.obs.telemetry import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, Reservoir, registry,
+)

@@ -107,13 +107,17 @@ class LocalRuntime:
         self,
         default_policy: Optional[PodRunPolicy] = None,
         resync_period: float = 0.0,
+        tracer=None,
     ):
         self.cluster = FakeCluster(default_policy=default_policy)
         self.client = FakeClusterClient(self.cluster)
         # Everything (stores, controller, scheduler) runs on the cluster's
         # simulated clock; threaded mode advances it from a wall-clock ticker.
+        # ``tracer`` (obs.Tracer) records control-plane spans — queue
+        # wait, per-key sync, requeue events; None = no overhead.
         self._opts = ControllerOptions(
-            now_fn=lambda: self.cluster.now, resync_period=resync_period
+            now_fn=lambda: self.cluster.now, resync_period=resync_period,
+            tracer=tracer,
         )
         self._wire()
         self._ticker: Optional[threading.Thread] = None

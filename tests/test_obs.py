@@ -1,0 +1,437 @@
+"""Observability stack: tracer, metrics registry, reservoirs, and the
+instrumented planes.
+
+Four layers, each pinned:
+
+1. **Primitives** — pow2 histogram bucket boundaries are exact binary
+   edges; registry labels isolate; snapshots are deterministic under
+   seeded concurrent writers; the reservoir is exact below cap and a
+   counted sliding window above it.
+2. **Tracer** — bounded ring drops oldest + counts drops; context
+   manager nesting links parents (even when a child closes first);
+   export is valid Chrome trace JSON; flush is idempotent.
+3. **Data plane** — a ``tracer=None`` engine is bit-identical to a
+   traced one (tracing must observe, never perturb); every submitted
+   rid yields exactly one terminal retire event whose finish_reason
+   matches the Completion; DrainError and drain both flush the
+   metrics JSONL and the trace file.
+4. **Control plane** — ``LocalRuntime(tracer=...)`` records per-key
+   sync spans (with outcome + noop tags) and workqueue queue_wait
+   spans on the ``control`` track.
+"""
+
+import json
+import math
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_controller_tpu.dataplane import metrics as metrics_mod
+from kubeflow_controller_tpu.dataplane.serving_engine import (
+    DrainError, Request, ServingEngine,
+)
+from kubeflow_controller_tpu.models import generate as gen
+from kubeflow_controller_tpu.models import transformer as tfm
+from kubeflow_controller_tpu.obs.telemetry import (
+    Histogram, MetricsRegistry, Reservoir, registry, reset_registry,
+)
+from kubeflow_controller_tpu.obs.trace import Tracer, load_chrome_trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    reset_registry()
+    yield
+    reset_registry()
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tfm.tiny_config()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return gen.inference_params(cfg, tfm.init_params(cfg, jax.random.key(0)))
+
+
+def _requests(cfg, n=6, seed=3):
+    rng = np.random.default_rng(seed)
+    return [
+        Request(rid=i,
+                prompt=rng.integers(0, cfg.vocab_size,
+                                    4 + int(rng.integers(0, 5))).astype(
+                                        np.int32),
+                max_new_tokens=3 + int(rng.integers(0, 5)))
+        for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Telemetry primitives
+
+
+class TestHistogram:
+    def test_bucket_boundaries_are_exact_binary_edges(self):
+        h = Histogram("lat_s", lo_exp=-4, hi_exp=4)
+        # Bucket for exponent e covers (2**(e-1), 2**e]: exact powers
+        # of two land in their own bucket, the next float up moves on.
+        assert h.bucket_index(1.0) == 0 - h.lo_exp
+        assert h.bucket_index(1.0000001) == 1 - h.lo_exp
+        assert h.bucket_index(2.0) == 1 - h.lo_exp
+        assert h.bucket_index(2.1) == 2 - h.lo_exp
+        assert h.bucket_index(0.5) == -1 - h.lo_exp
+        assert h.bucket_index(0.25) == -2 - h.lo_exp
+
+    def test_clamping_underflow_overflow_nonfinite(self):
+        h = Histogram("lat_s", lo_exp=-4, hi_exp=4)
+        assert h.bucket_index(2.0 ** -10) == 0          # underflow clamp
+        assert h.bucket_index(0.0) == 0
+        assert h.bucket_index(-1.0) == 0
+        last = len(h._buckets) - 1
+        assert h.bucket_index(2.0 ** 10) == last        # overflow bucket
+        assert h.bucket_index(math.inf) == last
+        # 2**hi_exp itself is still in range; the next bucket up is not.
+        assert h.bucket_index(2.0 ** 4) == 4 - h.lo_exp
+        assert h.bucket_index(2.0 ** 4 + 1) == last
+
+    def test_snapshot_fields(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat_s", "serving", lo_exp=-2, hi_exp=2)
+        for v in (0.3, 1.0, 1.5, 3.0, 100.0):
+            h.observe(v)
+        snap = r.snapshot()
+        assert snap["serving.lat_s.count"] == 5.0
+        assert snap["serving.lat_s.sum"] == pytest.approx(105.8)
+        assert snap["serving.lat_s.min"] == 0.3
+        assert snap["serving.lat_s.max"] == 100.0
+        assert snap["serving.lat_s.bucket_le_2e-1"] == 1.0   # 0.3
+        assert snap["serving.lat_s.bucket_le_2e0"] == 1.0    # 1.0
+        assert snap["serving.lat_s.bucket_le_2e1"] == 1.0    # 1.5
+        assert snap["serving.lat_s.bucket_le_2e2"] == 1.0    # 3.0
+        assert snap["serving.lat_s.bucket_overflow"] == 1.0  # 100.0
+
+
+class TestRegistry:
+    def test_label_isolation_and_get_or_create(self):
+        r = MetricsRegistry()
+        a = r.counter("requests", "serving")
+        b = r.counter("requests", "router")
+        assert a is not b
+        a.inc(3)
+        assert r.counter("requests", "serving") is a    # get-or-create
+        snap = r.snapshot()
+        assert snap["serving.requests"] == 3.0
+        assert snap["router.requests"] == 0.0
+
+    def test_kind_mismatch_raises(self):
+        r = MetricsRegistry()
+        r.counter("x", "s")
+        with pytest.raises(TypeError):
+            r.gauge("x", "s")
+
+    def test_negative_counter_increment_rejected(self):
+        r = MetricsRegistry()
+        with pytest.raises(ValueError):
+            r.counter("x").inc(-1)
+
+    def test_snapshot_deterministic_under_concurrent_writers(self):
+        r = MetricsRegistry()
+        n_threads, n_ops = 8, 500
+        seeds = list(range(n_threads))
+
+        def work(seed):
+            rng = np.random.default_rng(seed)
+            c = r.counter("ops", "serving")
+            h = r.histogram("v", "serving", lo_exp=-2, hi_exp=8)
+            g = r.gauge("last", "serving")
+            for _ in range(n_ops):
+                c.inc()
+                h.observe(float(rng.uniform(0.1, 100.0)))
+                g.set(float(seed))
+
+        threads = [threading.Thread(target=work, args=(s,)) for s in seeds]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = r.snapshot()
+        assert snap["serving.ops"] == float(n_threads * n_ops)
+        assert snap["serving.v.count"] == float(n_threads * n_ops)
+        # histogram bucket totals conserve every observation
+        buckets = sum(v for k, v in snap.items()
+                      if k.startswith("serving.v.bucket"))
+        assert buckets == float(n_threads * n_ops)
+        # snapshot is stable and key-sorted
+        assert snap == r.snapshot()
+        assert list(snap) == sorted(snap)
+
+
+class TestReservoir:
+    def test_exact_below_cap(self):
+        r = Reservoir(cap=8)
+        r.extend([3.0, 1.0, 2.0])
+        assert list(r) == [3.0, 1.0, 2.0]
+        assert len(r) == 3 and r.total == 3 and r.dropped == 0
+        assert r[1] == 1.0 and r[-1] == 2.0
+
+    def test_sliding_window_above_cap(self):
+        r = Reservoir(cap=4)
+        r.extend(range(1, 7))                    # 1..6
+        assert list(r) == [3.0, 4.0, 5.0, 6.0]
+        assert r.total == 6 and r.dropped == 2
+
+    def test_since_survives_eviction(self):
+        r = Reservoir(cap=4)
+        r.extend(range(10))
+        seen = r.total
+        assert r.since(seen) == []
+        r.extend([10.0, 11.0])
+        assert r.since(seen) == [10.0, 11.0]
+        # a window that starts inside the evicted prefix returns only
+        # what is still retained — no replay, no skip
+        assert r.since(0) == list(r)
+
+    def test_clear_and_bool(self):
+        r = Reservoir(cap=2, items=[1.0, 2.0, 3.0])
+        assert r and r.dropped == 1
+        r.clear()
+        assert not r and r.total == 0 and r.dropped == 0
+
+    def test_bad_cap_rejected(self):
+        with pytest.raises(ValueError):
+            Reservoir(cap=0)
+
+
+class TestMetricsLoggerNonFinite:
+    def test_inf_nan_clamped_to_null(self, tmp_path):
+        """Regression: ``v == v`` only filtered NaN — json.dumps then
+        emitted bare ``Infinity``, which no strict parser accepts."""
+        path = tmp_path / "m.jsonl"
+        ml = metrics_mod.MetricsLogger(str(path))
+        ml.write(0, {"ok": 1.5, "up": math.inf, "down": -math.inf,
+                     "bad": math.nan})
+        ml.close()
+        line = path.read_text().strip()
+        rec = json.loads(line)                   # strict: would reject Infinity
+        assert rec["ok"] == 1.5
+        assert rec["up"] is None
+        assert rec["down"] is None
+        assert rec["bad"] is None
+        for token in ("Infinity", "NaN"):
+            assert token not in line
+
+
+# ---------------------------------------------------------------------------
+# Tracer
+
+
+class TestTracer:
+    def test_ring_bounds_and_drop_counters(self):
+        tr = Tracer(capacity=4, clock=lambda: 0.0)
+        for i in range(6):
+            tr.add_span(f"s{i}", 0.0, 1.0)
+        assert tr.spans_recorded == 6
+        assert tr.spans_dropped == 2
+        spans = tr.snapshot()
+        assert len(spans) == 4
+        assert [s.name for s in spans] == ["s2", "s3", "s4", "s5"]
+
+    def test_ctx_manager_parent_links(self):
+        tr = Tracer()
+        with tr.span("outer", rid="k") as outer:
+            with tr.span("inner", rid="k") as inner:
+                inner.set(n=1)
+        spans = {s.name: s for s in tr.snapshot()}
+        # inner closes first but still links to the (reserved) outer sid
+        assert spans["inner"].parent == spans["outer"].sid
+        assert spans["outer"].parent is None
+        assert dict(spans["inner"].attrs)["n"] == 1
+
+    def test_ctx_manager_error_attr(self):
+        tr = Tracer()
+        with pytest.raises(RuntimeError):
+            with tr.span("boom"):
+                raise RuntimeError("x")
+        (s,) = tr.snapshot()
+        assert dict(s.attrs).get("error")
+
+    def test_export_valid_chrome_json(self, tmp_path):
+        tr = Tracer(clock=lambda: 0.0, path=str(tmp_path / "t.json"))
+        tr.add_span("work", 0.0, 0.5, rid="7", track="dataplane", k=1)
+        tr.add_event("mark", 0.25, rid="7", track="router")
+        tr.flush()
+        doc = load_chrome_trace(tr.path)         # raises on any violation
+        evs = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+        x = next(e for e in evs if e["ph"] == "X")
+        i = next(e for e in evs if e["ph"] == "i")
+        assert x["name"] == "work" and x["dur"] == pytest.approx(5e5)
+        assert x["args"]["rid"] == "7" and x["args"]["k"] == 1
+        assert i["s"] == "t"
+        procs = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert {"dataplane", "router"} <= procs
+
+    def test_flush_idempotent_and_pathless(self, tmp_path):
+        assert Tracer().flush() is None          # no path: no-op
+        tr = Tracer(clock=lambda: 0.0, path=str(tmp_path / "t.json"))
+        tr.add_span("a", 0.0, 1.0)
+        tr.flush()
+        tr.add_span("b", 1.0, 2.0)
+        tr.flush()                               # whole-file rewrite
+        doc = load_chrome_trace(tr.path)
+        names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert names == ["a", "b"]
+
+    def test_bad_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            Tracer(capacity=0)
+
+
+# ---------------------------------------------------------------------------
+# Data plane integration
+
+
+ENGINE_KW = dict(n_slots=3, max_seq=32)
+
+
+class TestEngineTracing:
+    def test_noop_tracer_bit_identity(self, cfg, params):
+        """Tracing must OBSERVE the engine, never steer it: greedy
+        streams with and without a tracer are bit-identical."""
+        plain = ServingEngine(cfg, params, **ENGINE_KW)
+        traced = ServingEngine(cfg, params, tracer=Tracer(), **ENGINE_KW)
+        a = {c.rid: list(c.tokens) for c in plain.run(_requests(cfg))}
+        b = {c.rid: list(c.tokens) for c in traced.run(_requests(cfg))}
+        assert a == b
+
+    def test_span_conservation_and_linkage(self, cfg, params, tmp_path):
+        tr = Tracer(path=str(tmp_path / "t.json"))
+        eng = ServingEngine(cfg, params, tracer=tr, **ENGINE_KW)
+        comps = eng.run(_requests(cfg))
+        tr.flush()
+        doc = load_chrome_trace(tr.path)
+        by_name = {}
+        for ev in doc["traceEvents"]:
+            if ev["ph"] == "M":
+                continue
+            by_name.setdefault(ev["name"], []).append(ev)
+        want = {str(c.rid): c.finish_reason for c in comps}
+        # exactly one terminal retire per submitted rid, reasons agree
+        submits = {e["args"]["rid"] for e in by_name["submit"]}
+        retires = [e["args"] for e in by_name["retire"]]
+        assert submits == set(want)
+        assert len(retires) == len(want)
+        for args in retires:
+            assert args["finish_reason"] == want[args["rid"]]
+        # every request has the full causal chain
+        for name in ("queue_wait", "admit", "prefill_chunk"):
+            assert {e["args"]["rid"] for e in by_name[name]} == set(want)
+        assert by_name["decode_quantum"]         # engine-level spans
+        assert by_name["dispatch"]
+        # stats mirror the tracer's counters after the run
+        assert eng.stats.spans_recorded == tr.spans_recorded
+        assert eng.stats.spans_dropped == tr.spans_dropped
+
+    def test_drain_error_flushes_metrics_and_trace(self, cfg, params,
+                                                   tmp_path):
+        """The overrun exit path is exactly when the postmortem record
+        matters: DrainError must leave a parseable trace file and a
+        metrics line tagged drain_error."""
+        mpath = tmp_path / "m.jsonl"
+        tr = Tracer(path=str(tmp_path / "t.json"))
+        eng = ServingEngine(cfg, params, tracer=tr,
+                            metrics_path=str(mpath), **ENGINE_KW)
+        with pytest.raises(DrainError):
+            eng.run(_requests(cfg), max_steps=2)
+        recs = [json.loads(l) for l in mpath.read_text().splitlines()]
+        assert recs[-1]["drain_error"] == 1.0
+        load_chrome_trace(tr.path)               # valid despite the abort
+        assert any(s.name == "submit" for s in tr.snapshot())
+
+    def test_drain_flushes_metrics_and_trace(self, cfg, params, tmp_path):
+        mpath = tmp_path / "m.jsonl"
+        tr = Tracer(path=str(tmp_path / "t.json"))
+        eng = ServingEngine(cfg, params, tracer=tr,
+                            metrics_path=str(mpath), **ENGINE_KW)
+        for r in _requests(cfg, n=2):
+            eng.submit(r)
+        comps = eng.drain(grace_s=30.0)
+        assert comps
+        recs = [json.loads(l) for l in mpath.read_text().splitlines()]
+        assert recs[-1]["drained"] == 1.0
+        doc = load_chrome_trace(tr.path)
+        assert any(e["name"] == "retire" for e in doc["traceEvents"]
+                   if e["ph"] != "M")
+
+    def test_serving_stats_reservoirs_bounded(self):
+        stats = metrics_mod.ServingStats()
+        cap = metrics_mod.SAMPLE_CAP
+        for i in range(cap + 100):
+            stats.ttfts_s.append(float(i))
+        assert len(stats.ttfts_s) == cap
+        assert stats.samples_dropped == 100
+        assert stats.summary()["samples_dropped"] == 100
+        # percentiles read the retained window, newest-cap samples
+        assert metrics_mod.percentile(stats.ttfts_s, 100) == float(
+            cap + 99)
+
+    def test_registry_feeds_from_engine_stats(self):
+        stats = metrics_mod.ServingStats()
+        from kubeflow_controller_tpu.dataplane.serving_engine import (
+            Completion,
+        )
+        stats.record(Completion(rid=1, tokens=[1, 2], finish_reason="eos",
+                                submit_t=0.0, first_token_t=0.5,
+                                done_t=1.0, admit_t=0.1))
+        snap = registry().snapshot()
+        assert snap["serving.requests_finished"] == 1.0
+        assert snap["serving.finish_eos"] == 1.0
+        assert snap["serving.ttft_s.count"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Control plane integration
+
+
+class TestControllerTracing:
+    def test_sync_and_queue_wait_spans(self):
+        from kubeflow_controller_tpu.api.core import (
+            Container, ObjectMeta, PodSpec, PodTemplateSpec,
+        )
+        from kubeflow_controller_tpu.api.types import (
+            ReplicaSpec, ReplicaType, TPUJob, TPUJobSpec, TPUSliceSpec,
+        )
+        from kubeflow_controller_tpu.runtime import LocalRuntime
+
+        tr = Tracer()
+        rt = LocalRuntime(tracer=tr)
+        rt.submit(TPUJob(
+            metadata=ObjectMeta(name="job", namespace="default"),
+            spec=TPUJobSpec(replica_specs=[ReplicaSpec(
+                replica_type=ReplicaType.WORKER,
+                template=PodTemplateSpec(spec=PodSpec(containers=[
+                    Container(name="t", image="jax:latest")])),
+                tpu=TPUSliceSpec(accelerator_type="v5p-8", num_slices=1),
+            )])))
+        rt.step(steps=5)
+        # The noop fast path fires on a REPEAT sync of a steady job
+        # (fingerprint unchanged since the last fully-steady pass);
+        # with resync_period=0 nothing re-enqueues the key, so poke it
+        # the way a resync would.
+        for _ in range(3):
+            rt.controller.queue.add("default/job")
+            rt.controller.drain()
+        spans = tr.snapshot()
+        syncs = [s for s in spans if s.name == "sync"]
+        waits = [s for s in spans if s.name == "queue_wait"]
+        assert syncs and waits
+        assert all(s.track == "control" for s in syncs + waits)
+        assert any(s.rid == "default/job" for s in syncs)
+        outcomes = {dict(s.attrs).get("outcome") for s in syncs}
+        assert outcomes - {None}, "sync spans must carry an outcome"
+        # resyncs of an unchanged job tag themselves noop
+        assert any(dict(s.attrs).get("noop") for s in syncs)
+        assert registry().snapshot()["control.syncs"] >= len(syncs)

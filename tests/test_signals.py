@@ -53,17 +53,19 @@ def test_serve_lm_sigterm_drains_partials_and_flushes_metrics(tmp_path):
     """ISSUE 4 acceptance: SIGTERM mid-decode -> the serve_lm entrypoint
     (wired to setup_signal_handler's stop event) drains the engine,
     exits 0, writes PARTIAL completions tagged with finish reasons, and
-    still flushes the metrics JSONL."""
+    still flushes the metrics JSONL AND the lifecycle trace — the
+    interrupted run is exactly the one whose postmortem matters."""
     import json
 
     out = tmp_path / "completions.jsonl"
     logdir = tmp_path / "logs"
+    trace = tmp_path / "trace.json"
     p = subprocess.Popen(
         [sys.executable, "-m",
          "kubeflow_controller_tpu.dataplane.entrypoints.serve_lm",
          "--config", "tiny", "--batch", "2", "--prompt-len", "4",
          "--max-new-tokens", "2048", "--output", str(out),
-         "--drain-grace-s", "0.5"],
+         "--drain-grace-s", "0.5", "--trace", str(trace)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env={**os.environ, "JAX_PLATFORMS": "cpu",
              "TPUJOB_LOG_DIR": str(logdir)},
@@ -100,6 +102,16 @@ def test_serve_lm_sigterm_drains_partials_and_flushes_metrics(tmp_path):
     rec = json.loads(mfile.read_text().strip().splitlines()[-1])
     assert rec["interrupted"] == 1.0
     assert rec["tokens_out"] > 0
+    # the trace survived the SIGTERM drain: parseable Chrome JSON with
+    # the drained requests' terminal retire events in it
+    from kubeflow_controller_tpu.obs.trace import load_chrome_trace
+    doc = load_chrome_trace(str(trace))
+    reasons = [e["args"]["finish_reason"] for e in doc["traceEvents"]
+               if e.get("ph") != "M" and e["name"] == "retire"]
+    assert len(reasons) == len(rows)
+    assert reasons and all(
+        r in ("eos", "length", "deadline", "cancelled", "shed")
+        for r in reasons)
 
 
 def test_serve_daemon_sigterm_clean_shutdown(tmp_path):
