@@ -3,9 +3,9 @@
 
 PY ?= python
 
-.PHONY: all native test test-fast test-tp test-obs bench bench-cp \
-	bench-serve bench-overload bench-prefix bench-fleet bench-spec \
-	bench-paged bench-tp bench-obs clean stamp
+.PHONY: all native test test-fast test-tp test-obs test-sampling bench \
+	bench-cp bench-serve bench-overload bench-prefix bench-fleet \
+	bench-spec bench-paged bench-tp bench-obs bench-sampling clean stamp
 
 # Build-stamp analog of the reference's ldflags version injection
 # (/root/reference/Makefile:23-26): export the sha for build_version().
@@ -36,6 +36,13 @@ test-obs:
 test-tp:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tp_serving.py -q
+
+# Sampling-subsystem guard: fixed-seed bit-reproducibility across batch
+# composition / churn / tp, copy-on-write fork sharing + leak freedom,
+# and constrained-decoding mask invariants (docs/serving.md "Sampling").
+test-sampling:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_sampling.py -q
 
 bench:
 	$(PY) bench.py
@@ -123,6 +130,15 @@ bench-tp:
 bench-obs:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/obs_bench.py \
 		--json benchmarks/obs_bench_summary.json
+
+# Sampling benchmark: n=4 COW fork vs four independent singles at equal
+# HBM (reproducibility + fork transparency asserted before timing;
+# gated >= 2x aggregate tokens/sec) and greedy TPOT p50 vs the
+# no-sampling twin (gated <= 5% regression) — see benchmarks/RESULTS.md
+# and docs/serving.md.
+bench-sampling:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/sampling_bench.py \
+		--json benchmarks/sampling_bench_summary.json
 
 clean:
 	$(MAKE) -C csrc clean

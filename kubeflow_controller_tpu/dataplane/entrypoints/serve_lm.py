@@ -29,9 +29,18 @@ docs/serving.md "Overload & shutdown semantics".
 
 ``--speculative`` turns on speculative decoding (``--draft-k``,
 ``--proposer {prompt,radix}``): model-free drafts verified in one fused
-forward per step, bit-identical greedy outputs, acceptance stats
+forward per step, bit-identical greedy outputs (sampled requests verify
+via the speculative-sampling acceptance rule), acceptance stats
 (``draft_proposed``/``draft_accepted``/``acceptance_rate``) in the same
 metrics JSONL summary — docs/serving.md "Speculative decoding".
+
+``--temperature/--top-k/--top-p/--seed`` select reproducible sampled
+decoding (fixed seed => bit-identical streams regardless of batch
+composition); ``--n`` asks for that many parallel generations per
+prompt, prefilled once and forked copy-on-write over shared KV pages;
+``--grammar {json,re:<pat>,set:<ids>}`` constrains every emitted token
+so the output always parses — docs/serving.md "Sampling, parallel
+generations, and constrained decoding".
 """
 
 from __future__ import annotations
@@ -132,7 +141,11 @@ def serve(
     max_new_tokens: int = 32,
     quant: str = "",
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    n: int = 1,
     seed: int = 0,
+    grammar: str = "",
     turns: int = 1,
     slots: int = 0,
     eos_id: Optional[int] = None,
@@ -167,6 +180,7 @@ def serve(
     import jax
 
     from kubeflow_controller_tpu.dataplane import metrics as metrics_mod
+    from kubeflow_controller_tpu.dataplane import sampling as sampling_mod
     from kubeflow_controller_tpu.dataplane.serving_engine import (
         Rejected, Request, ServingEngine,
     )
@@ -175,6 +189,24 @@ def serve(
     ctx = ctx or ProcessContext.from_env()
     tracer = Tracer(path=trace) if trace else None
     cfg = CONFIGS[config]()
+    # Sampling flags are validated up front (main() routes the same
+    # errors through argparse): a bad --temperature should fail before
+    # checkpoint restore, like a bad --tp does.
+    sampling_mod.SamplingParams(
+        temperature=temperature, top_k=top_k, top_p=top_p, n=n, seed=seed,
+    ).validate()
+    if n > 1 and not paged:
+        raise ValueError(
+            "n > 1 forks prompt KV pages copy-on-write and requires the "
+            "paged block pool (drop --no-paged)")
+    if (n > 1 or grammar) and turns > 1:
+        raise ValueError(
+            "--n / --grammar are single-turn engine features (turns == 1)")
+    if (top_k > 0 or top_p < 1.0) and turns > 1 and not prefix_cache:
+        raise ValueError(
+            "top-k/top-p serve through the engine; the contiguous "
+            "multi-turn path (--turns without --prefix-cache) supports "
+            "temperature only")
     # Tensor-parallel serving (docs/serving.md "Tensor-parallel
     # serving"): validate the head split BEFORE loading weights or
     # building an engine — a bad --tp should fail in milliseconds with
@@ -214,6 +246,7 @@ def serve(
     interrupted = False
     finish_reasons: List[str] = ["length"] * b
     rids: List[int] = list(range(b))
+    gens: List[int] = [0] * b
     # Size the KV cache to the actual request (prompt + new tokens), not
     # cfg.max_seq — an 8192-wide cache for a 64-token serve on the llama
     # configs would waste HBM and cap the batch.
@@ -225,13 +258,27 @@ def serve(
         n_slots = min(slots, b) if slots > 0 else b
         engine = ServingEngine(
             cfg, params, n_slots=n_slots, max_seq=s + max_new_tokens,
-            temperature=temperature, rng=rng, max_queue=max_queue,
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+            max_queue=max_queue,
             prefill_mode=("bucketed" if prefix_cache else prefill_mode),
             prefix_cache=prefix_cache, block_size=block_size,
             kv_hbm_budget_mb=kv_pool_mb, kv_quant=kv_quant, paged=paged,
             spec_decode=speculative, draft_k=draft_k, proposer=proposer,
             tp=tp, mesh=mesh, tracer=tracer,
         )
+        # One shared per-request params object: sampling state is keyed
+        # on (seed, gen, position), so requests never share mutable RNG
+        # state; the grammar mask object is stateless too (FSM state
+        # lives in the slot), so one instance serves every request.
+        req_params = None
+        if n > 1 or grammar:
+            mask = (sampling_mod.make_mask(grammar, cfg.vocab_size,
+                                           eos_id=eos_id)
+                    if grammar else None)
+            req_params = sampling_mod.SamplingParams(
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                n=n, seed=seed, logit_mask=mask,
+            )
         prompts_np = np.asarray(prompts)
         completions = []
         for i in range(b):
@@ -239,11 +286,11 @@ def serve(
                 engine.submit(Request(
                     rid=i, prompt=prompts_np[i],
                     max_new_tokens=max_new_tokens, eos_id=eos_id,
-                    deadline_s=deadline_s,
+                    deadline_s=deadline_s, params=req_params,
                 ))
             except Rejected as e:
                 logger.warning("request %d rejected: %s", i, e.reason)
-        max_steps = b * max_new_tokens + 2 * b + 4
+        max_steps = b * n * max_new_tokens + 2 * b * n + 4
         announced = False
         for _ in range(max_steps):
             if stop is not None and stop.is_set():
@@ -266,8 +313,9 @@ def serve(
             # still gets every completion that did finish.
             logger.error("engine failed to drain; flushing partials")
             completions.extend(engine.drain(0.0))
-        completions.sort(key=lambda c: c.rid)
+        completions.sort(key=lambda c: (c.rid, c.gen))
         rids = [c.rid for c in completions]
+        gens = [c.gen for c in completions]
         finish_reasons = [c.finish_reason for c in completions]
         tok_rows = [c.tokens for c in completions]
         dt = time.perf_counter() - t0
@@ -285,7 +333,8 @@ def serve(
         engine = ServingEngine(
             cfg, params, n_slots=n_slots,
             max_seq=turns * (s + max_new_tokens),
-            temperature=temperature, rng=rng, max_queue=max_queue,
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+            max_queue=max_queue,
             prefill_mode="bucketed", prefix_cache=True,
             block_size=block_size, kv_hbm_budget_mb=kv_pool_mb,
             kv_quant=kv_quant, paged=paged,
@@ -368,6 +417,9 @@ def serve(
             for row, (rid, reason) in enumerate(zip(rids, finish_reasons)):
                 f.write(json.dumps({
                     "rid": rid,
+                    # Generation index: n>1 requests emit n lines per
+                    # rid, distinguished here (0 for everything else).
+                    "gen": gens[row] if row < len(gens) else 0,
                     "prompt": np.asarray(prompts[rid]).tolist(),
                     "completion": list(map(int, tok_rows[row])),
                     "finish_reason": reason,
@@ -426,7 +478,32 @@ def main(argv=None) -> int:
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--quant", default="", choices=["", "int8"],
                    help="int8 = weight-only int8 serving weights")
-    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="softmax temperature (0 = greedy argmax; > 0 "
+                        "samples reproducibly from the per-request "
+                        "seeded RNG stream)")
+    p.add_argument("--top-k", type=int, default=0,
+                   help="keep only the k highest-probability tokens "
+                        "before sampling (0 = no top-k filter)")
+    p.add_argument("--top-p", type=float, default=1.0,
+                   help="nucleus sampling: keep the smallest probability "
+                        "mass >= p before sampling (1.0 = no filter)")
+    p.add_argument("--n", type=int, default=1,
+                   help="parallel generations per prompt: the prompt is "
+                        "prefilled ONCE, then forked into n slots that "
+                        "share its KV pages copy-on-write; completions "
+                        "carry a 'gen' index (requires the paged pool)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="sampling RNG seed: token i of generation g "
+                        "draws from fold_in(fold_in(key(seed), g), i), "
+                        "so fixed-seed streams are bit-identical "
+                        "regardless of batch composition, slot "
+                        "assignment, or engine config")
+    p.add_argument("--grammar", default="",
+                   help="constrained decoding spec: 'json' (emit valid "
+                        "JSON), 're:<pattern>' (incremental regex FSM), "
+                        "or 'set:<id,id,...>' (token allow-list); every "
+                        "emitted token keeps the output a valid prefix")
     p.add_argument("--turns", type=int, default=1,
                    help="multi-turn chat shape: each turn appends a "
                         "prompt via block prefill_continue, then decodes "
@@ -480,9 +557,10 @@ def main(argv=None) -> int:
                         "probe for the capability)")
     p.add_argument("--speculative", action="store_true",
                    help="speculative decoding: model-free drafts "
-                        "verified in one fused forward; greedy only "
-                        "(requires --temperature 0), outputs stay "
-                        "bit-identical to plain decode")
+                        "verified in one fused forward; greedy outputs "
+                        "stay bit-identical to plain decode, sampled "
+                        "requests verify via the speculative-sampling "
+                        "acceptance rule")
     p.add_argument("--draft-k", type=int, default=4,
                    help="max draft tokens proposed per slot per step "
                         "(adaptive-K shrinks below this on rejection)")
@@ -513,6 +591,27 @@ def main(argv=None) -> int:
             gen.check_tp_heads(CONFIGS[args.config](), args.tp)
         except ValueError as e:
             p.error(str(e))
+    # Sampling flag validation up front via argparse (usage + exit 2),
+    # mirroring the --tp head-split check: a negative temperature or a
+    # malformed grammar spec should not survive to checkpoint restore.
+    from kubeflow_controller_tpu.dataplane.sampling import (
+        SamplingParams, make_mask,
+    )
+    try:
+        SamplingParams(
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, n=args.n, seed=args.seed,
+        ).validate()
+        if args.grammar:
+            make_mask(args.grammar, CONFIGS[args.config]().vocab_size)
+    except ValueError as e:
+        p.error(str(e))
+    if args.n > 1 and not args.paged:
+        p.error("--n > 1 forks prompt KV pages copy-on-write and "
+                "requires the paged pool (drop --no-paged)")
+    if (args.n > 1 or args.grammar) and args.turns > 1:
+        p.error("--n / --grammar are single-turn engine features "
+                "(use --turns 1)")
     ctx = initialize_from_env()
     # Two-strike SIGTERM/SIGINT drain (util/signals.py, signals.go:26-40
     # parity): first signal sets the stop event — the engine drains and
@@ -534,6 +633,11 @@ def main(argv=None) -> int:
         max_new_tokens=args.max_new_tokens,
         quant=args.quant,
         temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        n=args.n,
+        seed=args.seed,
+        grammar=args.grammar,
         turns=args.turns,
         slots=args.slots,
         eos_id=None if args.eos_id < 0 else args.eos_id,

@@ -25,10 +25,14 @@ is the pure-host bookkeeping over that pool:
 Ownership model (the part the property tests pin):
 
 * every pool page is either **owned** by exactly one live slot (refcount
-  1, freed at retirement) or **shared** through a trie node — the node's
+  1, freed at retirement), **shared** through a trie node — the node's
   structural hold is refcount 1, and every live request whose table
   references the page holds one additional pin from admission (or
-  publish) to retirement;
+  publish) to retirement — or **fork-shared**: an ``n>1`` request's
+  child generations reference the parent's immutable prompt pages by
+  table id with one direct pool ref per child (no trie involvement),
+  released by the child's retirement; a fork-shared page is never
+  published by the child (adoption assumes slot ownership);
 * eos, length, deadline, cancel, and drain all release through the same
   path, and each page's refcount hits zero exactly once per tenancy,
   enforced loudly by :meth:`BlockPool.unref`;
@@ -44,6 +48,8 @@ slot until retirement — tables never retarget mid-flight.
 
 from __future__ import annotations
 
+import os
+from collections import Counter
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -92,6 +98,12 @@ def blocks_for_budget(
     return max(0, int(budget_bytes) // per_block)
 
 
+#: Anonymous owner token: plain alloc/ref/unref calls (slot ownership,
+#: trie holds, request pins) all account under this label, so the debug
+#: owner sets cost existing call sites nothing.
+_ANON_OWNER = "<anon>"
+
+
 class BlockPool:
     """Free-list allocator over ``n_blocks`` page ids with refcounts.
 
@@ -101,9 +113,20 @@ class BlockPool:
     zero, or unref of a never-allocated page) raises — an allocator
     that silently recycles an aliased page would corrupt cached
     prefixes undetectably.
+
+    **Owner-set debug mode** (``debug_owners=True`` or env
+    ``TPUJOB_KV_DEBUG_OWNERS=1``): every ref carries an owner token
+    (COW forks tag theirs ``("fork", rid, gen)``; everything else
+    accounts under an anonymous label), and a release whose owner holds
+    no reference raises immediately instead of corrupting a neighbor's
+    refcount — the class of bug copy-on-write forking makes possible
+    (two slots' table rows naming one physical page) and that a bare
+    refcount integer cannot catch. Off by default: the sets cost a dict
+    of Counters per pool, which serving does not need when the
+    invariants hold.
     """
 
-    def __init__(self, n_blocks: int):
+    def __init__(self, n_blocks: int, debug_owners: Optional[bool] = None):
         if n_blocks < 0:
             raise ValueError(f"n_blocks must be >= 0 (got {n_blocks})")
         self.n_blocks = n_blocks
@@ -111,6 +134,13 @@ class BlockPool:
         # keeps the working set of pool pages dense.
         self._free: List[int] = list(range(n_blocks - 1, -1, -1))
         self._refs: List[int] = [0] * n_blocks
+        if debug_owners is None:
+            debug_owners = os.environ.get(
+                "TPUJOB_KV_DEBUG_OWNERS", "") not in ("", "0", "false")
+        self.debug_owners = bool(debug_owners)
+        # page id -> Counter of owner tokens (multiset: one owner may
+        # legitimately hold several pins, e.g. trie hold + request pin).
+        self._owners: Dict[int, Counter] = {}
 
     @property
     def free_blocks(self) -> int:
@@ -123,7 +153,11 @@ class BlockPool:
     def refcount(self, bid: int) -> int:
         return self._refs[bid]
 
-    def alloc(self) -> Optional[int]:
+    def owners(self, bid: int) -> Counter:
+        """The page's live owner multiset (empty unless debug mode)."""
+        return Counter(self._owners.get(bid, Counter()))
+
+    def alloc(self, owner: object = None) -> Optional[int]:
         """Pop a free page at refcount 1, or None when exhausted (the
         caller decides whether to evict or to skip caching)."""
         if not self._free:
@@ -131,19 +165,36 @@ class BlockPool:
         bid = self._free.pop()
         assert self._refs[bid] == 0, f"free-list page {bid} had refs"
         self._refs[bid] = 1
+        if self.debug_owners:
+            self._owners[bid] = Counter(
+                [owner if owner is not None else _ANON_OWNER])
         return bid
 
-    def ref(self, bid: int) -> None:
+    def ref(self, bid: int, owner: object = None) -> None:
         if self._refs[bid] <= 0:
             raise RuntimeError(f"ref of dead page {bid}")
         self._refs[bid] += 1
+        if self.debug_owners:
+            self._owners[bid][
+                owner if owner is not None else _ANON_OWNER] += 1
 
-    def unref(self, bid: int) -> None:
+    def unref(self, bid: int, owner: object = None) -> None:
         if self._refs[bid] <= 0:
             raise RuntimeError(f"double free of page {bid}")
+        if self.debug_owners:
+            token = owner if owner is not None else _ANON_OWNER
+            held = self._owners.get(bid, Counter())
+            if held[token] <= 0:
+                raise RuntimeError(
+                    f"release of page {bid} by non-owner {token!r} "
+                    f"(held by {sorted(map(repr, held.elements()))})")
+            held[token] -= 1
+            if held[token] <= 0:
+                del held[token]
         self._refs[bid] -= 1
         if self._refs[bid] == 0:
             self._free.append(bid)
+            self._owners.pop(bid, None)
 
 
 @dataclass

@@ -140,6 +140,20 @@ class ServingStats:
     # the engine's tracer each step — 0/0 with tracing off.
     spans_recorded: int = 0
     spans_dropped: int = 0
+    # Sampling subsystem (docs/serving.md "Sampling, parallel
+    # generations, and constrained decoding"): ``sampled_requests``
+    # counts non-greedy generations admitted (forked children
+    # included); ``cow_page_copies`` counts device page copies COW
+    # forking performed (one per child with a partial boundary page);
+    # ``fork_shared_tokens`` counts prompt tokens whose KV a forked
+    # child reuses by reference instead of re-prefilling — the
+    # zero-copy accounting twin of ``prefix_zero_copy_tokens``;
+    # ``mask_tokens_filtered`` counts vocab entries constrained
+    # decoding masked out across all emitted masked tokens.
+    sampled_requests: int = 0
+    cow_page_copies: int = 0
+    fork_shared_tokens: int = 0
+    mask_tokens_filtered: int = 0
 
     def record(self, completion) -> None:
         self.finished += 1
@@ -207,6 +221,10 @@ class ServingStats:
             "prefix_hit_tokens": float(self.prefix_hit_tokens),
             "prefix_zero_copy_tokens": float(self.prefix_zero_copy_tokens),
             "prefix_hit_rate": self.prefix_hit_rate,
+            "sampled_requests": float(self.sampled_requests),
+            "cow_page_copies": float(self.cow_page_copies),
+            "fork_shared_tokens": float(self.fork_shared_tokens),
+            "mask_tokens_filtered": float(self.mask_tokens_filtered),
             "prefill_compiles": float(self.prefill_compiles),
             "prefill_chunks": float(self.prefill_chunks),
             "admit_cache_size": float(self.admit_cache_size),

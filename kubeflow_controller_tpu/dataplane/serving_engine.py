@@ -110,6 +110,27 @@ per-device KV bytes drop by N: a fixed per-device HBM budget admits N
 times the pool pages. The scheduler is mesh-blind — block tables,
 lengths, logits, and every host decision replicate, so all host logic
 in this file is byte-for-byte the single-chip path.
+
+Sampling (docs/serving.md "Sampling, parallel generations, and
+constrained decoding"): every request carries a
+:class:`~kubeflow_controller_tpu.dataplane.sampling.SamplingParams`
+(temperature / top-k / top-p / n / seed / logit_mask; None = engine
+defaults). Sampled rows draw token ``i`` of generation ``g`` under the
+counter-based key ``fold_in(fold_in(key(seed), g), i)`` — a pure
+function of the request, never of batch composition, slot index,
+admission order, churn, or engine config — so fixed-seed streams are
+bit-reproducible (pinned by tests/test_sampling.py). All-greedy
+batches still dispatch the original argmax step function byte-for-byte;
+mixed batches route through a sampled twin whose temperature<=0 rows
+reduce to the same argmax. ``n > 1`` forks the prefilled slot into n
+generations that share the prompt's KV pages copy-on-write (refcounted
+in :class:`~kubeflow_controller_tpu.dataplane.kv_blocks.BlockPool`;
+the partially-filled boundary page is copied on device at fork), so
+prefill cost and prompt KV bytes are paid once per prompt.
+``logit_mask`` constrains decoding: any step with a masked slot runs a
+synchronous masked dispatch whose allow-mask multiplies into the logits
+before argmax/sample, guaranteeing every emitted token keeps the output
+a valid prefix of the grammar.
 """
 
 from __future__ import annotations
@@ -127,6 +148,7 @@ import numpy as np
 from kubeflow_controller_tpu.dataplane import kv_blocks
 from kubeflow_controller_tpu.dataplane import spec_decode as spec_decode_mod
 from kubeflow_controller_tpu.dataplane.metrics import MetricsLogger, ServingStats
+from kubeflow_controller_tpu.dataplane.sampling import LogitMask, SamplingParams
 from kubeflow_controller_tpu.obs.telemetry import registry
 from kubeflow_controller_tpu.obs.trace import Tracer
 from kubeflow_controller_tpu.models import generate as gen
@@ -180,6 +202,12 @@ class Request:
     max_new_tokens: int
     eos_id: Optional[int] = None
     deadline_s: Optional[float] = None
+    # Per-request sampling contract (temperature/top-k/top-p/n/seed/
+    # logit_mask). None means "use the engine defaults" (greedy unless
+    # the engine was constructed with temperature > 0). ``n > 1`` forks
+    # the prefilled slot into n copy-on-write generations; all n
+    # completions carry this rid and are distinguished by ``gen``.
+    params: Optional[SamplingParams] = None
 
 
 @dataclass
@@ -191,6 +219,7 @@ class Completion:
     first_token_t: Optional[float]    # None when retired before any token
     done_t: float
     admit_t: Optional[float] = None   # None when shed/cancelled in queue
+    gen: int = 0                      # generation index for n>1 requests
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -297,6 +326,46 @@ class _Slot:
     # clearing takes either a full accept of a >= 2-token draft or two
     # probe hits in a row.
     spec_hits: int = 0
+    # Resolved sampling contract for this generation (request params or
+    # the engine defaults) and the generation index (0 for the parent /
+    # singleton, 1..n-1 for COW forks).
+    sp: SamplingParams = field(default_factory=SamplingParams)
+    gen_idx: int = 0
+    # Pool pages this slot READS but does not own: fork-shared prompt
+    # pages refcounted directly in the BlockPool at fork time. Released
+    # (unref'd) on every retirement path via _free_shared. A slot with
+    # shared pages never publishes to the prefix trie — insert_owned
+    # adoption assumes the slot owns every page its table row names.
+    shared: List[int] = field(default_factory=list)
+    # Constrained-decoding state: the request's LogitMask and the FSM
+    # state advanced per booked token. Slots with a mask decode in
+    # synchronous chunk=1 constrained quanta and never speculate.
+    mask: Optional[LogitMask] = None
+    mask_state: object = None
+
+
+@dataclass
+class _ForkSource:
+    """A prefilled parent awaiting COW forks for generations 1..n-1.
+
+    Captured at prefill completion: a snapshot of the parent's block-table
+    row, final logits row, and the shared-page refcounts each pending
+    child already holds (taken eagerly so the parent's own retirement can
+    never free a page a deferred child still needs). Children materialize
+    as slots free up; cancel/deadline releases the holds leak-free."""
+
+    req: Request
+    sp: SamplingParams
+    submit_t: float
+    admit_t: float
+    deadline_t: Optional[float]
+    gens_left: List[int]              # generation indices not yet placed
+    table: np.ndarray                 # parent row snapshot (host copy)
+    needed: int                       # pages spanned by prompt + budget
+    prompt_len: int
+    logits_row: jax.Array             # [vocab] parent logits at prefill end
+    shared: List[int]                 # fully-immutable prompt page ids
+    boundary_bid: Optional[int]       # partial last prompt page (COW target)
 
 
 class ServingEngine:
@@ -316,6 +385,7 @@ class ServingEngine:
         temperature: float = 0.0,
         top_k: int = 0,
         top_p: float = 1.0,
+        seed: int = 0,
         rng: Optional[jax.Array] = None,
         clock: Callable[[], float] = time.perf_counter,
         decode_chunk: int = 4,
@@ -344,6 +414,13 @@ class ServingEngine:
         self.n_slots = n_slots
         self.max_seq = int(max_seq or cfg.max_seq)
         self.temperature = temperature
+        # Engine-default sampling contract: requests submitted without
+        # explicit ``params`` resolve to this. Validation here rejects
+        # temperature < 0 / bad top-p at construction.
+        self._default_params = SamplingParams(
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p), seed=int(seed))
+        self._default_params.validate()
         self.decode_chunk = max(1, int(decode_chunk))
         # Admission control: bound the FIFO (None = unbounded, the
         # trusting-harness default) and optionally shed on queue wait.
@@ -464,9 +541,12 @@ class ServingEngine:
         # Speculative decoding (docs/serving.md "Speculative decoding"):
         # draft K tokens host-side (model-free proposers), verify all
         # K+1 positions in ONE fused forward, commit the longest
-        # greedy-consistent run. Greedy-only: the acceptance rule that
-        # makes outputs provably identical is argmax equality, so a
-        # sampling engine must not silently change its distribution.
+        # accepted run. Greedy rows accept on argmax equality; sampled
+        # rows accept by the speculative-sampling rule specialized to
+        # deterministic drafts (sample the target per position, accept
+        # while it equals the draft — the rejected sample IS the
+        # residual correction), so every row keeps its exact
+        # per-(seed, position) stream through the spec path.
         self.spec_decode = bool(spec_decode)
         self.draft_k = int(draft_k)
         self.spec_patience = max(1, int(spec_patience))
@@ -481,11 +561,6 @@ class ServingEngine:
         self._spec_backoff = [0] * n_slots
         self._proposer: Optional[spec_decode_mod.DraftProposer] = None
         if self.spec_decode:
-            if temperature > 0.0:
-                raise ValueError(
-                    "spec_decode requires temperature=0 (greedy): the "
-                    "accept rule is argmax equality — sampled decode "
-                    "through it would change the output distribution")
             if self.draft_k < 1:
                 raise ValueError(f"draft_k must be >= 1 (got {draft_k})")
             if isinstance(proposer, str):
@@ -497,9 +572,33 @@ class ServingEngine:
                 raise ValueError(
                     f"proposer must be 'prompt', 'radix', or a "
                     f"DraftProposer (got {proposer!r})")
+        # Legacy kwarg, kept for call-site compatibility. Sampling no
+        # longer consumes an engine-global RNG: every draw is keyed by
+        # the request's (seed, gen, position) counter chain
+        # (models/generate.py:sample_step_slots), which is what makes a
+        # sampled stream bit-reproducible across batch composition,
+        # slot assignment, and churn.
         self._rng = rng if rng is not None else jax.random.key(0)
         self._clock = clock
         self._step_idx = 0
+        # Per-slot sampling lanes, host-owned and mirrored to device
+        # (_push_sampling) before any sampled dispatch: temperature,
+        # top-k, top-p, seed, generation index. Greedy rows carry
+        # temperature 0 and pass through the sampled kernel bitwise as
+        # argmax (where-select in sample_step_slots).
+        self._temp_h = np.zeros(n_slots, np.float32)
+        self._topk_h = np.zeros(n_slots, np.int32)
+        self._topp_h = np.ones(n_slots, np.float32)
+        self._seed_h = np.zeros(n_slots, np.int32)
+        self._gen_h = np.zeros(n_slots, np.int32)
+        self._samp_dirty = True
+        self._temp_d = self._topk_d = self._topp_d = None
+        self._seed_d = self._gen_d = None
+        # Prefilled parents awaiting COW forks (n>1), and per-rid count
+        # of generations still owed a Completion (rid stays reserved
+        # until the LAST generation finishes).
+        self._fork_sources: List[_ForkSource] = []
+        self._rid_gens: Dict[int, int] = {}
         # Optional JSONL sink: drain() writes the final ServingStats
         # summary here (and closes the file) before returning, so a
         # SIGTERM'd replica's metrics survive the process — the fleet
@@ -571,15 +670,9 @@ class ServingEngine:
         mesh_ = self._mesh
 
         def _make_step(vw):
-            def _micro(carry, key, eos, budget, params):
+            def _micro(carry, _k, eos, budget, params):
                 logits, cache, emitted = carry
-                if temperature <= 0.0:
-                    toks = logits.argmax(-1).astype(jnp.int32)
-                else:
-                    filtered = gen._filter_logits(
-                        logits / temperature, top_k=top_k, top_p=top_p
-                    )
-                    toks = jax.random.categorical(key, filtered, axis=-1)
+                toks = logits.argmax(-1).astype(jnp.int32)
                 was_active = cache.active
                 new_logits, cache = gen.decode_step_paged(
                     cfg, params, toks[:, None], cache, mesh=mesh_,
@@ -598,10 +691,8 @@ class ServingEngine:
                 def body(carry, k):
                     return _micro(carry, k, eos, budget, params)
 
-                keys = (None if temperature <= 0.0
-                        else jax.random.split(key, chunk))
                 (logits, cache, emitted), toks = jax.lax.scan(
-                    body, (logits, cache, emitted), keys, length=chunk)
+                    body, (logits, cache, emitted), None, length=chunk)
                 # next_tok: what each row's NEXT sampled token will be
                 # (the carried logits' argmax) — spec mode feeds it to
                 # the draft proposer; plain mode never fetches it.
@@ -615,6 +706,94 @@ class ServingEngine:
 
         self._make_step = _make_step
         self._step_fns: Dict[int, Callable] = {}
+
+        # Sampled twin of _make_step: identical chunk/retirement
+        # structure, but each micro-step draws via the counter-based
+        # per-slot kernel (temperature/top-k/top-p filtering, key =
+        # fold_in(fold_in(PRNGKey(seed), gen), position)). ``emitted``
+        # IS the position argument — token i of a generation is always
+        # drawn under the same key regardless of which quantum, chunk
+        # offset, or slot it lands in. Greedy rows (temperature 0) take
+        # the argmax lane inside the kernel, bitwise identical to the
+        # greedy step fn.
+        def _make_step_sampled(vw):
+            def _micro(carry, eos, budget, params, temp, tk, tp_p, seed_v,
+                       gen_v):
+                logits, cache, emitted = carry
+                toks = gen.sample_step_slots(
+                    logits, temp, tk, tp_p, seed_v, gen_v, emitted)
+                was_active = cache.active
+                new_logits, cache = gen.decode_step_paged(
+                    cfg, params, toks[:, None], cache, mesh=mesh_,
+                    view_width=vw)
+                emitted = jnp.where(was_active, emitted + 1, emitted)
+                done = was_active & ((toks == eos) | (emitted >= budget))
+                cache = cache._replace(active=cache.active & ~done)
+                return (new_logits, cache, emitted), toks
+
+            def _step(params, logits, cache, eos, budget, emitted,
+                      temp, tk, tp_p, seed_v, gen_v):
+                def body(carry, _):
+                    return _micro(carry, eos, budget, params, temp, tk,
+                                  tp_p, seed_v, gen_v)
+
+                (logits, cache, emitted), toks = jax.lax.scan(
+                    body, (logits, cache, emitted), None, length=chunk)
+                # The sampled next_tok peek: drawn at the carried
+                # position, so it is bitwise the first token the next
+                # quantum would draw — spec mode drafts from it.
+                next_tok = gen.sample_step_slots(
+                    logits, temp, tk, tp_p, seed_v, gen_v, emitted)
+                return toks, next_tok, logits, cache, emitted
+
+            return jax.jit(_step, donate_argnums=(1, 2, 5))
+
+        self._make_step_sampled = _make_step_sampled
+        self._step_fns_sampled: Dict[int, Callable] = {}
+
+        # Constrained (masked) twin: ONE token per dispatch so the host
+        # can advance each slot's grammar FSM between draws. Unmasked
+        # rows get all-True mask rows — a bitwise no-op — and since
+        # draws are keyed by position, a stream is unchanged by which
+        # quantum flavor emitted each of its tokens.
+        def _make_step_masked(vw):
+            def _step(params, logits, cache, eos, budget, emitted,
+                      temp, tk, tp_p, seed_v, gen_v, mask):
+                toks = gen.sample_step_slots(
+                    logits, temp, tk, tp_p, seed_v, gen_v, emitted,
+                    mask=mask)
+                was_active = cache.active
+                new_logits, cache = gen.decode_step_paged(
+                    cfg, params, toks[:, None], cache, mesh=mesh_,
+                    view_width=vw)
+                emitted = jnp.where(was_active, emitted + 1, emitted)
+                done = was_active & ((toks == eos) | (emitted >= budget))
+                cache = cache._replace(active=cache.active & ~done)
+                return toks, new_logits, cache, emitted
+
+            return jax.jit(_step, donate_argnums=(1, 2, 5))
+
+        self._make_step_masked = _make_step_masked
+        self._step_fns_masked: Dict[int, Callable] = {}
+
+        # COW fork install: activate a child row whose table was
+        # assembled host-side — copy the parent's prefill-final logits
+        # row, set the retirement rule, zero the emitted counter. The
+        # child then decodes exactly as if it had prefilled itself.
+        def _fork_install(cache, logits_buf, eos, budget, emitted, slot,
+                          logits_row, length_val, eos_val, budget_val):
+            logits_buf = jax.lax.dynamic_update_slice(
+                logits_buf, logits_row[None].astype(logits_buf.dtype),
+                (slot, jnp.int32(0)))
+            eos = eos.at[slot].set(eos_val)
+            budget = budget.at[slot].set(budget_val)
+            emitted = emitted.at[slot].set(0)
+            cache = cache._replace(
+                length=cache.length.at[slot].set(length_val),
+                active=cache.active.at[slot].set(True))
+            return cache, logits_buf, eos, budget, emitted
+
+        self._fork_fn = jax.jit(_fork_install, donate_argnums=(0, 1, 2, 3, 4))
 
         # Speculative step: verify the host-proposed draft window in one
         # fused forward (generate.verify_step_slots), commit the
@@ -657,6 +836,37 @@ class ServingEngine:
                 return jax.jit(_spec, donate_argnums=(1, 2, 5))
 
             self._spec_step = _make_spec()
+
+            def _make_spec_sampled():
+                # Sampled verify: same fused forward, but acceptance is
+                # the speculative-sampling rule specialized to the
+                # deterministic draft (generate.verify_step_paged_sampled)
+                # and next_tok is the kernel's positional peek, not the
+                # argmax. Greedy rows through this fn are bitwise the
+                # greedy verify; an all-greedy batch never calls it.
+                def _spec(params, logits, cache, eos, budget, emitted,
+                          draft, dlen, temp, tk, tp_p, seed_v, gen_v):
+                    max_commit = jnp.maximum(budget - emitted, 1)
+                    (window, n, next_tok, new_logits,
+                     cache) = gen.verify_step_paged_sampled(
+                        cfg, params, draft, dlen, logits, cache, eos,
+                        max_commit, temp, tk, tp_p, seed_v, gen_v,
+                        emitted, mesh=mesh_)
+                    emitted = emitted + n
+                    in_commit = (jnp.arange(k_draft + 1, dtype=jnp.int32)
+                                 [None, :] < n[:, None])
+                    committed_eos = (
+                        (window == eos[:, None]) & (eos[:, None] >= 0)
+                        & in_commit
+                    ).any(axis=1)
+                    done = cache.active & (committed_eos
+                                           | (emitted >= budget))
+                    cache = cache._replace(active=cache.active & ~done)
+                    return window, n, next_tok, new_logits, cache, emitted
+
+                return jax.jit(_spec, donate_argnums=(1, 2, 5))
+
+            self._spec_step_sampled = _make_spec_sampled()
         # Exact-mode per-length admission memo, LRU-bounded (satellite of
         # the compile-explosion fix: even the fallback path cannot grow
         # without limit).
@@ -709,6 +919,14 @@ class ServingEngine:
         self._rids = set()
         self._done_buf = []
         self._draining = False
+        self._temp_h = np.zeros(self.n_slots, np.float32)
+        self._topk_h = np.zeros(self.n_slots, np.int32)
+        self._topp_h = np.ones(self.n_slots, np.float32)
+        self._seed_h = np.zeros(self.n_slots, np.int32)
+        self._gen_h = np.zeros(self.n_slots, np.int32)
+        self._samp_dirty = True
+        self._fork_sources = []
+        self._rid_gens = {}
 
     def register_prefix(self, tokens, cache, row: int = 0) -> int:
         """Seed the prefix trie from an EXTERNAL KV cache — the
@@ -755,6 +973,17 @@ class ServingEngine:
         prompt = np.asarray(req.prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError(f"request {req.rid}: empty prompt")
+        if req.params is not None:
+            req.params.validate()
+            if req.params.max_tokens is not None:
+                # SamplingParams.max_tokens overrides the request budget.
+                req.max_new_tokens = int(req.params.max_tokens)
+            if req.params.logit_mask is not None:
+                mv = getattr(req.params.logit_mask, "vocab_size", None)
+                if mv is not None and mv != self.cfg.vocab_size:
+                    raise ValueError(
+                        f"request {req.rid}: logit_mask vocab "
+                        f"{mv} != model vocab {self.cfg.vocab_size}")
         if prompt.size + req.max_new_tokens > self.max_seq:
             raise ValueError(
                 f"request {req.rid}: prompt {prompt.size} + "
@@ -787,6 +1016,8 @@ class ServingEngine:
         self.queue.append(_Queued(req=req, submit_t=now,
                                   deadline_t=deadline_t))
         self._rids.add(req.rid)
+        if req.params is not None and req.params.n > 1:
+            self._rid_gens[req.rid] = req.params.n
         self.stats.submitted += 1
         if len(self.queue) > self.stats.queue_depth_max:
             self.stats.queue_depth_max = len(self.queue)
@@ -809,17 +1040,26 @@ class ServingEngine:
             if q.req.rid == rid:
                 self.queue.remove(q)
                 self._rids.discard(rid)
+                self._rid_gens.pop(rid, None)
                 now = self._clock()
                 self._finish_completion(Completion(
                     rid=rid, tokens=[], finish_reason="cancelled",
                     submit_t=q.submit_t, first_token_t=None, done_t=now,
                 ))
                 return True
+        found = False
+        # n>1 requests occupy several slots (one per live generation)
+        # and possibly a pending fork source — cancel them ALL.
         for slot in self.slots:
             if slot is not None and slot.req.rid == rid:
                 slot.cancelled = True
-                return True
-        return False                      # retired between bookkeeping
+                found = True
+        for src in list(self._fork_sources):
+            if src.req.rid == rid:
+                self._cancel_fork_source(src, "cancelled")
+                self._fork_sources.remove(src)
+                found = True
+        return found                      # retired between bookkeeping
 
     def _record_completion(self, comp: Completion) -> None:
         """The ONE funnel every Completion passes through — natural
@@ -849,6 +1089,69 @@ class ServingEngine:
         if self._prefix_store is not None and slot.path:
             self._prefix_store.release(slot.path)
             slot.path = []
+
+    def _free_shared(self, slot: _Slot) -> None:
+        """Drop the slot's fork-shared page holds (pages it reads but
+        does not own — refcounted directly in the pool at fork time).
+        Called on EVERY retirement path, like _release_pins/_free_owned,
+        so COW sharing is leak-free under eos/length/deadline/cancel/
+        drain alike."""
+        for bid in slot.shared:
+            self.pool.unref(bid, owner=("fork", slot.req.rid,
+                                        slot.gen_idx))
+        slot.shared = []
+
+    def _rid_done(self, rid: int) -> None:
+        """One generation of ``rid`` finished. The rid stays reserved
+        (duplicate-rid guard) until ALL generations of an n>1 request
+        have produced their Completion."""
+        left = self._rid_gens.get(rid)
+        if left is None:
+            self._rids.discard(rid)
+            return
+        if left <= 1:
+            self._rid_gens.pop(rid, None)
+            self._rids.discard(rid)
+        else:
+            self._rid_gens[rid] = left - 1
+
+    # -- per-slot sampling lanes -----------------------------------------
+
+    def _set_slot_sampling(self, i: int, sp: SamplingParams,
+                           gen_idx: int = 0) -> None:
+        """Program slot i's sampling lane (admission and fork). Greedy
+        requests write temperature 0 — the sampled kernel's where-select
+        keeps their stream the exact argmax."""
+        self._temp_h[i] = sp.temperature
+        self._topk_h[i] = sp.top_k
+        self._topp_h[i] = sp.top_p
+        self._seed_h[i] = sp.seed
+        self._gen_h[i] = gen_idx
+        self._samp_dirty = True
+
+    def _push_sampling(self) -> None:
+        """Mirror the host sampling lanes to device, like _push_tables:
+        called before every SAMPLED dispatch, no-op while clean."""
+        if not self._samp_dirty and self._temp_d is not None:
+            return
+        self._temp_d = self._replicate(jnp.asarray(self._temp_h.copy()))
+        self._topk_d = self._replicate(jnp.asarray(self._topk_h.copy()))
+        self._topp_d = self._replicate(jnp.asarray(self._topp_h.copy()))
+        self._seed_d = self._replicate(jnp.asarray(self._seed_h.copy()))
+        self._gen_d = self._replicate(jnp.asarray(self._gen_h.copy()))
+        self._samp_dirty = False
+
+    def _sampled_in(self, snapshot) -> int:
+        """Count decoding rows that need the sampled kernel."""
+        return sum(1 for s in snapshot
+                   if s is not None and not s.sp.is_greedy)
+
+    def _masked_decoding(self) -> bool:
+        """True when any DECODING slot carries a grammar/token-set mask
+        — such quanta run synchronously at chunk=1 so the FSM advances
+        per token (mid-prefill masked slots don't count yet)."""
+        return any(s is not None and s.prefill is None
+                   and s.mask is not None for s in self.slots)
 
     # -- block-table plumbing --------------------------------------------
 
@@ -901,6 +1204,37 @@ class ServingEngine:
         if fn is None:
             fn = self._step_fns[vw] = self._make_step(vw)
         return fn(params, logits, cache, eos, budget, emitted, key)
+
+    def _dispatch_plain(self, snapshot):
+        """Dispatch the pipelined plain chunk for the current snapshot,
+        picking the greedy or sampled compiled twin. An all-greedy batch
+        runs the exact pre-sampling step fn; a mixed batch runs the
+        sampled twin, whose greedy lanes are bitwise argmax."""
+        if self._sampled_in(snapshot):
+            self._push_sampling()
+            vw = self._view_width()
+            fn = self._step_fns_sampled.get(vw)
+            if fn is None:
+                fn = self._step_fns_sampled[vw] = \
+                    self._make_step_sampled(vw)
+            return fn(self.params, self.logits, self.cache, self.eos,
+                      self.budget, self.emitted, self._temp_d,
+                      self._topk_d, self._topp_d, self._seed_d,
+                      self._gen_d)
+        return self._step_fn(self.params, self.logits, self.cache,
+                             self.eos, self.budget, self.emitted, None)
+
+    def _step_fn_masked(self, mask):
+        """Dispatch one constrained (chunk=1) micro-step with the given
+        [n_slots, vocab] admissibility mask."""
+        self._push_sampling()
+        vw = self._view_width()
+        fn = self._step_fns_masked.get(vw)
+        if fn is None:
+            fn = self._step_fns_masked[vw] = self._make_step_masked(vw)
+        return fn(self.params, self.logits, self.cache, self.eos,
+                  self.budget, self.emitted, self._temp_d, self._topk_d,
+                  self._topp_d, self._seed_d, self._gen_d, mask)
 
     def _spec_fn(self, params, logits, cache, eos, budget, emitted,
                  draft, dlen):
@@ -956,14 +1290,15 @@ class ServingEngine:
         every position before its length mask can expose it."""
         self._release_pins(slot)
         self._free_owned(slot)
+        self._free_shared(slot)
         self._clear_table_row(i)
         comp = Completion(
             rid=slot.req.rid, tokens=slot.tokens, finish_reason=reason,
             submit_t=slot.submit_t, first_token_t=slot.first_token_t,
-            done_t=now, admit_t=slot.admit_t,
+            done_t=now, admit_t=slot.admit_t, gen=slot.gen_idx,
         )
         self.slots[i] = None
-        self._rids.discard(slot.req.rid)
+        self._rid_done(slot.req.rid)
         self.cache = self.cache._replace(
             active=self.cache.active.at[i].set(False))
         self._record_completion(comp)
@@ -1070,6 +1405,7 @@ class ServingEngine:
                        and now - q.submit_t >= self.max_queue_delay_s)
             if expired or delayed:
                 self._rids.discard(q.req.rid)
+                self._rid_gens.pop(q.req.rid, None)
                 self._finish_completion(Completion(
                     rid=q.req.rid, tokens=[], finish_reason="shed",
                     submit_t=q.submit_t, first_token_t=None, done_t=now,
@@ -1097,6 +1433,11 @@ class ServingEngine:
         cursor at the match point — :meth:`_advance_prefills` runs the
         uncached suffix one chunk per step, interleaved with decode."""
         self._shed_queued()
+        # Pending COW forks admit FIRST: they extend work the engine
+        # already prefilled (their shared-page holds are live), so
+        # placing them ahead of the FIFO never deadlocks — a parent
+        # never waits on its own children — and frees the holds sooner.
+        self._spawn_forks()
         while self.queue:
             try:
                 slot = self.slots.index(None)
@@ -1104,6 +1445,8 @@ class ServingEngine:
                 return                      # slots full
             q = self.queue.popleft()
             req = q.req
+            sp = (req.params if req.params is not None
+                  else self._default_params)
             now = self._clock()
             path: List[kv_blocks.RadixNode] = []
             matched = 0
@@ -1162,13 +1505,23 @@ class ServingEngine:
                 self.slots[slot] = _Slot(
                     req=req, submit_t=q.submit_t, admit_t=now,
                     deadline_t=q.deadline_t, spec_k=self.draft_k,
-                    owned=owned,
+                    owned=owned, sp=sp, mask=sp.logit_mask,
+                    mask_state=(sp.logit_mask.init_state()
+                                if sp.logit_mask is not None else None),
                 )
+                self._set_slot_sampling(slot, sp, 0)
+                if sp.n > 1:
+                    # Exact mode prefills in one shot, so the parent is
+                    # fork-ready right here.
+                    self._capture_fork_source(slot, self.slots[slot])
             else:
                 self.slots[slot] = _Slot(
                     req=req, submit_t=q.submit_t, admit_t=now,
                     deadline_t=q.deadline_t, path=path,
-                    spec_k=self.draft_k, owned=owned,
+                    spec_k=self.draft_k, owned=owned, sp=sp,
+                    mask=sp.logit_mask,
+                    mask_state=(sp.logit_mask.init_state()
+                                if sp.logit_mask is not None else None),
                     prefill=_Prefill(
                         tokens=req.prompt, next_off=matched,
                         eos_val=(-1 if req.eos_id is None
@@ -1176,6 +1529,9 @@ class ServingEngine:
                         budget_val=req.max_new_tokens,
                     ),
                 )
+                self._set_slot_sampling(slot, sp, 0)
+            if not sp.is_greedy:
+                self.stats.sampled_requests += 1
             self.stats.admitted += 1
             self.stats.record_queue_wait(now - q.submit_t)
             if self._tracer is not None:
@@ -1184,6 +1540,9 @@ class ServingEngine:
                 self._tracer.add_event(
                     "admit", now, rid=r, slot=slot,
                     prefix_hit=int(matched), pages_reserved=int(needed))
+        # Exact-mode admissions above may have captured fork sources;
+        # place their children in any slots still free.
+        self._spawn_forks()
 
     def _advance_prefills(self) -> None:
         """Run ONE prefill chunk for every slot mid-admission (Sarathi-
@@ -1258,6 +1617,161 @@ class ServingEngine:
                     self._prefix_store.trie.acquire(ext)
                     slot.path = slot.path + ext
                 slot.prefill = None
+                if slot.sp.n > 1:
+                    # Chunked prefill just finished: the parent is now
+                    # fork-ready (its KV covers the whole prompt and its
+                    # logits row is the prompt-final distribution).
+                    self._capture_fork_source(i, slot)
+        self._spawn_forks()
+
+    # -- copy-on-write forks (n > 1) -------------------------------------
+
+    def _capture_fork_source(self, i: int, slot: _Slot) -> None:
+        """Snapshot a just-prefilled n>1 parent for COW forking.
+
+        Children share the parent's PHYSICAL prompt pages by table id:
+        each pending generation takes a direct pool refcount on every
+        fully-immutable prompt page (and on the partial boundary page,
+        held until its COW copy lands), so neither the parent's
+        retirement nor trie eviction can free a page a deferred child
+        still needs. The parent's prefill-final logits row is
+        materialized here, before any later dispatch donates the
+        buffer."""
+        sp = slot.sp
+        bs = self.block_size
+        L = int(slot.req.prompt.size)
+        fp = L // bs                       # fully-immutable prompt pages
+        shared = [int(self._tables[i, b]) for b in range(fp)]
+        boundary_bid = int(self._tables[i, fp]) if L % bs else None
+        gens = list(range(1, sp.n))
+        for g in gens:
+            owner = ("fork", slot.req.rid, g)
+            for bid in shared:
+                self.pool.ref(bid, owner=owner)
+            if boundary_bid is not None:
+                self.pool.ref(boundary_bid,
+                              owner=("fork-src", slot.req.rid, g))
+        self._fork_sources.append(_ForkSource(
+            req=slot.req, sp=sp, submit_t=slot.submit_t,
+            admit_t=slot.admit_t, deadline_t=slot.deadline_t,
+            gens_left=gens, table=self._tables[i].copy(),
+            needed=int(self._slot_blocks[i]), prompt_len=L,
+            logits_row=self.logits[i], shared=shared,
+            boundary_bid=boundary_bid,
+        ))
+
+    def _materialize_fork(self, slot_idx: int, src: _ForkSource,
+                          g: int) -> bool:
+        """Install generation ``g`` of a fork source into a free slot:
+        copy the parent's table row for the shared prompt pages, COW the
+        partial boundary page (fresh page + device copy + table swap —
+        the child's first decode write lands in it), allocate fresh
+        decode pages, and activate the row with the parent's
+        prefill-final logits. Returns False (leaving the source's holds
+        intact for retry next quantum) when the pool cannot supply the
+        fresh pages yet."""
+        bs = self.block_size
+        L = src.prompt_len
+        fp = L // bs
+        owned: List[int] = []
+        for _ in range(src.needed - fp):
+            bid = self._alloc_block()
+            if bid is None:
+                for x in owned:
+                    self.pool.unref(x)
+                return False
+            owned.append(bid)
+        row = self._tables[slot_idx]
+        row[:] = self._kv_pool_blocks
+        row[:fp] = src.table[:fp]
+        row[fp:src.needed] = owned
+        self._slot_blocks[slot_idx] = src.needed
+        self._tables_dirty = True
+        if src.boundary_bid is not None:
+            # The boundary page holds prompt KV the child reads but
+            # will also write (its first decode position lands there):
+            # copy-on-write at first-write time, which IS fork time for
+            # this page.
+            self.cache = gen.copy_pool_pages(
+                self.cache, [src.boundary_bid], [owned[0]],
+                mesh=self._mesh)
+            self.pool.unref(src.boundary_bid,
+                            owner=("fork-src", src.req.rid, g))
+            self.stats.cow_page_copies += 1
+        (self.cache, self.logits, self.eos, self.budget,
+         self.emitted) = self._fork_fn(
+            self.cache, self.logits, self.eos, self.budget,
+            self.emitted,
+            jnp.asarray(slot_idx, jnp.int32), src.logits_row,
+            jnp.asarray(L, jnp.int32),
+            jnp.asarray(-1 if src.req.eos_id is None else src.req.eos_id,
+                        jnp.int32),
+            jnp.asarray(src.req.max_new_tokens, jnp.int32),
+        )
+        self.slots[slot_idx] = _Slot(
+            req=src.req, submit_t=src.submit_t, admit_t=src.admit_t,
+            deadline_t=src.deadline_t, spec_k=self.draft_k,
+            owned=owned, sp=src.sp, gen_idx=g, shared=list(src.shared),
+            mask=src.sp.logit_mask,
+            mask_state=(src.sp.logit_mask.init_state()
+                        if src.sp.logit_mask is not None else None),
+        )
+        self._set_slot_sampling(slot_idx, src.sp, g)
+        self.stats.admitted += 1
+        self.stats.fork_shared_tokens += fp * bs
+        if not src.sp.is_greedy:
+            self.stats.sampled_requests += 1
+        if self._tracer is not None:
+            self._tracer.add_event(
+                "fork", self._clock(), rid=str(src.req.rid), gen=g,
+                slot=slot_idx, shared_pages=fp,
+                cow_pages=int(src.boundary_bid is not None))
+        return True
+
+    def _spawn_forks(self) -> None:
+        """Place pending fork generations into free slots (called from
+        every admission path). A source whose deadline passed sheds its
+        remaining generations leak-free."""
+        if not self._fork_sources:
+            return
+        remaining: List[_ForkSource] = []
+        for src in self._fork_sources:
+            if (src.deadline_t is not None
+                    and self._clock() >= src.deadline_t):
+                self._cancel_fork_source(src, "deadline")
+                continue
+            while src.gens_left:
+                try:
+                    slot = self.slots.index(None)
+                except ValueError:
+                    break
+                if not self._materialize_fork(slot, src,
+                                              src.gens_left[0]):
+                    break
+                src.gens_left.pop(0)
+            if src.gens_left:
+                remaining.append(src)
+        self._fork_sources = remaining
+
+    def _cancel_fork_source(self, src: _ForkSource, reason: str) -> None:
+        """Release every pending generation's page holds and emit its
+        (empty) Completion. The caller removes ``src`` from
+        ``_fork_sources``."""
+        now = self._clock()
+        for g in list(src.gens_left):
+            owner = ("fork", src.req.rid, g)
+            for bid in src.shared:
+                self.pool.unref(bid, owner=owner)
+            if src.boundary_bid is not None:
+                self.pool.unref(src.boundary_bid,
+                                owner=("fork-src", src.req.rid, g))
+            self._finish_completion(Completion(
+                rid=src.req.rid, tokens=[], finish_reason=reason,
+                submit_t=src.submit_t, first_token_t=None, done_t=now,
+                admit_t=src.admit_t, gen=g,
+            ))
+            self._rid_done(src.req.rid)
+        src.gens_left = []
 
     @property
     def n_active(self) -> int:
@@ -1266,7 +1780,8 @@ class ServingEngine:
     @property
     def idle(self) -> bool:
         return (not self.queue and self.n_active == 0
-                and self._pending is None and not self._done_buf)
+                and self._pending is None and not self._done_buf
+                and not self._fork_sources)
 
     def step(self) -> List[Completion]:
         """One scheduling quantum, pipelined one dispatch deep:
@@ -1298,6 +1813,8 @@ class ServingEngine:
         traffic — dispatch the SAME pipelined plain chunk as here, so
         hostile traffic keeps plain-decode TPOT.
         """
+        if self._masked_decoding():
+            return self._step_constrained()
         if self.spec_decode:
             return self._step_spec()
         tr = self._tracer
@@ -1315,20 +1832,14 @@ class ServingEngine:
         ]
         n_decoding = sum(s is not None for s in snapshot)
         if n_decoding > 0:
-            if self.temperature <= 0.0:
-                key = None
-            else:
-                self._step_idx += 1
-                key = jax.random.fold_in(self._rng, self._step_idx)
             self._push_tables()
             t_d0 = self._clock() if tr is not None else 0.0
             toks, next_tok, self.logits, self.cache, self.emitted = (
-                self._step_fn(
-                    self.params, self.logits, self.cache, self.eos,
-                    self.budget, self.emitted, key))
+                self._dispatch_plain(snapshot))
             if tr is not None:
                 tr.add_span("dispatch", t_d0, self._clock(),
-                            slots=n_decoding)
+                            slots=n_decoding,
+                            sampled=self._sampled_in(snapshot))
             dispatched = (toks, next_tok, snapshot, n_decoding)
 
         finished.extend(self._process_pending())
@@ -1338,6 +1849,86 @@ class ServingEngine:
         if tr is not None:
             tr.add_span("decode_quantum", t_q0, self._clock(),
                         slots=n_decoding, finished=len(finished))
+        self._sync_stats()
+        return finished
+
+    def _step_constrained(self) -> List[Completion]:
+        """One scheduling quantum while any decoding slot carries a
+        logit mask. Constrained decoding is inherently synchronous — the
+        FSM must see token i before it can admit token i+1 — so these
+        quanta dispatch ONE masked micro-step and book it immediately
+        (no pipeline). Unmasked neighbors ride along under all-True mask
+        rows: the mask is a bitwise no-op for them, and because draws
+        are keyed by (seed, gen, position) their streams are unchanged
+        by which quantum flavor emitted each token. Masked slots never
+        speculate; spec engines delegate here whenever a masked slot is
+        decoding."""
+        tr = self._tracer
+        t_q0 = self._clock() if tr is not None else 0.0
+        finished: List[Completion] = list(self._done_buf)
+        self._done_buf.clear()
+        finished.extend(self._retire_due())
+        # Flush the pipelined chunk from a preceding plain quantum
+        # BEFORE dispatching: booking order is the stream order.
+        finished.extend(self._process_pending())
+        snapshot: List[Optional[_Slot]] = [
+            s if (s is not None and s.prefill is None) else None
+            for s in self.slots
+        ]
+        vocab = self.cfg.vocab_size
+        mask = np.ones((self.n_slots, vocab), bool)
+        n_masked = 0
+        now = self._clock()
+        for i, s in enumerate(snapshot):
+            if s is None or s.mask is None:
+                continue
+            allowed = s.mask.allowed(s.mask_state)
+            if not allowed.any():
+                # Empty support: the grammar has no admissible
+                # continuation and no eos token was configured to carry
+                # the termination (with an eos id the mask itself keeps
+                # eos admissible at complete/dead-end states). Retire
+                # as a natural finish rather than sampling from nothing.
+                finished.append(self._retire_slot(i, s, "eos", now))
+                snapshot[i] = None
+                continue
+            mask[i] = allowed
+            self.stats.mask_tokens_filtered += int(
+                vocab - int(allowed.sum()))
+            n_masked += 1
+        n_decoding = sum(s is not None for s in snapshot)
+        if n_decoding > 0:
+            self._push_tables()
+            t_d0 = self._clock() if tr is not None else 0.0
+            toks, self.logits, self.cache, self.emitted = (
+                self._step_fn_masked(
+                    self._replicate(jnp.asarray(mask))))
+            toks_np = np.asarray(jax.device_get(toks))
+            if tr is not None:
+                tr.add_span("sample", t_d0, self._clock(),
+                            slots=n_decoding, masked=n_masked,
+                            sampled=self._sampled_in(snapshot))
+            now = self._clock()
+            self.stats.steps += 1
+            for i, s in enumerate(snapshot):
+                if s is None or self.slots[i] is not s:
+                    continue              # retired mid-quantum
+                tok = int(toks_np[i])
+                if s.mask is not None:
+                    s.mask_state = s.mask.advance(s.mask_state, tok)
+                # next_tok is unknown here (the masked step does not
+                # peek); spec probing for this slot resumes after its
+                # next plain quantum.
+                s.next_tok = None
+                comp = self._book_token(i, s, tok, now)
+                if comp is not None:
+                    finished.append(comp)
+        self._admit_waiting()
+        self._advance_prefills()
+        if tr is not None:
+            tr.add_span("decode_quantum", t_q0, self._clock(),
+                        slots=n_decoding, constrained=True,
+                        finished=len(finished))
         self._sync_stats()
         return finished
 
@@ -1401,13 +1992,12 @@ class ServingEngine:
                 self._push_tables()
                 t_d0 = self._clock() if tr is not None else 0.0
                 toks, next_tok, self.logits, self.cache, self.emitted = (
-                    self._step_fn(
-                        self.params, self.logits, self.cache, self.eos,
-                        self.budget, self.emitted, None))
+                    self._dispatch_plain(snapshot_p))
                 if tr is not None:
                     tr.add_span("dispatch", t_d0, self._clock(),
                                 slots=sum(s is not None
-                                          for s in snapshot_p))
+                                          for s in snapshot_p),
+                                sampled=self._sampled_in(snapshot_p))
                 dispatched = (toks, next_tok, snapshot_p,
                               sum(s is not None for s in snapshot_p))
             finished.extend(self._process_pending())
@@ -1436,11 +2026,21 @@ class ServingEngine:
             if proposal is not None:
                 draft, dlen = proposal
                 t_v0 = self._clock() if tr is not None else 0.0
-                window, n, next_tok, self.logits, self.cache, \
-                    self.emitted = self._spec_fn(
-                        self.params, self.logits, self.cache, self.eos,
-                        self.budget, self.emitted,
-                        jnp.asarray(draft), jnp.asarray(dlen))
+                if self._sampled_in(snapshot):
+                    self._push_sampling()
+                    window, n, next_tok, self.logits, self.cache, \
+                        self.emitted = self._spec_step_sampled(
+                            self.params, self.logits, self.cache,
+                            self.eos, self.budget, self.emitted,
+                            jnp.asarray(draft), jnp.asarray(dlen),
+                            self._temp_d, self._topk_d, self._topp_d,
+                            self._seed_d, self._gen_d)
+                else:
+                    window, n, next_tok, self.logits, self.cache, \
+                        self.emitted = self._spec_fn(
+                            self.params, self.logits, self.cache,
+                            self.eos, self.budget, self.emitted,
+                            jnp.asarray(draft), jnp.asarray(dlen))
                 # One transfer for all three outputs: the spec step is
                 # synchronous (the next proposal needs these), so every
                 # extra device_get round-trip lands on the critical path.
@@ -1457,9 +2057,7 @@ class ServingEngine:
                 # exactly like the non-spec engine — this is the path
                 # incompressible traffic settles into under backoff.
                 toks, next_tok, self.logits, self.cache, self.emitted = (
-                    self._step_fn(
-                        self.params, self.logits, self.cache, self.eos,
-                        self.budget, self.emitted, None))
+                    self._dispatch_plain(snapshot))
                 self._pending = (toks, next_tok, snapshot, n_decoding)
         self._admit_waiting()
         self._advance_prefills()
@@ -1669,6 +2267,16 @@ class ServingEngine:
         reg.gauge("pool_blocks_in_use", "serving").set(
             self.pool.used_blocks)
         reg.gauge("active_slots", "serving").set(self.n_active)
+        # Sampling-subsystem gauges mirror the monotone stats counters
+        # (set, not inc: _sync_stats runs every quantum).
+        reg.gauge("sampled_requests", "serving").set(
+            self.stats.sampled_requests)
+        reg.gauge("cow_page_copies", "serving").set(
+            self.stats.cow_page_copies)
+        reg.gauge("fork_shared_tokens", "serving").set(
+            self.stats.fork_shared_tokens)
+        reg.gauge("mask_tokens_filtered", "serving").set(
+            self.stats.mask_tokens_filtered)
 
     def _book_token(self, i: int, slot: _Slot, tok: int,
                     now: float) -> Optional[Completion]:
@@ -1691,7 +2299,7 @@ class ServingEngine:
         done_eos = req.eos_id is not None and tok == req.eos_id
         if not done_eos and len(slot.tokens) < req.max_new_tokens:
             return None
-        if self._prefix_store is not None:
+        if self._prefix_store is not None and not slot.shared:
             # RadixAttention semantics: the finished row's DECODED
             # tokens join the trie too (their KV is already in the
             # slot's own pool pages — every committed token's KV landed
@@ -1701,6 +2309,14 @@ class ServingEngine:
             # the trie lacks adopt this slot's pages in place; the
             # partial tail block (and any dedup-losing duplicates) are
             # freed by _free_owned below.
+            #
+            # Forked children (slot.shared non-empty) NEVER publish:
+            # their table rows name pages the PARENT owns, and
+            # insert_owned adoption assumes every mapped page belongs
+            # to this slot — adopting a shared page would hand the trie
+            # a block another slot still frees at retirement (the
+            # double-release hazard the owner-set debug mode in
+            # kv_blocks catches).
             full = np.concatenate([
                 req.prompt, np.asarray(slot.tokens, np.int32)])
             bs = self.block_size
@@ -1715,16 +2331,17 @@ class ServingEngine:
                 slot.owned.remove(owned_map[o])
         self._release_pins(slot)
         self._free_owned(slot)
+        self._free_shared(slot)
         self._clear_table_row(i)
         comp = Completion(
             rid=req.rid, tokens=slot.tokens,
             finish_reason="eos" if done_eos else "length",
             submit_t=slot.submit_t,
             first_token_t=slot.first_token_t, done_t=now,
-            admit_t=slot.admit_t,
+            admit_t=slot.admit_t, gen=slot.gen_idx,
         )
         self.slots[i] = None
-        self._rids.discard(req.rid)
+        self._rid_done(req.rid)
         return comp
 
     def _process_pending(self) -> List[Completion]:
@@ -1796,6 +2413,7 @@ class ServingEngine:
         while self.queue:
             q = self.queue.popleft()
             self._rids.discard(q.req.rid)
+            self._rid_gens.pop(q.req.rid, None)
             comp = Completion(
                 rid=q.req.rid, tokens=[], finish_reason="shed",
                 submit_t=q.submit_t, first_token_t=None, done_t=now,
@@ -1813,6 +2431,13 @@ class ServingEngine:
         for i, slot in enumerate(self.slots):
             if slot is not None:
                 out.append(self._retire_slot(i, slot, "deadline", now))
+        # Pending fork generations never got a slot: shed them with
+        # their page holds released (leak-free under drain).
+        for src in self._fork_sources:
+            self._cancel_fork_source(src, "deadline")
+        self._fork_sources = []
+        out.extend(self._done_buf)
+        self._done_buf.clear()
         # Every retirement path above funnels through _release_pins, so
         # by here no request holds a trie pin — the block pool's only
         # remaining refs are the trie's own (leak-checked by
@@ -1863,7 +2488,8 @@ class ServingEngine:
             # per request) + chunked-prefill steps (one block per step
             # in bucketed mode) bounds the drain.
             max_steps = sum(
-                r.max_new_tokens
+                (r.params.n if r.params is not None else 1)
+                * (r.max_new_tokens + 2)
                 + -(-int(np.asarray(r.prompt).size) // self.block_size)
                 for r in requests
             ) + 2 * len(requests) + 4

@@ -4,9 +4,15 @@ Speculative decoding (Leviathan et al., "Fast Inference from
 Transformers via Speculative Decoding") splits a decode step into
 *draft* and *verify*: something cheap guesses the next K tokens, one
 fused forward pass (``generate.verify_step_slots``) scores all K+1
-positions, and the longest greedy-consistent run commits. With greedy
-acceptance the committed stream is provably the stream plain decode
-would have produced — speculation changes latency, never output.
+positions, and the longest accepted run commits. Greedy rows accept
+the longest argmax-consistent run — the committed stream is provably
+the stream plain decode would have produced; sampled rows accept by
+the standard speculative-sampling rule (``generate.
+verify_step_paged_sampled``) drawing from the same per-position seeded
+RNG keys plain decode uses, so the committed stream follows the exact
+target distribution and a fixed seed stays reproducible (docs/
+serving.md "Sampling"). Speculation changes acceptance latency, never
+the output distribution.
 
 This module is the *draft* half. No draft model: both proposers guess
 from token statistics the serving stack already holds, so a wrong

@@ -1473,7 +1473,8 @@ def _verify_step_paged_impl(
     max_commit: jax.Array,      # [B] int32 — commit budget cap, >= 1
     tp_shards: int = 1,
     view_width: Optional[int] = None,
-) -> Tuple[jax.Array, jax.Array, jax.Array, PagedKVCache]:
+    sampling=None,              # (temperature, top_k, top_p, seed, gen, pos)
+) -> Tuple[jax.Array, ...]:
     from kubeflow_controller_tpu.ops.attention import paged_kv_view
 
     b, k_draft = draft.shape
@@ -1495,7 +1496,15 @@ def _verify_step_paged_impl(
         cache.v, cache.tables, vw, scale=cache.v_scale, out_dtype=dt,
     )
 
-    t0 = logits.argmax(-1).astype(jnp.int32)
+    if sampling is None:
+        t0 = logits.argmax(-1).astype(jnp.int32)
+    else:
+        # Sampled rows draw t0 under the counter-based key for the next
+        # stream position; greedy rows fall through to argmax inside
+        # sample_step_slots (same bits as the plain-argmax branch).
+        s_temp, s_topk, s_topp, s_seed, s_gen, s_pos = sampling
+        t0 = sample_step_slots(
+            logits, s_temp, s_topk, s_topp, s_seed, s_gen, s_pos)
     window = jnp.concatenate(
         [t0[:, None], draft.astype(jnp.int32)], axis=1)   # [B, W]
 
@@ -1566,6 +1575,20 @@ def _verify_step_paged_impl(
     all_logits = _head_logits(cfg, params, x)        # [B, W, vocab]
 
     preds = all_logits.argmax(-1).astype(jnp.int32)  # [B, W]
+    if sampling is not None:
+        # Speculative sampling with a deterministic (delta-distribution)
+        # draft: sample t ~ filtered-target at each window position under
+        # that position's counter key; accept a draft token iff it equals
+        # t (probability p(draft) — exactly the standard min(1, p/q)
+        # acceptance for a point-mass q), and on rejection t itself is
+        # the residual-distribution correction, carried as next_tok and
+        # re-derived bitwise by the next quantum's t0 draw. Greedy rows
+        # keep the argmax-equality rule verbatim via the where-select.
+        pred_pos = (s_pos[:, None] + 1
+                    + jnp.arange(w, dtype=jnp.int32)[None, :])
+        sampled_preds = _sample_rows_2d(
+            all_logits, s_temp, s_topk, s_topp, s_seed, s_gen, pred_pos)
+        preds = jnp.where((s_temp > 0.0)[:, None], sampled_preds, preds)
     ok = (
         (window[:, 1:] == preds[:, :-1])
         & (jnp.arange(k_draft, dtype=jnp.int32)[None, :]
@@ -1598,9 +1621,16 @@ def _verify_step_paged_impl(
     idx = jnp.clip(n - 1, 0, k_draft)
     new_logits = jnp.take_along_axis(
         all_logits, idx[:, None, None], axis=1)[:, 0]
-    return window, n, new_logits, cache._replace(
+    new_cache = cache._replace(
         k=k_all, v=v_all, k_scale=k_scale, v_scale=v_scale,
         length=pos0 + n)
+    if sampling is None:
+        return window, n, new_logits, new_cache
+    # preds[n-1] is the peek at stream position pos + n: for greedy rows
+    # it equals new_logits.argmax (same bits); for sampled rows it is the
+    # draw the next quantum's first sample would make from new_logits.
+    next_tok = jnp.take_along_axis(preds, idx[:, None], axis=1)[:, 0]
+    return window, n, next_tok, new_logits, new_cache
 
 
 def verify_step_paged(
@@ -1640,6 +1670,67 @@ def verify_step_paged(
         check_rep=False,
     )
     return fn(params, draft, draft_len, logits, cache, eos, max_commit)
+
+
+def verify_step_paged_sampled(
+    cfg: TransformerConfig,
+    params: Params,
+    draft: jax.Array,           # [B, K] int32 — proposed continuations
+    draft_len: jax.Array,       # [B] int32 in [0, K] — valid drafts/row
+    logits: jax.Array,          # [B, vocab] — carried last-position logits
+    cache: PagedKVCache,
+    eos: jax.Array,             # [B] int32 — per-row EOS id (-1 = none)
+    max_commit: jax.Array,      # [B] int32 — commit budget cap, >= 1
+    temperature: jax.Array,     # [B] f32 — <= 0 rows verify greedily
+    top_k: jax.Array,           # [B] i32
+    top_p: jax.Array,           # [B] f32
+    seed: jax.Array,            # [B] i32
+    gen: jax.Array,             # [B] i32
+    pos: jax.Array,             # [B] i32 — emitted-token count per row
+    mesh: Optional[Mesh] = None,
+    view_width: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, PagedKVCache]:
+    """:func:`verify_step_paged` generalized to per-row sampling via the
+    standard speculative-sampling acceptance rule specialized to this
+    repo's deterministic drafters (the draft distribution is a point
+    mass, so accept-with-prob ``min(1, p/q)`` reduces to "sample from
+    the filtered target; accept while it equals the draft", and the
+    rejection-position sample is itself the residual correction).
+    Greedy rows (``temperature <= 0``) take the argmax-equality rule of
+    :func:`verify_step_paged` with the same bits, and an all-greedy
+    engine never calls this function at all — the greedy verify path is
+    byte-identical to before. Returns ``(window, n, next_tok,
+    new_logits, cache)`` where ``next_tok`` is the bitwise peek of the
+    next quantum's first draw (sampled rows) or ``new_logits.argmax``
+    (greedy rows). Sampled keys are counter-based per
+    :func:`_sample_keys`, so acceptance and corrections are
+    batch-composition-independent; under tp the sampling inputs are
+    replicated and every shard draws identical tokens."""
+    sampling = (temperature, top_k, top_p, seed, gen, pos)
+    tp = tp_size(mesh)
+    if tp <= 1:
+        return _verify_step_paged_impl(
+            cfg, params, draft, draft_len, logits, cache, eos,
+            max_commit, 1, view_width, sampling)
+    check_tp_heads(cfg, tp)
+
+    def _shard_body(params, draft, draft_len, logits, cache, eos,
+                    max_commit, sampling):
+        return _verify_step_paged_impl(
+            cfg, params, draft, draft_len, logits, cache, eos, max_commit,
+            tp_shards=tp, view_width=view_width, sampling=sampling)
+
+    fn = shard_map(
+        _shard_body,
+        mesh=mesh,
+        in_specs=(_replicated_specs(params), P(), P(), P(),
+                  paged_cache_specs(cache), P(), P(),
+                  (P(), P(), P(), P(), P(), P())),
+        out_specs=(P(), P(), P(), P(), paged_cache_specs(cache)),
+        check_rep=False,
+    )
+    return fn(params, draft, draft_len, logits, cache, eos, max_commit,
+              sampling)
 
 
 def _check_cache_capacity(cache: KVCache, new_tokens: int, what: str) -> None:
@@ -1900,3 +1991,175 @@ def generate(
         cfg, params, logits, cache, max_new_tokens,
         temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
     )
+
+
+# ---------------------------------------------------------------------------
+# Batched sampling: per-row filtering + counter-based per-request RNG
+# ---------------------------------------------------------------------------
+
+
+def _sample_keys(seed: jax.Array, gen: jax.Array, pos: jax.Array) -> jax.Array:
+    """Per-row counter-based sampling keys.
+
+    Row ``i`` gets ``fold_in(fold_in(PRNGKey(seed[i]), gen[i]), pos[i])``
+    — a pure function of (request seed, generation index, position in the
+    generated stream), never of the step counter, slot id, or batch
+    around it. This is the whole reproducibility contract: re-running a
+    request in any batch mix, admission order, or slot assignment
+    re-derives the identical key sequence."""
+
+    def one(s, g, p):
+        k = jax.random.PRNGKey(s)
+        k = jax.random.fold_in(k, g)
+        return jax.random.fold_in(k, p)
+
+    return jax.vmap(one)(seed, gen, pos)
+
+
+def _filter_logits_rows(
+    logits: jax.Array,          # [B, vocab]
+    temperature: jax.Array,     # [B] f32 — <= 0 rows pass through (greedy)
+    top_k: jax.Array,           # [B] i32 — 0 disables
+    top_p: jax.Array,           # [B] f32 — >= 1 disables
+) -> jax.Array:
+    """Per-row temperature/top-k/top-p — the batched twin of
+    :func:`_filter_logits`. Identical op sequence and tie handling, but
+    every knob is a ``[B]`` vector applied per row; rows whose knob is
+    disabled (``top_k == 0`` / ``top_p >= 1``) pass through bitwise
+    untouched, so a uniform-parameter batch filters exactly like the
+    static single-request path."""
+    safe_t = jnp.where(temperature > 0.0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+    v = scaled.shape[-1]
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    # kth largest per row == lax.top_k(values)[-1] for that row's k.
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(top_k - 1, 0, v - 1)[:, None], axis=-1)
+    scaled = jnp.where(
+        (top_k > 0)[:, None] & (scaled < kth), -jnp.inf, scaled)
+    sorted2 = jnp.sort(scaled, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted2, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = jnp.concatenate(
+        [jnp.ones_like(cum[..., :1], bool),
+         cum[..., :-1] < top_p[:, None]], axis=-1)
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted2, jnp.inf), axis=-1, keepdims=True)
+    scaled = jnp.where(
+        (top_p < 1.0)[:, None] & (scaled < thresh), -jnp.inf, scaled)
+    return scaled
+
+
+def sample_step_slots(
+    logits: jax.Array,          # [B, vocab]
+    temperature: jax.Array,     # [B] f32 — <= 0 means greedy for that row
+    top_k: jax.Array,           # [B] i32
+    top_p: jax.Array,           # [B] f32
+    seed: jax.Array,            # [B] i32 — per-request RNG seed
+    gen: jax.Array,             # [B] i32 — parallel-generation index
+    pos: jax.Array,             # [B] i32 — position in the generated stream
+    mask: Optional[jax.Array] = None,   # [B, vocab] bool — True = allowed
+) -> jax.Array:
+    """Batched per-slot sampling step. Greedy rows (``temperature <= 0``)
+    take the exact ``argmax`` the greedy engine path takes — same bits —
+    so mixing sampled and greedy traffic in one batch never perturbs the
+    greedy rows. Sampled rows draw ``categorical`` from the per-row
+    filtered logits under the counter-based key of
+    :func:`_sample_keys`. ``mask`` (constrained decoding) zeroes
+    disallowed tokens to ``-inf`` before both paths; an all-``True`` row
+    is a bitwise no-op."""
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    greedy = logits.argmax(-1).astype(jnp.int32)
+    filtered = _filter_logits_rows(logits, temperature, top_k, top_p)
+    keys = _sample_keys(seed, gen, pos)
+    sampled = jax.vmap(
+        lambda k, l: jax.random.categorical(k, l, axis=-1))(keys, filtered)
+    return jnp.where(temperature > 0.0, sampled, greedy).astype(jnp.int32)
+
+
+def _sample_rows_2d(
+    all_logits: jax.Array,      # [B, W, vocab]
+    temperature: jax.Array,     # [B]
+    top_k: jax.Array,           # [B]
+    top_p: jax.Array,           # [B]
+    seed: jax.Array,            # [B]
+    gen: jax.Array,             # [B]
+    pos: jax.Array,             # [B, W] — per-position stream indices
+) -> jax.Array:
+    """:func:`sample_step_slots` over a [B, W] window (no mask): each
+    window position samples under its own positional key, so the draw at
+    stream position p is bitwise the draw the plain decode path would
+    have made there."""
+    b, w, v = all_logits.shape
+    rep = lambda x: jnp.repeat(x, w)  # noqa: E731
+    flat = _filter_logits_rows(
+        all_logits.reshape(b * w, v),
+        rep(temperature), rep(top_k), rep(top_p))
+    keys = _sample_keys(rep(seed), rep(gen), pos.reshape(-1))
+    sampled = jax.vmap(
+        lambda k, l: jax.random.categorical(k, l, axis=-1))(keys, flat)
+    return sampled.reshape(b, w).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Copy-on-write page copy
+# ---------------------------------------------------------------------------
+
+
+def _copy_pages_impl(pool_k, pool_v, k_scale, v_scale, src, dst):
+    # Whole-page gather then scatter: sentinel dst drops the write (and
+    # its sentinel src gather clamps harmlessly). Quantized pools copy
+    # the int8 payload AND its scales verbatim — no requantization, so a
+    # COW'd page is bit-identical to its source.
+    pool_k = pool_k.at[:, dst].set(pool_k[:, src], mode="drop")
+    pool_v = pool_v.at[:, dst].set(pool_v[:, src], mode="drop")
+    if k_scale is not None:
+        k_scale = k_scale.at[:, dst].set(k_scale[:, src], mode="drop")
+        v_scale = v_scale.at[:, dst].set(v_scale[:, src], mode="drop")
+    return pool_k, pool_v, k_scale, v_scale
+
+
+_copy_pool_pages_j = jax.jit(_copy_pages_impl, donate_argnums=(0, 1, 2, 3))
+
+
+@functools.lru_cache(maxsize=16)
+def _copy_pages_tp_fn(mesh: Mesh, has_scale: bool):
+    scale_spec = _TP_SCALE_SPEC if has_scale else None
+    return jax.jit(shard_map(
+        _copy_pages_impl, mesh=mesh,
+        in_specs=(_TP_POOL_SPEC, _TP_POOL_SPEC, scale_spec, scale_spec,
+                  P(), P()),
+        out_specs=(_TP_POOL_SPEC, _TP_POOL_SPEC, scale_spec, scale_spec),
+        check_rep=False,
+    ), donate_argnums=(0, 1, 2, 3))
+
+
+def copy_pool_pages(
+    cache: PagedKVCache,
+    src_ids,                    # source page ids (host list)
+    dst_ids,                    # destination page ids, same length
+    mesh: Optional[Mesh] = None,
+) -> PagedKVCache:
+    """Copy whole pool pages ``src -> dst`` on device — the copy-on-write
+    kernel behind ``n>1`` forked generations. The id lists pad to the
+    next power of two with a dropped sentinel (compile count stays
+    O(log) in pages per call, and the common one-boundary-page COW
+    compiles once). Under tp each shard copies its own KV-head slice of
+    the page; no collective. ``mesh``: see :func:`decode_step_paged`."""
+    m = 1
+    while m < len(src_ids):
+        m *= 2
+    sentinel = cache.k.shape[1]                  # OOB -> dropped
+    src = np.full((m,), sentinel, np.int32)
+    src[:len(src_ids)] = src_ids
+    dst = np.full((m,), sentinel, np.int32)
+    dst[:len(dst_ids)] = dst_ids
+    tp = tp_size(mesh)
+    if tp <= 1:
+        fn = _copy_pool_pages_j
+    else:
+        fn = _copy_pages_tp_fn(mesh, cache.k_scale is not None)
+    k, v, ks, vs = fn(cache.k, cache.v, cache.k_scale, cache.v_scale,
+                      jnp.asarray(src), jnp.asarray(dst))
+    return cache._replace(k=k, v=v, k_scale=ks, v_scale=vs)

@@ -7,7 +7,11 @@ Two layers, matching the design split in ``dataplane/kv_blocks.py``:
    per tenancy (double-free raises), eviction only reclaims unpinned
    leaves in LRU order, and a randomized op soup preserves the
    refcount-accounting invariant ``pool.refcount(block) == 1 +
-   request pins`` for every live node.
+   request pins`` for every live node. The fork-ownership soup does the
+   same for copy-on-write sharing (ISSUE 12): in owner-set debug mode
+   every page's refcount must equal its owner multiset — slot
+   tenancies + fork shares + trie holds — and release by a non-owner
+   (including double release) raises.
 
 2. **Engine integration**: with the prefix cache ON, greedy outputs are
    BIT-IDENTICAL to the cache-off bucketed engine under slot churn and
@@ -178,6 +182,93 @@ def test_trie_random_ops_preserve_refcount_invariant():
         assert pool.used_blocks == n_live
     for path in held:
         trie.release(path)
+
+
+def test_pool_owner_guard_raises_on_non_owner_release():
+    """Owner-set debug mode (TPUJOB_KV_DEBUG_OWNERS / debug_owners=True):
+    a release by a party that holds no ref on the page — including a
+    double release by a party that already gave its ref back — raises
+    instead of silently corrupting the refcount for the other
+    tenants."""
+    pool = BlockPool(4, debug_owners=True)
+    b = pool.alloc(owner=("slot", 1))
+    pool.ref(b, owner=("fork", 1, 0))
+    with pytest.raises(RuntimeError, match="non-owner"):
+        pool.unref(b, owner=("fork", 2, 1))
+    pool.unref(b, owner=("fork", 1, 0))
+    with pytest.raises(RuntimeError, match="non-owner"):
+        pool.unref(b, owner=("fork", 1, 0))    # double release
+    pool.unref(b, owner=("slot", 1))
+    assert pool.used_blocks == 0
+
+
+def test_fork_refcount_soup_owner_ledger_consistent():
+    """Property-style soup over the fork-sharing ownership model: 300
+    random slot-alloc / fork-share / fork-retire(cancel) / slot-retire /
+    trie ops against one pool in debug-owner mode. After every op each
+    page's refcount must equal the size of its owner multiset (slot
+    tenancies + fork shares + anonymous trie holds) — the accounting a
+    double release or release-by-non-owner would break — and the whole
+    soup must drain back to zero used pages."""
+    rng = np.random.default_rng(7)
+    pool = BlockPool(24, debug_owners=True)
+    trie = RadixCache(pool, block_size=2)
+    slots = {}      # rid -> pages alloc'd under owner ("slot", rid)
+    forks = []      # (rid, g, shared pages) ref'd under ("fork", rid, g)
+    held = []
+    next_rid, next_g = 0, 0
+    for step in range(300):
+        op = rng.integers(0, 6)
+        if op == 0 and pool.free_blocks > 2:
+            rid, next_rid = next_rid, next_rid + 1
+            slots[rid] = [pool.alloc(owner=("slot", rid))
+                          for _ in range(int(rng.integers(1, 3)))]
+        elif op == 1 and slots:
+            # Fork: a child takes one ref per shared parent page. The
+            # parent may already have live forks; pages stack refs.
+            rid = list(slots)[int(rng.integers(0, len(slots)))]
+            g, next_g = next_g, next_g + 1
+            share = ([b for b in slots[rid] if rng.integers(0, 2)]
+                     or slots[rid][:1])
+            for b in share:
+                pool.ref(b, owner=("fork", rid, g))
+            forks.append((rid, g, share))
+        elif op == 2 and forks:
+            # Fork retire/cancel: give back each shared ref exactly once.
+            rid, g, share = forks.pop(int(rng.integers(0, len(forks))))
+            for b in share:
+                pool.unref(b, owner=("fork", rid, g))
+        elif op == 3 and slots:
+            # Parent retires its own tenancy; outstanding fork shares
+            # keep the pages alive (refcount > 0) until the children go.
+            rid = list(slots)[int(rng.integers(0, len(slots)))]
+            for b in slots.pop(rid):
+                pool.unref(b, owner=("slot", rid))
+        elif op == 4:
+            if pool.free_blocks > 4:
+                path, _ = trie.insert(
+                    _toks(rng.integers(0, 4, size=rng.integers(2, 7))))
+                if path and rng.integers(0, 2):
+                    trie.acquire(path)
+                    held.append(path)
+            elif held:
+                trie.release(held.pop())
+        else:
+            trie.evict_one()
+        for bid in range(24):
+            rc, owners = pool.refcount(bid), pool.owners(bid)
+            assert rc == sum(owners.values()), (step, bid, rc, owners)
+    for rid, g, share in forks:
+        for b in share:
+            pool.unref(b, owner=("fork", rid, g))
+    for rid, bids in slots.items():
+        for b in bids:
+            pool.unref(b, owner=("slot", rid))
+    for path in held:
+        trie.release(path)
+    while trie.evict_one() is not None:
+        pass
+    assert pool.used_blocks == 0, "soup leaked pages"
 
 
 # -- engine integration ---------------------------------------------------
