@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: all native test test-fast test-tp test-obs test-sampling bench \
+.PHONY: all native test test-fast test-tp test-obs test-sampling \
+	test-pallas bench \
 	bench-cp bench-serve bench-overload bench-prefix bench-fleet \
 	bench-spec bench-paged bench-tp bench-obs bench-sampling clean stamp
 
@@ -36,6 +37,16 @@ test-obs:
 test-tp:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tp_serving.py -q
+
+# Pallas kernel guard: the fused paged-attention decode kernel in
+# INTERPRET mode on CPU against the XLA gather oracle — the declared
+# kernel tolerance contract, int8 fused dequant, width caps, sentinel
+# clamping, and the engine-level stream equality + traffic gauges.
+# Tier-1 (tests/conftest.py runs it under plain `make test` too); this
+# target is the cheap CI gate for kernel-touching changes.
+test-pallas:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_paged_attention_pallas.py -q
 
 # Sampling-subsystem guard: fixed-seed bit-reproducibility across batch
 # composition / churn / tp, copy-on-write fork sharing + leak freedom,
@@ -111,11 +122,15 @@ bench-paged:
 		--json benchmarks/paged_bench_summary.json
 
 # Tensor-parallel serving benchmark: tp in {1,2,4,8} greedy streams
-# asserted bit-identical to the 1-chip engine BEFORE timing; gates on
-# >=3.5x admissible slots at fixed per-device HBM at tp=4 and no tp=1
-# TTFT regression (<=52.1 ms, measured unsharded in a subprocess) —
-# see benchmarks/RESULTS.md and docs/serving.md. The script forces the
-# 8-virtual-device split itself.
+# asserted bit-identical to the 1-chip engine BEFORE timing (gathered
+# legs; the tp_compute="parallel" legs at tp in {2,4} assert stream
+# equality under the declared psum tolerance contract instead); gates
+# on >=3.5x admissible slots at fixed per-device HBM at tp=4, no tp=1
+# TTFT regression (<=52.1 ms, measured unsharded in a subprocess), and
+# the parallel legs' modeled per-shard traffic (hbm_bytes_per_step /
+# flops_per_token_per_shard) strictly below the gathered legs' at the
+# same tp — see benchmarks/RESULTS.md and docs/serving.md. The script
+# forces the 8-virtual-device split itself.
 bench-tp:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/tp_bench.py \
 		--json benchmarks/tp_bench_summary.json

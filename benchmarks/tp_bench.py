@@ -30,14 +30,28 @@ asserted BEFORE any timing is recorded:
   ms across invocations here), so results inside a 15% noise band pass
   with a warning; only a result beyond the band fails.
 
-The Pareto sweep then records aggregate tokens/sec and
-admissible-slots-at-fixed-per-device-HBM per tp. On the forced-host
-CPU "mesh" the shards are threads of one chip, so tokens/sec REGRESSES
-with tp (the all_gather is pure overhead with no extra FLOPs behind
-it) — reported honestly; the capacity column is the hardware-
-independent win. The sweep uses an 8-KV-head tiny variant so tp=8
-divides evenly; the tp=1 TTFT gate uses the stock tiny config so the
-number is comparable to paged_bench's.
+The Pareto sweep then records aggregate tokens/sec,
+admissible-slots-at-fixed-per-device-HBM, and the engine's analytic
+per-shard traffic gauges (hbm_bytes_per_step / flops_per_token_per
+_shard) per leg. Since the ISSUE 13 compute-parallel mode, each tp in
+{2, 4} runs THREE legs at equal chip count: ``tp_compute="gathered"``
+(the bitwise oracle), ``tp_compute="parallel"`` (Megatron column/row
+split — 1/tp of every projection per shard, one psum per block), and
+parallel with ``attn_impl="pallas"`` (the fused paged-attention kernel;
+interpret mode on CPU). Parallel legs assert token-stream equality
+against tp=1 BEFORE timing — the psum tolerance contract
+(gen.tp_parallel_tolerance) lives in the logits and is pinned by
+tests/test_tp_serving.py; a flipped token would fail here. Deterministic
+gates: the parallel legs' modeled per-shard FLOPs and HBM bytes must be
+strictly below the gathered legs' at the same tp, and the Pallas legs'
+HBM bytes strictly below their XLA twins (the 3x->1x KV round trip).
+Measured tokens/sec is reported honestly per leg: on the forced-host
+CPU "mesh" the shards are threads of one chip, so gathered tp REGRESSES
+throughput (the all_gather is pure overhead), while the parallel legs
+recover real speed by cutting per-shard FLOPs tp-fold. The sweep uses
+an 8-KV-head tiny variant so tp=8 divides evenly; the tp=1 TTFT gate
+uses the stock tiny config so the number is comparable to
+paged_bench's.
 
 Prints one JSON object; with ``--json`` also writes it to a file. Run
 via ``make bench-tp`` (sets the 8-virtual-device XLA flag).
@@ -219,12 +233,29 @@ def main(argv=None) -> int:
                    prefill_mode="bucketed", block_size=args.block_size,
                    prefix_cache=True)
 
-    # ---- gate 1: bit-exactness BEFORE timing ----------------------------
-    def streams(tp):
+    # The leg grid: every sweep tp runs the gathered oracle; tp in
+    # {2, 4} adds the Megatron compute-parallel leg and its Pallas
+    # twin at EQUAL chip count (the acceptance comparison).
+    legs = []
+    for tp in sweep_tps:
+        legs.append((tp, "gathered", "xla"))
+        if tp in (2, 4):
+            legs.append((tp, "parallel", "xla"))
+            legs.append((tp, "parallel", "pallas"))
+
+    # ---- gate 1: stream equality BEFORE timing --------------------------
+    # Gathered legs are BITWISE (no reduction reassociated — a tripwire,
+    # not a tolerance). Parallel legs reassociate the contraction sum in
+    # one psum per block, so their LOGITS carry the declared per-tp
+    # tolerance contract (gen.tp_parallel_tolerance, pinned in
+    # tests/test_tp_serving.py) — but the greedy token STREAMS must
+    # still match tp=1 on this workload, and that is asserted here.
+    def streams(tp, tp_compute="gathered", attn_impl="xla"):
         from kubeflow_controller_tpu.dataplane.serving_engine import (
             Request, ServingEngine,
         )
-        eng = ServingEngine(cfg, params, tp=tp, **base_kw)
+        eng = ServingEngine(cfg, params, tp=tp, tp_compute=tp_compute,
+                            attn_impl=attn_impl, **base_kw)
         out = eng.run([Request(rid=r.rid, prompt=r.prompt.copy(),
                                max_new_tokens=r.max_new_tokens)
                        for r in reqs])
@@ -232,24 +263,27 @@ def main(argv=None) -> int:
 
     base_streams = streams(1)
     divergent = []
-    for tp in sweep_tps:
-        if tp == 1:
+    for tp, mode, attn in legs:
+        if (tp, mode, attn) == (1, "gathered", "xla"):
             continue
-        if streams(tp) != base_streams:
-            divergent.append(tp)
+        if streams(tp, mode, attn) != base_streams:
+            divergent.append(f"tp={tp}/{mode}/{attn}")
     if divergent:
-        print(f"BIT-EXACTNESS FAILURE at tp {divergent}; refusing to "
+        print(f"STREAM-EQUALITY FAILURE at {divergent}; refusing to "
               f"time a divergent engine")
         return 1
 
-    # ---- Pareto sweep: tokens/sec + capacity per tp ---------------------
+    # ---- Pareto sweep: tokens/sec + capacity + traffic per leg ----------
     budget = args.budget_mb << 20
     pareto = []
-    for tp in sweep_tps:
+    for tp, mode, attn in legs:
         _, summ, eng = run_engine(cfg, params, reqs, args.repeats,
-                                  tp=tp, **base_kw)
+                                  tp=tp, tp_compute=mode, attn_impl=attn,
+                                  **base_kw)
         pareto.append({
             "tp": tp,
+            "tp_compute": mode,
+            "attn_impl": attn,
             "tokens_per_sec": round(summ["tokens_per_sec"], 1),
             "ttft_p50_ms": summ["ttft_p50_ms"],
             "admissible_slots_at_fixed_per_device_hbm":
@@ -258,10 +292,39 @@ def main(argv=None) -> int:
             "kv_hbm_per_device_mb": round(
                 eng.stats.kv_hbm_per_device_mb, 3),
             "pool_blocks_per_shard": eng.stats.pool_blocks_per_shard,
+            "hbm_bytes_per_step": int(eng.stats.hbm_bytes_per_step),
+            "flops_per_token_per_shard": int(
+                eng.stats.flops_per_token_per_shard),
         })
+    by_leg = {(r["tp"], r["tp_compute"], r["attn_impl"]): r
+              for r in pareto}
     cap = {r["tp"]: r["admissible_slots_at_fixed_per_device_hbm"]
-           for r in pareto}
+           for r in pareto if r["tp_compute"] == "gathered"}
     cap_ratio_tp4 = (cap[4] / cap[1]) if (1 in cap and 4 in cap) else None
+
+    # Deterministic traffic gates + the measured speed comparison at
+    # equal chip count.
+    traffic_failures = []
+    speedups = {}
+    for tp in (2, 4):
+        g = by_leg.get((tp, "gathered", "xla"))
+        par = by_leg.get((tp, "parallel", "xla"))
+        pal = by_leg.get((tp, "parallel", "pallas"))
+        if not (g and par):
+            continue
+        if not (par["flops_per_token_per_shard"]
+                < g["flops_per_token_per_shard"]):
+            traffic_failures.append(f"tp={tp}: parallel FLOPs not below "
+                                    f"gathered")
+        if not (par["hbm_bytes_per_step"] < g["hbm_bytes_per_step"]):
+            traffic_failures.append(f"tp={tp}: parallel HBM bytes not "
+                                    f"below gathered")
+        if pal and not (pal["hbm_bytes_per_step"]
+                        < par["hbm_bytes_per_step"]):
+            traffic_failures.append(f"tp={tp}: pallas HBM bytes not "
+                                    f"below the XLA gather leg")
+        speedups[f"tp{tp}"] = round(
+            par["tokens_per_sec"] / g["tokens_per_sec"], 3)
 
     # ---- gate 3: tp=1 TTFT on the stock config (vs PR 8) ----------------
     gate_sum = run_gate_subprocess(args)
@@ -271,6 +334,10 @@ def main(argv=None) -> int:
         "value": round(cap_ratio_tp4, 2) if cap_ratio_tp4 else None,
         "unit": "x admissible slots per device, tp=4 vs tp=1",
         "bit_exact": {f"tp={t}": True for t in sweep_tps if t != 1},
+        "stream_equal": {f"tp={t}/{m}/{a}": True
+                         for t, m, a in legs
+                         if (t, m, a) != (1, "gathered", "xla")},
+        "speedup_parallel_vs_gathered": speedups,
         "pareto": pareto,
         "budget_mb_per_device": args.budget_mb,
         "tp1_ttft_p50_ms": gate_sum["ttft_p50_ms"],
@@ -289,6 +356,19 @@ def main(argv=None) -> int:
         print(f"CAPACITY BELOW TARGET: {cap_ratio_tp4:.2f}x <"
               f" {CAPACITY_GATE_TP4}x at tp=4")
         return 1
+    if traffic_failures:
+        print("TRAFFIC-MODEL GATE FAILURE: " + "; ".join(traffic_failures))
+        return 1
+    slow = {k: v for k, v in speedups.items() if v <= 1.0}
+    if slow:
+        # Measured speed is host-noise-exposed in a way the modeled
+        # traffic is not; report loudly but only fail when parallel is
+        # decisively slower than the gathered leg it replaces.
+        print(f"note: parallel legs not faster than gathered on this "
+              f"host: {slow}", file=sys.stderr)
+        if any(v < 0.85 for v in slow.values()):
+            print(f"PARALLEL SLOWER THAN GATHERED beyond noise: {slow}")
+            return 1
     ttft = gate_sum["ttft_p50_ms"]
     if ttft > TTFT_GATE_MS * (1 + TTFT_NOISE_TOL):
         print(f"TP=1 TTFT REGRESSION: {ttft:.1f} ms >"

@@ -162,6 +162,8 @@ def serve(
     draft_k: int = 4,
     proposer: str = "prompt",
     tp: int = 1,
+    tp_compute: str = "gathered",
+    attn_impl: str = "xla",
     mesh_devices: str = "",
     trace: str = "",
     stop=None,
@@ -215,7 +217,7 @@ def serve(
     if tp > 1:
         import jax
 
-        gen.check_tp_heads(cfg, tp)
+        gen.check_tp_heads(cfg, tp, tp_compute)
         devs = None
         if mesh_devices:
             all_devs = jax.devices()
@@ -264,7 +266,8 @@ def serve(
             prefix_cache=prefix_cache, block_size=block_size,
             kv_hbm_budget_mb=kv_pool_mb, kv_quant=kv_quant, paged=paged,
             spec_decode=speculative, draft_k=draft_k, proposer=proposer,
-            tp=tp, mesh=mesh, tracer=tracer,
+            tp=tp, mesh=mesh, tp_compute=tp_compute, attn_impl=attn_impl,
+            tracer=tracer,
         )
         # One shared per-request params object: sampling state is keyed
         # on (seed, gen, position), so requests never share mutable RNG
@@ -339,7 +342,8 @@ def serve(
             block_size=block_size, kv_hbm_budget_mb=kv_pool_mb,
             kv_quant=kv_quant, paged=paged,
             spec_decode=speculative, draft_k=draft_k, proposer=proposer,
-            tp=tp, mesh=mesh, tracer=tracer,
+            tp=tp, mesh=mesh, tp_compute=tp_compute, attn_impl=attn_impl,
+            tracer=tracer,
         )
         prompts_np = np.asarray(prompts)
         history = [list(map(int, prompts_np[i])) for i in range(b)]
@@ -576,6 +580,24 @@ def main(argv=None) -> int:
                         "stay bit-identical to tp=1 and pooled KV "
                         "capacity at fixed per-device HBM scales ~tp x "
                         "(requires n_kv_heads %% tp == 0)")
+    p.add_argument("--tp-compute", default="gathered",
+                   choices=["gathered", "parallel"],
+                   help="what the per-shard kernels do with the stored "
+                        "weight shards: gathered = all-gather at "
+                        "dispatch (bitwise tp=1 streams; tp is a "
+                        "capacity knob only); parallel = Megatron "
+                        "column/row-parallel matmuls — each shard runs "
+                        "1/tp of every projection with one psum per "
+                        "block, greedy outputs within the declared "
+                        "per-tp tolerance contract "
+                        "(docs/serving.md; requires d_ff %% tp == 0)")
+    p.add_argument("--attn-impl", default="xla",
+                   choices=["xla", "pallas"],
+                   help="paged decode attention: xla = dense KV view "
+                        "gather (the bit-exactness oracle); pallas = "
+                        "fused flash-style kernel streaming pool pages "
+                        "through VMEM once, int8 dequant fused into the "
+                        "page load (output within a few ulps of xla)")
     p.add_argument("--mesh", default="",
                    help="comma-separated device indices to build the "
                         "serving mesh from (e.g. '0,1,2,3'; default: "
@@ -588,7 +610,8 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.tp > 1:
         try:
-            gen.check_tp_heads(CONFIGS[args.config](), args.tp)
+            gen.check_tp_heads(
+                CONFIGS[args.config](), args.tp, args.tp_compute)
         except ValueError as e:
             p.error(str(e))
     # Sampling flag validation up front via argparse (usage + exit 2),
@@ -654,6 +677,8 @@ def main(argv=None) -> int:
         draft_k=args.draft_k,
         proposer=args.proposer,
         tp=args.tp,
+        tp_compute=args.tp_compute,
+        attn_impl=args.attn_impl,
         mesh_devices=args.mesh,
         trace=args.trace,
         stop=stop,

@@ -120,6 +120,18 @@ class ServingStats:
     tp: int = 1
     pool_blocks_per_shard: int = 0
     kv_hbm_per_device_mb: float = 0.0
+    # Analytic per-step traffic model (docs/serving.md "Tensor-parallel
+    # serving"): ``hbm_bytes_per_step`` is the weight + KV bytes one
+    # decode step reads per shard at the current occupancy-capped view
+    # width (tp_compute="parallel" divides the col/row-parallel weight
+    # bytes by tp; attn_impl="pallas" drops the 3x gather round trip to
+    # 1x), and ``flops_per_token_per_shard`` the matmul + attention
+    # FLOPs a shard spends per decoded token. Gauges, refreshed by the
+    # engine every quantum and mirrored to the obs registry under
+    # ``dataplane.*`` — the numbers tp_bench's Pareto sweep reports
+    # next to tokens/sec.
+    hbm_bytes_per_step: float = 0.0
+    flops_per_token_per_shard: float = 0.0
     # Speculative decoding (docs/serving.md "Speculative decoding"):
     # ``draft_proposed`` counts draft tokens sent to the verifier,
     # ``draft_accepted`` those that committed (acceptance_rate is their
@@ -235,6 +247,9 @@ class ServingStats:
             "tp": float(self.tp),
             "pool_blocks_per_shard": float(self.pool_blocks_per_shard),
             "kv_hbm_per_device_mb": float(self.kv_hbm_per_device_mb),
+            "hbm_bytes_per_step": float(self.hbm_bytes_per_step),
+            "flops_per_token_per_shard": float(
+                self.flops_per_token_per_shard),
             "draft_proposed": float(self.draft_proposed),
             "draft_accepted": float(self.draft_accepted),
             "acceptance_rate": self.acceptance_rate,

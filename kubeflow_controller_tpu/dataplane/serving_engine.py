@@ -139,7 +139,7 @@ import threading
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -407,6 +407,8 @@ class ServingEngine:
         spec_cooldown_max: int = 256,
         tp: int = 1,
         mesh=None,
+        tp_compute: str = "gathered",
+        attn_impl: str = "xla",
         tracer: Optional[Tracer] = None,
     ):
         self.cfg = cfg
@@ -481,10 +483,28 @@ class ServingEngine:
         # Tensor-parallel serving: resolve the mesh FIRST (an explicit
         # mesh wins; else a 1-D tp mesh over the first tp devices; tp<=1
         # means no mesh at all — the single-chip engine runs today's
-        # exact unsharded code path). With a mesh, weights place
-        # storage-sharded (per-device weight HBM ~1/tp; the kernels
-        # declare them replicated and XLA gathers at dispatch — bytes
-        # move, never change) and the pool places KVH-sharded.
+        # exact unsharded code path). Weights place storage-sharded
+        # either way (per-device weight HBM ~1/tp) and the pool places
+        # KVH-sharded; tp_compute picks what the kernels do with the
+        # stored shards: "gathered" declares them replicated (XLA
+        # gathers at dispatch — bytes move, never change; fp greedy
+        # bitwise 1-chip), "parallel" consumes them in place (Megatron
+        # column/row split, 1/tp of every projection per shard, one
+        # psum per block, within gen.tp_parallel_tolerance).
+        if tp_compute not in ("gathered", "parallel"):
+            raise ValueError(
+                f"tp_compute must be 'gathered' or 'parallel' "
+                f"(got {tp_compute!r})"
+            )
+        if attn_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"attn_impl must be 'xla' or 'pallas' (got {attn_impl!r})"
+            )
+        self.tp_compute = tp_compute
+        self.attn_impl = attn_impl
+        # View width of the most recent dispatch (refreshed by
+        # _view_width); feeds the analytic per-step traffic model.
+        self._last_vw = 0
         if mesh is not None:
             self._mesh = mesh
             self.tp = gen.tp_size(mesh)
@@ -492,15 +512,21 @@ class ServingEngine:
             self.tp = max(1, int(tp))
             self._mesh = mesh_lib.serving_mesh(self.tp)
         self._repl = None
+        self._w_quant = ""
         if self._mesh is not None:
-            gen.check_tp_heads(cfg, self.tp)
+            gen.check_tp_heads(cfg, self.tp, tp_compute)
             wq = (params.get("layers", {}).get("wq")
                   if isinstance(params, dict) else None)
             w_quant = "int8" if isinstance(wq, tuple) else ""
+            self._w_quant = w_quant
             self.params = sharding_lib.shard_serving_params(
                 cfg, params, self._mesh, w_quant)
             self._repl = jax.sharding.NamedSharding(
                 self._mesh, jax.sharding.PartitionSpec())
+        else:
+            wq = (params.get("layers", {}).get("wq")
+                  if isinstance(params, dict) else None)
+            self._w_quant = "int8" if isinstance(wq, tuple) else ""
         if not paged:
             raise ValueError(
                 "the contiguous engine path was removed in PR 8 — the "
@@ -668,6 +694,8 @@ class ServingEngine:
         # compiles once per distinct prompt length.
         chunk = self.decode_chunk
         mesh_ = self._mesh
+        tp_compute_ = self.tp_compute
+        attn_impl_ = self.attn_impl
 
         def _make_step(vw):
             def _micro(carry, _k, eos, budget, params):
@@ -676,7 +704,8 @@ class ServingEngine:
                 was_active = cache.active
                 new_logits, cache = gen.decode_step_paged(
                     cfg, params, toks[:, None], cache, mesh=mesh_,
-                    view_width=vw)
+                    view_width=vw, tp_compute=tp_compute_,
+                    attn_impl=attn_impl_)
                 # On-device retirement: this token IS decoded (the
                 # stream includes EOS), then the row goes inactive for
                 # every later micro-step until readmission. Its later
@@ -725,7 +754,8 @@ class ServingEngine:
                 was_active = cache.active
                 new_logits, cache = gen.decode_step_paged(
                     cfg, params, toks[:, None], cache, mesh=mesh_,
-                    view_width=vw)
+                    view_width=vw, tp_compute=tp_compute_,
+                    attn_impl=attn_impl_)
                 emitted = jnp.where(was_active, emitted + 1, emitted)
                 done = was_active & ((toks == eos) | (emitted >= budget))
                 cache = cache._replace(active=cache.active & ~done)
@@ -765,7 +795,8 @@ class ServingEngine:
                 was_active = cache.active
                 new_logits, cache = gen.decode_step_paged(
                     cfg, params, toks[:, None], cache, mesh=mesh_,
-                    view_width=vw)
+                    view_width=vw, tp_compute=tp_compute_,
+                    attn_impl=attn_impl_)
                 emitted = jnp.where(was_active, emitted + 1, emitted)
                 done = was_active & ((toks == eos) | (emitted >= budget))
                 cache = cache._replace(active=cache.active & ~done)
@@ -806,20 +837,27 @@ class ServingEngine:
         if self.spec_decode:
             k_draft = self.draft_k
 
-            def _make_spec():
-                # Verify always gathers the FULL table span. The K+1-wide
+            def _make_spec(vw):
+                # Verify gathers at the SAME occupancy-capped width as
+                # decode (satellite of the paged_kv_view cap: the engine's
+                # view width always covers every live slot's reserved
+                # span, so no attended column is lost). The K+1-wide
                 # verify attention is a real matmul whose width-W
                 # reduction XLA tiles differently at different W — unlike
-                # the decode matvec, trailing exactly-zero masked terms
-                # do NOT leave the partial sums bitwise-unchanged. Verify
-                # fires only on spec quanta, so the capped gather stays
-                # where it pays: the hot decode path.
+                # the decode matvec, trailing exactly-zero masked terms do
+                # NOT leave the partial sums bitwise-unchanged; that
+                # ~1-ulp retiling drift is a DECLARED tolerance contract
+                # now (tests/test_paged_attention.py:
+                # test_verify_width_tolerance_contract), not test luck,
+                # which is what lets the hot verify path buy the same
+                # capped-gather savings as decode.
                 def _spec(params, logits, cache, eos, budget, emitted,
                           draft, dlen):
                     max_commit = jnp.maximum(budget - emitted, 1)
                     window, n, new_logits, cache = gen.verify_step_paged(
                         cfg, params, draft, dlen, logits, cache, eos,
-                        max_commit, mesh=mesh_)
+                        max_commit, mesh=mesh_, view_width=vw,
+                        tp_compute=tp_compute_)
                     emitted = emitted + n      # n = 0 on inactive rows
                     in_commit = (jnp.arange(k_draft + 1, dtype=jnp.int32)
                                  [None, :] < n[:, None])
@@ -835,9 +873,10 @@ class ServingEngine:
 
                 return jax.jit(_spec, donate_argnums=(1, 2, 5))
 
-            self._spec_step = _make_spec()
+            self._make_spec = _make_spec
+            self._spec_steps: Dict[int, Callable] = {}
 
-            def _make_spec_sampled():
+            def _make_spec_sampled(vw):
                 # Sampled verify: same fused forward, but acceptance is
                 # the speculative-sampling rule specialized to the
                 # deterministic draft (generate.verify_step_paged_sampled)
@@ -851,7 +890,8 @@ class ServingEngine:
                      cache) = gen.verify_step_paged_sampled(
                         cfg, params, draft, dlen, logits, cache, eos,
                         max_commit, temp, tk, tp_p, seed_v, gen_v,
-                        emitted, mesh=mesh_)
+                        emitted, mesh=mesh_, view_width=vw,
+                        tp_compute=tp_compute_)
                     emitted = emitted + n
                     in_commit = (jnp.arange(k_draft + 1, dtype=jnp.int32)
                                  [None, :] < n[:, None])
@@ -866,15 +906,18 @@ class ServingEngine:
 
                 return jax.jit(_spec, donate_argnums=(1, 2, 5))
 
-            self._spec_step_sampled = _make_spec_sampled()
+            self._make_spec_sampled = _make_spec_sampled
+            self._spec_steps_sampled: Dict[int, Callable] = {}
         # Exact-mode per-length admission memo, LRU-bounded (satellite of
         # the compile-explosion fix: even the fallback path cannot grow
         # without limit).
         self._admits: "OrderedDict[int, Callable]" = OrderedDict()
-        # Bucketed-mode per-width chunk memo: widths are {block_size} u
-        # {powers of two < block_size}, so this holds O(log block_size)
-        # entries for the engine's lifetime — no cap needed.
-        self._chunks: Dict[int, Callable] = {}
+        # Bucketed-mode per-(chunk width, view width) memo: chunk widths
+        # are {block_size} u {powers of two < block_size} and view widths
+        # are powers of two <= the table span, so this holds
+        # O(log block_size * log max_blocks) entries for the engine's
+        # lifetime — no cap needed.
+        self._chunks: Dict[Tuple[int, int], Callable] = {}
         # Cumulative prefill compiles since engine construction (exact
         # lengths + bucket widths); survives reset() because the
         # compiled functions do too.
@@ -1194,7 +1237,8 @@ class ServingEngine:
         while nb < mb:
             nb *= 2
         nb = max(1, min(nb, self._max_blocks))
-        return nb * self.block_size
+        self._last_vw = nb * self.block_size
+        return self._last_vw
 
     def _step_fn(self, params, logits, cache, eos, budget, emitted, key):
         """Dispatch the fused decode chunk compiled for the current
@@ -1238,10 +1282,24 @@ class ServingEngine:
 
     def _spec_fn(self, params, logits, cache, eos, budget, emitted,
                  draft, dlen):
-        """Dispatch the fused draft-verify step (always full table
-        width — see _make_spec for why verify is never view-capped)."""
-        return self._spec_step(params, logits, cache, eos, budget,
-                               emitted, draft, dlen)
+        """Dispatch the fused draft-verify step at the current
+        occupancy-capped view width (same per-width memo discipline as
+        decode; the retiling drift this admits is a declared tolerance
+        contract — see _make_spec)."""
+        vw = self._view_width()
+        fn = self._spec_steps.get(vw)
+        if fn is None:
+            fn = self._spec_steps[vw] = self._make_spec(vw)
+        return fn(params, logits, cache, eos, budget, emitted, draft,
+                  dlen)
+
+    def _spec_fn_sampled(self, *args):
+        """Sampled twin of :meth:`_spec_fn` (same per-width memo)."""
+        vw = self._view_width()
+        fn = self._spec_steps_sampled.get(vw)
+        if fn is None:
+            fn = self._spec_steps_sampled[vw] = self._make_spec_sampled(vw)
+        return fn(*args)
 
     def _blocks_needed(self, prompt_size: int, max_new: int) -> int:
         """Pages covering the request's whole prompt+budget span."""
@@ -1336,10 +1394,13 @@ class ServingEngine:
         cfg = self.cfg
         mesh_ = self._mesh
 
+        tp_compute_ = self.tp_compute
+
         def admit(params, prompt, cache, logits_buf, eos, budget,
                   emitted, slot, eos_val, budget_val):
             row_logits, cache = gen.prefill_into_paged(
-                cfg, params, prompt, cache, slot, mesh=mesh_)
+                cfg, params, prompt, cache, slot, mesh=mesh_,
+                tp_compute=tp_compute_)
             logits_buf = jax.lax.dynamic_update_slice(
                 logits_buf, row_logits.astype(logits_buf.dtype),
                 (slot, 0))
@@ -1357,21 +1418,27 @@ class ServingEngine:
 
     def _chunk_fn(self, w: int) -> Callable:
         """Jitted (one prefill chunk -> slot row) for padded chunk width
-        ``w`` — a power of two <= block_size, so the whole memo holds
-        O(log block_size) entries ever. Installs the chunk's logits row
-        and the slot's retirement rule; ``activate`` flips the row live
-        on the final chunk only."""
-        fn = self._chunks.get(w)
+        ``w`` — a power of two <= block_size — at the current
+        occupancy-capped view width, so the whole memo holds
+        O(log block_size * log max_blocks) entries ever. The view width
+        always covers the admitted slot's reserved span (reservation
+        precedes the first chunk), so capping the slot's page gather
+        loses no attended column. Installs the chunk's logits row and
+        the slot's retirement rule; ``activate`` flips the row live on
+        the final chunk only."""
+        vw = self._view_width()
+        fn = self._chunks.get((w, vw))
         if fn is not None:
             return fn
         cfg = self.cfg
         mesh_ = self._mesh
+        tp_compute_ = self.tp_compute
 
         def chunk(params, toks, cache, logits_buf, eos, budget, emitted,
                   slot, offset, n_real, eos_val, budget_val, activate):
             row_logits, cache = gen.prefill_chunk_paged(
                 cfg, params, toks, cache, slot, offset, n_real,
-                mesh=mesh_)
+                mesh=mesh_, view_width=vw, tp_compute=tp_compute_)
             logits_buf = jax.lax.dynamic_update_slice(
                 logits_buf, row_logits.astype(logits_buf.dtype),
                 (slot, 0))
@@ -1382,7 +1449,7 @@ class ServingEngine:
                 active=cache.active.at[slot].set(activate))
             return cache, logits_buf, eos, budget, emitted
 
-        fn = self._chunks[w] = jax.jit(
+        fn = self._chunks[(w, vw)] = jax.jit(
             chunk, donate_argnums=(2, 3, 4, 5, 6))
         self._prefill_compiles += 1
         return fn
@@ -2029,7 +2096,7 @@ class ServingEngine:
                 if self._sampled_in(snapshot):
                     self._push_sampling()
                     window, n, next_tok, self.logits, self.cache, \
-                        self.emitted = self._spec_step_sampled(
+                        self.emitted = self._spec_fn_sampled(
                             self.params, self.logits, self.cache,
                             self.eos, self.budget, self.emitted,
                             jnp.asarray(draft), jnp.asarray(dlen),
@@ -2235,6 +2302,58 @@ class ServingEngine:
             self._record_completion(c)
         return finished
 
+    def _traffic_model(self) -> Tuple[float, float]:
+        """Analytic per-step traffic this engine's configuration moves,
+        per shard: ``(hbm_bytes_per_step, flops_per_token_per_shard)``.
+
+        Decode is bandwidth-bound, so the model counts the two streams
+        that dominate a step's HBM reads and lets tp_bench report
+        *traffic*, not just tokens/sec:
+
+        * **weights** — every projection is read once per step. Under
+          ``tp_compute="parallel"`` the column/row-parallel weights are
+          consumed as stored shards, so their bytes divide by tp; under
+          ``"gathered"`` each shard materializes the full weight at
+          dispatch (the all-gather moves the missing (tp-1)/tp from
+          peers, but the shard still reads/writes full-size operands).
+          int8 weight-only cuts the per-element cost to one byte.
+        * **KV** — each live slot's view-width span of pool pages. The
+          XLA gather path pays 3x per byte (pool read, dense-view
+          write, view read back into attention); the Pallas kernel
+          streams pages through VMEM once.
+
+        FLOPs per token per shard: 2 flops per weight param touched
+        (matmul), plus the two attention einsums over the view width on
+        the shard's local heads, plus the lm_head. Both numbers are
+        *models*, not counters — they exist so the bench's Pareto sweep
+        can show parallel-vs-gathered and pallas-vs-xla moving the
+        bytes the docs claim they move.
+        """
+        cfg = self.cfg
+        tp = max(self.tp, 1)
+        hd = cfg.head_dim
+        L = cfg.n_layers
+        parallel = self.tp_compute == "parallel" and tp > 1
+        div = tp if parallel else 1
+        # Per-layer projection param counts, split by parallel class.
+        col = (cfg.d_model * (cfg.n_heads + 2 * cfg.n_kv_heads) * hd
+               + 2 * cfg.d_model * cfg.d_ff)
+        row = (cfg.n_heads * hd * cfg.d_model
+               + cfg.d_ff * cfg.d_model)
+        local_params = L * (col + row) / div + cfg.d_model * cfg.vocab_size
+        per_elem = (1 if self._w_quant == "int8"
+                    else jnp.dtype(cfg.dtype).itemsize)
+        weight_bytes = local_params * per_elem
+        vw = self._last_vw or self._view_width()
+        kv_factor = 1 if self.attn_impl == "pallas" else 3
+        kv_bytes = (kv_factor * self.n_slots * vw
+                    * kv_blocks.kv_bytes_per_token(cfg, self.kv_quant, tp))
+        # Attention runs on the shard's head slice in BOTH tp modes
+        # (gathered slices heads, parallel projects them locally).
+        local_heads = cfg.n_heads // tp if tp > 1 else cfg.n_heads
+        flops = 2.0 * local_params + 4.0 * vw * local_heads * hd * L
+        return weight_bytes + kv_bytes, flops
+
     def _sync_stats(self) -> None:
         """Refresh the gauges ServingStats carries alongside its
         counters: compile-cache sizes and block-pool occupancy. The pool
@@ -2277,6 +2396,14 @@ class ServingEngine:
             self.stats.fork_shared_tokens)
         reg.gauge("mask_tokens_filtered", "serving").set(
             self.stats.mask_tokens_filtered)
+        # Analytic per-step traffic (satellite of the compute-parallel
+        # PR): published under dataplane.* so tp_bench and fleet
+        # dashboards read measured-model traffic next to tokens/sec.
+        hbm_bytes, flops = self._traffic_model()
+        self.stats.hbm_bytes_per_step = hbm_bytes
+        self.stats.flops_per_token_per_shard = flops
+        reg.gauge("hbm_bytes_per_step", "dataplane").set(hbm_bytes)
+        reg.gauge("flops_per_token_per_shard", "dataplane").set(flops)
 
     def _book_token(self, i: int, slot: _Slot, tok: int,
                     now: float) -> Optional[Completion]:

@@ -639,17 +639,38 @@ def _pool_write(pool, scale, idx, val):
 # The paged kernels run under shard_map on a 1-D "tp" mesh
 # (parallel.mesh.serving_mesh): the pool's KVH axis is split across
 # shards, every host-visible table/length/active array and the logits
-# are replicated, and weights are DECLARED replicated (in_specs P()) so
-# XLA all-gathers the NamedSharding-stored shards at dispatch — data
-# movement only, never different bytes. Per shard the kernels compute
-# the FULL q/k/v projections + rope (bitwise the 1-chip values, every
-# input being replicated), slice the shard's contiguous KV-head group,
-# run the contiguous attention math on it unchanged (GQA attention is
-# independent per KV head; the per-element dot products over head_dim
-# and the softmax over positions never see the head count), and
-# all_gather the head outputs — an exact concatenation. fp greedy is
-# therefore bit-identical to the 1-chip engine by construction, the
-# same argument PR 8 used for paging (pinned by tests/test_tp_serving).
+# are replicated. Two compute placements share those cache specs:
+#
+# tp_compute="gathered" (the bitwise oracle): weights are DECLARED
+# replicated (in_specs P()) so XLA all-gathers the NamedSharding-stored
+# shards at dispatch — data movement only, never different bytes. Per
+# shard the kernels compute the FULL q/k/v projections + rope (bitwise
+# the 1-chip values, every input being replicated), slice the shard's
+# contiguous KV-head group, run the contiguous attention math on it
+# unchanged (GQA attention is independent per KV head; the per-element
+# dot products over head_dim and the softmax over positions never see
+# the head count), and all_gather the head outputs — an exact
+# concatenation. fp greedy is therefore bit-identical to the 1-chip
+# engine by construction, the same argument PR 8 used for paging
+# (pinned by tests/test_tp_serving).
+#
+# tp_compute="parallel" (Megatron column/row split): weights enter the
+# kernels in their stored shards (parallel.sharding.
+# tp_compute_param_specs) — wq/wk/wv and w_gate/w_up column-parallel on
+# the output axis, wo/w_down row-parallel on the contraction axis — so
+# each shard runs 1/tp of every projection. A column slice of wq IS a
+# contiguous head range (head h lives in output columns [h*hd,
+# (h+1)*hd)), so the local q/k/v reshape lands on exactly the KV-head
+# group `_tp_slice_heads` used to cut out of the full projection, rope
+# commutes with the head slice (it acts per head over head_dim), and
+# the attention math between projections is the gathered path's code
+# verbatim. The only new collective is one lax.psum after wo and one
+# after w_down (completing the row-parallel contractions); psum
+# reassociates those two reductions, so parallel-vs-gathered is a
+# declared per-tp tolerance contract (`tp_parallel_tolerance`, pinned
+# by tests/test_tp_serving) rather than bitwise — every shard still
+# receives the SAME psum result, so activations and logits stay
+# replicated across shards and greedy decisions are shard-independent.
 
 _TP_POOL_SPEC = P(None, None, None, "tp", None)   # [L, nb, bs, KVH, D]
 _TP_SCALE_SPEC = P(None, None, None, "tp")        # [L, nb, bs, KVH]
@@ -662,22 +683,72 @@ def tp_size(mesh: Optional[Mesh]) -> int:
     return int(mesh.shape.get("tp", 1))
 
 
-def check_tp_heads(cfg: TransformerConfig, tp: int) -> None:
-    """Refuse non-divisible head counts BEFORE any XLA sharding error:
-    KV heads split across the tp axis, so ``n_kv_heads % tp`` must be 0
-    (which also divides ``n_heads`` — GQA requires n_kv_heads | n_heads)."""
-    if tp > 1 and cfg.n_kv_heads % tp:
-        raise ValueError(
-            f"tensor-parallel serving shards KV heads across tp, so "
-            f"n_kv_heads must be divisible by tp (n_kv_heads="
-            f"{cfg.n_kv_heads}, tp={tp}). Pick tp from the divisors of "
-            f"n_kv_heads, or reshape the model."
+def check_tp_heads(cfg: TransformerConfig, tp: int,
+                   tp_compute: str = "gathered") -> None:
+    """Refuse non-shardable configs BEFORE any XLA sharding error, in
+    ONE structured message listing every violated axis (so an operator
+    fixes the config once, not once per retry):
+
+    - ``n_kv_heads % tp`` must be 0 — KV heads split across the tp axis
+      (which also divides ``n_heads``: GQA requires n_kv_heads | n_heads).
+    - ``d_ff % tp`` must be 0 under ``tp_compute="parallel"`` — the MLP
+      hidden axis is column-split across shards there (the gathered
+      path never splits d_ff compute, so it only needs the head rule).
+    - MoE configs are refused outright under tp > 1 (expert dispatch is
+      mesh-size-dependent at trace time).
+
+    The same refusal fires at arg-parse (``serve_lm``), at engine
+    construction, and inside every paged kernel's mesh wrapper."""
+    if tp <= 1:
+        return
+    problems = []
+    if cfg.n_kv_heads % tp:
+        problems.append(
+            f"n_kv_heads must be divisible by tp — KV heads split "
+            f"across the tp axis (n_kv_heads={cfg.n_kv_heads}, tp={tp}); "
+            f"pick tp from the divisors of n_kv_heads, or reshape the "
+            f"model"
         )
-    if tp > 1 and cfg.moe_experts:
-        raise ValueError(
-            "tensor-parallel serving does not support MoE configs yet "
-            "(expert dispatch is mesh-size-dependent at trace time)"
+    if tp_compute == "parallel" and cfg.d_ff % tp:
+        problems.append(
+            f"d_ff must be divisible by tp under tp_compute='parallel' "
+            f"— the MLP hidden axis is column-split across shards "
+            f"(d_ff={cfg.d_ff}, tp={tp}); use tp_compute='gathered' or "
+            f"pick tp from the divisors of d_ff"
         )
+    if cfg.moe_experts:
+        problems.append(
+            "MoE configs are not supported under tensor-parallel "
+            "serving yet (expert dispatch is mesh-size-dependent at "
+            "trace time)"
+        )
+    if problems:
+        raise ValueError(
+            "tensor-parallel serving refused this config:\n  - "
+            + "\n  - ".join(problems)
+        )
+
+
+def tp_parallel_tolerance(cfg: TransformerConfig, tp: int) -> Dict[str, float]:
+    """The declared per-tp logits tolerance for ``tp_compute="parallel"``
+    vs the gathered/1-chip oracle.
+
+    Row-parallel wo/w_down split one contraction into ``tp`` partial
+    products combined by a psum — the same bytes in a different
+    summation tree, so outputs drift by a few ulps per block instead of
+    matching bitwise (the gathered path keeps the 1-chip reduction
+    order and stays the bitwise oracle). Modeled like the int8 KV error
+    model in docs/serving.md as a *bounded perturbation*: two
+    reassociated reductions per layer plus the head matmul, each
+    contributing O(tp·eps) relative error in the fp32 accumulators,
+    composed over depth as a random walk (sqrt growth), with a 16×
+    safety factor. tests/test_tp_serving.py pins both sides of the
+    contract: measured drift stays under this bound, and greedy token
+    streams on the gated workloads are equal outright."""
+    eps = float(jnp.finfo(jnp.promote_types(cfg.dtype, jnp.float32)).eps)
+    blocks = 2 * cfg.n_layers + 1
+    bound = 16.0 * max(tp, 1) * (blocks ** 0.5) * eps
+    return {"rtol": bound, "atol": bound}
 
 
 def paged_cache_specs(cache: PagedKVCache) -> PagedKVCache:
@@ -714,6 +785,20 @@ def _replicated_specs(tree) -> object:
     return jax.tree.map(lambda _: P(), tree)
 
 
+def _tp_param_specs(params: Params, parallel: bool) -> object:
+    """shard_map in_specs for the weight tree: replicated under
+    ``tp_compute="gathered"`` (XLA all-gathers the stored shards at
+    dispatch), column/row-split under ``"parallel"`` (the kernels
+    consume the stored shards in place — see
+    ``parallel.sharding.tp_compute_param_specs``)."""
+    if not parallel:
+        return _replicated_specs(params)
+    from kubeflow_controller_tpu.parallel.sharding import (
+        tp_compute_param_specs,
+    )
+    return tp_compute_param_specs(params)
+
+
 def _decode_layer_paged(
     cfg: TransformerConfig,
     lp: Params,
@@ -723,13 +808,22 @@ def _decode_layer_paged(
     cache: PagedKVCache,
     tp_shards: int = 1,
     view_width: Optional[int] = None,
+    tp_parallel: bool = False,
+    attn_impl: str = "xla",
 ):
     """``_decode_layer_slots`` reading and writing the block pool through
     per-slot tables: row b scatters its new k/v into page
     ``tables[b, pos[b] // bs]`` at page row ``pos[b] % bs`` (sentinel
-    pages drop the write), then attends over the table-gathered view of
-    its pages — the same einsum/mask/softmax ops at the same width on
-    the same bytes, so the fp path is bitwise the contiguous kernel."""
+    pages drop the write), then attends over the slot's pages — via the
+    table-gathered dense view (``attn_impl="xla"``: the same
+    einsum/mask/softmax ops at the same width on the same bytes, so the
+    fp path is bitwise the contiguous kernel) or via the fused Pallas
+    kernel (``attn_impl="pallas"``: flash-style online softmax streaming
+    pool pages in place through the block table — a different reduction
+    order, pinned against the gather oracle by a tolerance contract).
+    ``tp_parallel``: consume column/row-sharded weights — local
+    projections, one psum after wo and one after w_down (see the
+    placement comment above :func:`check_tp_heads`)."""
     from kubeflow_controller_tpu.ops.attention import paged_kv_view
 
     b = x.shape[0]
@@ -741,18 +835,22 @@ def _decode_layer_paged(
     # The gathered view (and its masks) may be capped to the engine's
     # live occupancy; pool WRITES always guard against the full span.
     vw = width if view_width is None else min(view_width, width)
+    par = tp_shards > 1 and tp_parallel
+    rep = cfg.n_heads // cfg.n_kv_heads
+    # Column-parallel projections produce this shard's contiguous
+    # KV-head group directly (a column slice of wq IS a head slice);
+    # the gathered path projects every head and slices after rope.
+    g = cfg.n_kv_heads // tp_shards if par else cfg.n_kv_heads
 
     h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ _w(lp, "wq", dt)).reshape(b, 1, cfg.n_heads, hd)
-    k = (h @ _w(lp, "wk", dt)).reshape(b, 1, cfg.n_kv_heads, hd)
-    v = (h @ _w(lp, "wv", dt)).reshape(b, 1, cfg.n_kv_heads, hd)
+    q = (h @ _w(lp, "wq", dt)).reshape(b, 1, g * rep, hd)
+    k = (h @ _w(lp, "wk", dt)).reshape(b, 1, g, hd)
+    v = (h @ _w(lp, "wv", dt)).reshape(b, 1, g, hd)
     positions = pos[:, None]                     # [B, 1]
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    rep = cfg.n_heads // cfg.n_kv_heads
-    g = cfg.n_kv_heads
     qg = q.reshape(b, 1, g, rep, hd)
-    if tp_shards > 1:
+    if tp_shards > 1 and not par:
         # Full projections above are replicated (bitwise the 1-chip
         # values); keep only this shard's KV-head group from here on.
         g = cfg.n_kv_heads // tp_shards
@@ -771,29 +869,44 @@ def _decode_layer_paged(
         cache.k, cache.k_scale, (layer, blk, off), k[:, 0])
     v_pool, v_scale = _pool_write(
         cache.v, cache.v_scale, (layer, blk, off), v[:, 0])
-    k_cache = paged_kv_view(
-        k_pool[layer], cache.tables, vw,
-        scale=None if k_scale is None else k_scale[layer],
-        out_dtype=dt)                            # [B, vw, KVH, D]
-    v_cache = paged_kv_view(
-        v_pool[layer], cache.tables, vw,
-        scale=None if v_scale is None else v_scale[layer],
-        out_dtype=dt)
+    if attn_impl == "pallas":
+        from kubeflow_controller_tpu.ops.paged_attention_pallas import (
+            paged_attention_decode,
+        )
+        attn = paged_attention_decode(
+            qg[:, 0], k_pool[layer], v_pool[layer], cache.tables, pos,
+            k_scale=None if k_scale is None else k_scale[layer],
+            v_scale=None if v_scale is None else v_scale[layer],
+            width=vw, sm_scale=hd ** -0.5, out_dtype=dt,
+        )[:, None]                               # [B, 1, G, rep, D]
+    else:
+        k_cache = paged_kv_view(
+            k_pool[layer], cache.tables, vw,
+            scale=None if k_scale is None else k_scale[layer],
+            out_dtype=dt)                        # [B, vw, KVH, D]
+        v_cache = paged_kv_view(
+            v_pool[layer], cache.tables, vw,
+            scale=None if v_scale is None else v_scale[layer],
+            out_dtype=dt)
 
-    s = jnp.einsum(
-        "bqgrd,bkgd->bgrqk", qg, k_cache,
-        preferred_element_type=jnp.float32,
-    ) * (hd ** -0.5)                             # [B, G, rep, 1, S]
-    valid = jnp.arange(vw)[None, :] <= pos[:, None]          # [B, S]
-    s = jnp.where(valid[:, None, None, None, :], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1).astype(dt)
-    attn = jnp.einsum("bgrqk,bkgd->bqgrd", p, v_cache)
-    if tp_shards > 1:
-        # Exact concatenation of the shards' head-group outputs: the
-        # (g, rep, hd) flattening below then matches the 1-chip layout.
-        attn = lax.all_gather(attn, "tp", axis=2, tiled=True)
-    attn = attn.reshape(b, 1, -1)
-    x = x + attn @ _w(lp, "wo", dt)
+        s = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, k_cache,
+            preferred_element_type=jnp.float32,
+        ) * (hd ** -0.5)                         # [B, G, rep, 1, S]
+        valid = jnp.arange(vw)[None, :] <= pos[:, None]      # [B, S]
+        s = jnp.where(valid[:, None, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(dt)
+        attn = jnp.einsum("bgrqk,bkgd->bqgrd", p, v_cache)
+    if par:
+        # Row-parallel wo: this shard's head group hits its own rows of
+        # wo; the psum completes the contraction (the one collective).
+        x = x + lax.psum(attn.reshape(b, 1, -1) @ _w(lp, "wo", dt), "tp")
+    else:
+        if tp_shards > 1:
+            # Exact concatenation of the shards' head-group outputs: the
+            # (g, rep, hd) flattening below then matches 1-chip layout.
+            attn = lax.all_gather(attn, "tp", axis=2, tiled=True)
+        x = x + attn.reshape(b, 1, -1) @ _w(lp, "wo", dt)
 
     h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.moe_experts:
@@ -801,7 +914,8 @@ def _decode_layer_paged(
     else:
         gate = jax.nn.silu(h @ _w(lp, "w_gate", dt))
         up = h @ _w(lp, "w_up", dt)
-        x = x + (gate * up) @ _w(lp, "w_down", dt)
+        down = (gate * up) @ _w(lp, "w_down", dt)
+        x = x + (lax.psum(down, "tp") if par else down)
     return x, k_pool, v_pool, k_scale, v_scale
 
 
@@ -812,6 +926,8 @@ def _decode_step_paged_impl(
     cache: PagedKVCache,
     tp_shards: int = 1,
     view_width: Optional[int] = None,
+    tp_parallel: bool = False,
+    attn_impl: str = "xla",
 ) -> Tuple[jax.Array, PagedKVCache]:
     x = params["embed"].astype(cfg.dtype)[tokens]     # [B, 1, D]
     pos = cache.length
@@ -824,7 +940,8 @@ def _decode_step_paged_impl(
         )
         c = cache._replace(k=k, v=v, k_scale=ks, v_scale=vs)
         return _decode_layer_paged(cfg, lp, x, pos, layer, c,
-                                   tp_shards, view_width)
+                                   tp_shards, view_width,
+                                   tp_parallel, attn_impl)
 
     x, k, v, ks, vs = lax.fori_loop(
         0, cfg.n_layers, body,
@@ -845,6 +962,8 @@ def decode_step_paged(
     cache: PagedKVCache,
     mesh: Optional[Mesh] = None,
     view_width: Optional[int] = None,
+    tp_compute: str = "gathered",
+    attn_impl: str = "xla",
 ) -> Tuple[jax.Array, PagedKVCache]:
     """``decode_step_slots`` over the paged pool: one token for every
     slot at its own position, appends landing in each slot's tail page
@@ -852,25 +971,86 @@ def decode_step_paged(
     read-only here (the host owns them).
 
     ``mesh`` (a ``serving_mesh``): run under shard_map with the pool's
-    KVH axis split across tp — per-shard math unchanged, head outputs
-    all-gathered exactly, fp greedy bitwise the 1-chip kernel.
-    ``view_width``: cap the gathered view to the caller's live
-    occupancy (see ``paged_kv_view``); writes still span the full
-    table."""
+    KVH axis split across tp. ``tp_compute="gathered"`` keeps per-shard
+    math unchanged (full projections, head outputs all-gathered
+    exactly) — fp greedy bitwise the 1-chip kernel; ``"parallel"`` runs
+    Megatron column/row-split projections, 1/tp of the matmul FLOPs per
+    shard with one psum per block, within ``tp_parallel_tolerance``.
+    ``attn_impl="pallas"`` swaps the gather+dense-softmax attention for
+    the fused Pallas page-streaming kernel. ``view_width``: cap the
+    gathered view to the caller's live occupancy (see
+    ``paged_kv_view``); writes still span the full table."""
     tp = tp_size(mesh)
     if tp <= 1:
         return _decode_step_paged_impl(
-            cfg, params, tokens, cache, 1, view_width)
-    check_tp_heads(cfg, tp)
+            cfg, params, tokens, cache, 1, view_width, False, attn_impl)
+    check_tp_heads(cfg, tp, tp_compute)
+    parallel = tp_compute == "parallel"
     fn = shard_map(
         functools.partial(_decode_step_paged_impl, cfg,
-                          tp_shards=tp, view_width=view_width),
+                          tp_shards=tp, view_width=view_width,
+                          tp_parallel=parallel, attn_impl=attn_impl),
         mesh=mesh,
-        in_specs=(_replicated_specs(params), P(), paged_cache_specs(cache)),
+        in_specs=(_tp_param_specs(params, parallel), P(),
+                  paged_cache_specs(cache)),
         out_specs=(P(), paged_cache_specs(cache)),
         check_rep=False,
     )
     return fn(params, tokens, cache)
+
+
+def _tp_prefill_forward(
+    cfg: TransformerConfig,
+    params: Params,
+    prompt: jax.Array,          # [1, S] int32
+    tp_shards: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Column/row-parallel full-prompt forward for admission prefill
+    under ``tp_compute="parallel"``: the fused :func:`prefill` assumes
+    replicated full weights, so the parallel path runs its own block
+    forward on the shard's column slices (local head group and d_ff
+    slice; one psum per block, mirroring ``_decode_layer_paged``).
+    Returns ``(last-position logits [1, V], row_k, row_v)`` with k/v
+    already LOCAL ``[L, S, KVH/tp, D]`` — they scatter into the pool
+    shard directly, no `_tp_slice_heads` needed."""
+    b, s = prompt.shape
+    dt = cfg.dtype
+    hd = cfg.head_dim
+    rep = cfg.n_heads // cfg.n_kv_heads
+    g = cfg.n_kv_heads // tp_shards
+    x = params["embed"].astype(dt)[prompt]              # [1, S, D]
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    causal = (
+        jnp.arange(s, dtype=jnp.int32)[:, None]
+        >= jnp.arange(s, dtype=jnp.int32)[None, :]
+    )
+
+    def body(x, lp):
+        h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ _w(lp, "wq", dt)).reshape(b, s, g * rep, hd)
+        k = (h @ _w(lp, "wk", dt)).reshape(b, s, g, hd)
+        v = (h @ _w(lp, "wv", dt)).reshape(b, s, g, hd)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        qg = q.reshape(b, s, g, rep, hd)
+        sc = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg, k,
+            preferred_element_type=jnp.float32,
+        ) * (hd ** -0.5)
+        sc = jnp.where(causal[None, None, None], sc, -1e30)
+        p = jax.nn.softmax(sc, axis=-1).astype(dt)
+        attn = jnp.einsum("bgrqk,bkgd->bqgrd", p, v).reshape(b, s, -1)
+        x = x + lax.psum(attn @ _w(lp, "wo", dt), "tp")
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(h2 @ _w(lp, "w_gate", dt))
+        up = h2 @ _w(lp, "w_up", dt)
+        x = x + lax.psum((gate * up) @ _w(lp, "w_down", dt), "tp")
+        return x, (k[0], v[0])                   # [S, KVH/tp, D]
+
+    x, (row_k, row_v) = lax.scan(body, x, params["layers"])
+    logits = _head_logits(
+        cfg, params, rmsnorm(x[:, -1], params["final_norm"], cfg.norm_eps))
+    return logits, row_k, row_v
 
 
 def _prefill_into_paged_impl(
@@ -880,22 +1060,27 @@ def _prefill_into_paged_impl(
     cache: PagedKVCache,
     slot: jax.Array,            # [] int32
     tp_shards: int = 1,
+    tp_parallel: bool = False,
 ) -> Tuple[jax.Array, PagedKVCache]:
     n_blocks, bs = cache.k.shape[1], cache.k.shape[2]
     mb = cache.tables.shape[1]
     s = prompt.shape[1]
-    logits, mini = prefill(
-        cfg, params, prompt, init_kv_cache(cfg, 1, s))
-    row_k = mini.k[:, 0]                         # [L, S, KVH, D]
-    row_v = mini.v[:, 0]
-    if tp_shards > 1:
-        # The fused prefill above ran replicated — identical logits and
-        # KV bytes on every shard; each shard scatters only its own
-        # KV-head slice into its pool shard (quantize-on-write commutes
-        # with the head slice: scales are per-(token, head)).
-        g = cfg.n_kv_heads // tp_shards
-        row_k = _tp_slice_heads(row_k, g, axis=2)
-        row_v = _tp_slice_heads(row_v, g, axis=2)
+    if tp_shards > 1 and tp_parallel:
+        logits, row_k, row_v = _tp_prefill_forward(
+            cfg, params, prompt, tp_shards)      # k/v already local
+    else:
+        logits, mini = prefill(
+            cfg, params, prompt, init_kv_cache(cfg, 1, s))
+        row_k = mini.k[:, 0]                     # [L, S, KVH, D]
+        row_v = mini.v[:, 0]
+        if tp_shards > 1:
+            # The fused prefill above ran replicated — identical logits
+            # and KV bytes on every shard; each shard scatters only its
+            # own KV-head slice into its pool shard (quantize-on-write
+            # commutes with the head slice: scales per-(token, head)).
+            g = cfg.n_kv_heads // tp_shards
+            row_k = _tp_slice_heads(row_k, g, axis=2)
+            row_v = _tp_slice_heads(row_v, g, axis=2)
     trow = cache.tables[slot]                    # [mb]
     cols = jnp.arange(s, dtype=jnp.int32)
     blk = trow[jnp.clip(cols // bs, 0, mb - 1)]  # s <= mb*bs checked above
@@ -918,13 +1103,15 @@ def prefill_into_paged(
     cache: PagedKVCache,
     slot: jax.Array,            # [] int32 — destination slot
     mesh: Optional[Mesh] = None,
+    tp_compute: str = "gathered",
 ) -> Tuple[jax.Array, PagedKVCache]:
     """``prefill_into_slot`` for the paged pool: block-prefill the
     prompt (the identical fused forward — identical logits and KV bytes)
     and scatter the S positions into the pages of slot ``slot``'s table.
     ``length[slot] = S``, ``active[slot] = True``; every other slot's
-    pages are untouched. Compiles once per prompt length. ``mesh``: see
-    :func:`decode_step_paged`."""
+    pages are untouched. Compiles once per prompt length. ``mesh`` /
+    ``tp_compute``: see :func:`decode_step_paged` (the parallel path
+    substitutes :func:`_tp_prefill_forward` for the fused prefill)."""
     if prompt.shape[0] != 1:
         raise ValueError(
             f"prefill_into_paged admits one request (got batch "
@@ -939,12 +1126,14 @@ def prefill_into_paged(
     tp = tp_size(mesh)
     if tp <= 1:
         return _prefill_into_paged_impl(cfg, params, prompt, cache, slot)
-    check_tp_heads(cfg, tp)
+    check_tp_heads(cfg, tp, tp_compute)
+    parallel = tp_compute == "parallel"
     fn = shard_map(
-        functools.partial(_prefill_into_paged_impl, cfg, tp_shards=tp),
+        functools.partial(_prefill_into_paged_impl, cfg, tp_shards=tp,
+                          tp_parallel=parallel),
         mesh=mesh,
-        in_specs=(_replicated_specs(params), P(), paged_cache_specs(cache),
-                  P()),
+        in_specs=(_tp_param_specs(params, parallel), P(),
+                  paged_cache_specs(cache), P()),
         out_specs=(P(), paged_cache_specs(cache)),
         check_rep=False,
     )
@@ -1158,6 +1347,8 @@ def _prefill_chunk_paged_impl(
     offset: jax.Array,          # [] int32 — absolute start position
     n_real: jax.Array,          # [] int32 — real (un-padded) chunk length
     tp_shards: int = 1,
+    view_width: Optional[int] = None,
+    tp_parallel: bool = False,
 ) -> Tuple[jax.Array, PagedKVCache]:
     from kubeflow_controller_tpu.ops.attention import paged_kv_view
 
@@ -1167,15 +1358,23 @@ def _prefill_chunk_paged_impl(
     n_blocks, bs = cache.k.shape[1], cache.k.shape[2]
     mb = cache.tables.shape[1]
     width = mb * bs
+    # Occupancy cap on the slot's page view (see paged_kv_view): the
+    # chunk only attends to columns < offset, and the engine's view
+    # width always covers the slot's reserved span >= offset + n_real,
+    # so capping the gather loses nothing. Writes still span the full
+    # table via the sentinel guard below.
+    vw = width if view_width is None else min(view_width, width)
     rep = cfg.n_heads // cfg.n_kv_heads
+    par = tp_shards > 1 and tp_parallel
     g_local = (cfg.n_kv_heads // tp_shards if tp_shards > 1
                else cfg.n_kv_heads)
+    gp = g_local if par else cfg.n_kv_heads      # projection head groups
     trow = cache.tables[slot]                    # [mb]
     kc_row = paged_kv_view(
-        cache.k, trow, width, scale=cache.k_scale, out_dtype=dt,
-    )                                            # [L, width, KVH, D]
+        cache.k, trow, vw, scale=cache.k_scale, out_dtype=dt,
+    )                                            # [L, vw, KVH, D]
     vc_row = paged_kv_view(
-        cache.v, trow, width, scale=cache.v_scale, out_dtype=dt,
+        cache.v, trow, vw, scale=cache.v_scale, out_dtype=dt,
     )
 
     x = params["embed"].astype(dt)[toks]         # [1, W, D]
@@ -1185,22 +1384,22 @@ def _prefill_chunk_paged_impl(
         moe_cfg = cfg.replace(
             moe_capacity_factor=float(cfg.moe_experts) / cfg.moe_top_k
         )
-    cache_cols = jnp.arange(width, dtype=jnp.int32)
+    cache_cols = jnp.arange(vw, dtype=jnp.int32)
     causal = (
         jnp.arange(w, dtype=jnp.int32)[:, None]
         >= jnp.arange(w, dtype=jnp.int32)[None, :]
     )                                            # [W, W]
 
     def body(x, layer_in):
-        lp, kc, vc = layer_in                    # kc [width, KVH, D]
+        lp, kc, vc = layer_in                    # kc [vw, KVH, D]
         h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ _w(lp, "wq", dt)).reshape(b, w, cfg.n_heads, hd)
-        k = (h @ _w(lp, "wk", dt)).reshape(b, w, cfg.n_kv_heads, hd)
-        v = (h @ _w(lp, "wv", dt)).reshape(b, w, cfg.n_kv_heads, hd)
+        q = (h @ _w(lp, "wq", dt)).reshape(b, w, gp * rep, hd)
+        k = (h @ _w(lp, "wk", dt)).reshape(b, w, gp, hd)
+        v = (h @ _w(lp, "wv", dt)).reshape(b, w, gp, hd)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        qg = q.reshape(b, w, cfg.n_kv_heads, rep, hd)
-        if tp_shards > 1:
+        qg = q.reshape(b, w, gp, rep, hd)
+        if tp_shards > 1 and not par:
             qg = _tp_slice_heads(qg, g_local, axis=2)
             k = _tp_slice_heads(k, g_local, axis=2)
             v = _tp_slice_heads(v, g_local, axis=2)
@@ -1208,7 +1407,7 @@ def _prefill_chunk_paged_impl(
         s_cache = jnp.einsum(
             "bqgrd,kgd->bgrqk", qg, kc,
             preferred_element_type=jnp.float32,
-        ) * scale                                # [1,G,rep,W,width]
+        ) * scale                                # [1,G,rep,W,vw]
         s_cache = jnp.where(
             (cache_cols < offset)[None, None, None, None, :],
             s_cache, -1e30,
@@ -1222,13 +1421,17 @@ def _prefill_chunk_paged_impl(
             jnp.concatenate([s_cache, s_new], axis=-1), axis=-1
         ).astype(dt)
         attn = (
-            jnp.einsum("bgrqk,kgd->bqgrd", p[..., :width], vc)
-            + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., width:], v)
+            jnp.einsum("bgrqk,kgd->bqgrd", p[..., :vw], vc)
+            + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., vw:], v)
         )
-        if tp_shards > 1:
-            attn = lax.all_gather(attn, "tp", axis=2, tiled=True)
-        attn = attn.reshape(b, w, -1)
-        x = x + attn @ _w(lp, "wo", dt)
+        if par:
+            attn = attn.reshape(b, w, -1)
+            x = x + lax.psum(attn @ _w(lp, "wo", dt), "tp")
+        else:
+            if tp_shards > 1:
+                attn = lax.all_gather(attn, "tp", axis=2, tiled=True)
+            attn = attn.reshape(b, w, -1)
+            x = x + attn @ _w(lp, "wo", dt)
         h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         if cfg.moe_experts:
             down, _aux = tfm._moe_ffn(moe_cfg, _dense_lp(lp, dt), h2)
@@ -1236,7 +1439,8 @@ def _prefill_chunk_paged_impl(
         else:
             gate = jax.nn.silu(h2 @ _w(lp, "w_gate", dt))
             up = h2 @ _w(lp, "w_up", dt)
-            x = x + (gate * up) @ _w(lp, "w_down", dt)
+            down = (gate * up) @ _w(lp, "w_down", dt)
+            x = x + (lax.psum(down, "tp") if par else down)
         return x, (k[0], v[0])                   # [W, KVH, D]
 
     x, (k_new, v_new) = lax.scan(
@@ -1271,6 +1475,8 @@ def prefill_chunk_paged(
     offset: jax.Array,          # [] int32 — absolute start position
     n_real: jax.Array,          # [] int32 — real (un-padded) chunk length
     mesh: Optional[Mesh] = None,
+    view_width: Optional[int] = None,
+    tp_compute: str = "gathered",
 ) -> Tuple[jax.Array, PagedKVCache]:
     """``prefill_chunk_into_slot`` over the paged pool: the chunk
     attends to the table-gathered view of the slot's prior pages (a
@@ -1278,7 +1484,10 @@ def prefill_chunk_paged(
     intra-chunk causal, and its k/v scatter straight into the slot's
     own pages at absolute columns ``offset + [0, W)``. Same bucketing
     and padding discipline, same math at the same width — the fp path
-    is bitwise the contiguous kernel. ``mesh``: see
+    is bitwise the contiguous kernel. ``view_width``: cap the slot's
+    page view to the engine's live occupancy (must cover the slot's
+    reserved span; the engine's pow2-rounded width does by
+    construction). ``mesh`` / ``tp_compute``: see
     :func:`decode_step_paged` (the slot's page view and k/v scatter are
     per-shard; the chunk's logits come out replicated)."""
     if toks.shape[0] != 1:
@@ -1289,13 +1498,16 @@ def prefill_chunk_paged(
     tp = tp_size(mesh)
     if tp <= 1:
         return _prefill_chunk_paged_impl(
-            cfg, params, toks, cache, slot, offset, n_real)
-    check_tp_heads(cfg, tp)
+            cfg, params, toks, cache, slot, offset, n_real,
+            1, view_width)
+    check_tp_heads(cfg, tp, tp_compute)
+    parallel = tp_compute == "parallel"
     fn = shard_map(
-        functools.partial(_prefill_chunk_paged_impl, cfg, tp_shards=tp),
+        functools.partial(_prefill_chunk_paged_impl, cfg, tp_shards=tp,
+                          view_width=view_width, tp_parallel=parallel),
         mesh=mesh,
-        in_specs=(_replicated_specs(params), P(), paged_cache_specs(cache),
-                  P(), P(), P()),
+        in_specs=(_tp_param_specs(params, parallel), P(),
+                  paged_cache_specs(cache), P(), P(), P()),
         out_specs=(P(), paged_cache_specs(cache)),
         check_rep=False,
     )
@@ -1474,6 +1686,7 @@ def _verify_step_paged_impl(
     tp_shards: int = 1,
     view_width: Optional[int] = None,
     sampling=None,              # (temperature, top_k, top_p, seed, gen, pos)
+    tp_parallel: bool = False,
 ) -> Tuple[jax.Array, ...]:
     from kubeflow_controller_tpu.ops.attention import paged_kv_view
 
@@ -1486,8 +1699,10 @@ def _verify_step_paged_impl(
     width = mb * bs
     vw = width if view_width is None else min(view_width, width)
     rep = cfg.n_heads // cfg.n_kv_heads
+    par = tp_shards > 1 and tp_parallel
     g_local = (cfg.n_kv_heads // tp_shards if tp_shards > 1
                else cfg.n_kv_heads)
+    gp = g_local if par else cfg.n_kv_heads      # projection head groups
     pos0 = cache.length                              # [B]
     kview = paged_kv_view(
         cache.k, cache.tables, vw, scale=cache.k_scale, out_dtype=dt,
@@ -1524,13 +1739,13 @@ def _verify_step_paged_impl(
     def body(x, layer_in):
         lp, kc, vc = layer_in                        # kc [B,vw,KVH,D]
         h = rmsnorm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ _w(lp, "wq", dt)).reshape(b, w, cfg.n_heads, hd)
-        k = (h @ _w(lp, "wk", dt)).reshape(b, w, cfg.n_kv_heads, hd)
-        v = (h @ _w(lp, "wv", dt)).reshape(b, w, cfg.n_kv_heads, hd)
+        q = (h @ _w(lp, "wq", dt)).reshape(b, w, gp * rep, hd)
+        k = (h @ _w(lp, "wk", dt)).reshape(b, w, gp, hd)
+        v = (h @ _w(lp, "wv", dt)).reshape(b, w, gp, hd)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        qg = q.reshape(b, w, cfg.n_kv_heads, rep, hd)
-        if tp_shards > 1:
+        qg = q.reshape(b, w, gp, rep, hd)
+        if tp_shards > 1 and not par:
             qg = _tp_slice_heads(qg, g_local, axis=2)
             k = _tp_slice_heads(k, g_local, axis=2)
             v = _tp_slice_heads(v, g_local, axis=2)
@@ -1555,10 +1770,14 @@ def _verify_step_paged_impl(
             jnp.einsum("bgrqk,bkgd->bqgrd", p[..., :vw], vc)
             + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., vw:], v)
         )
-        if tp_shards > 1:
-            attn = lax.all_gather(attn, "tp", axis=2, tiled=True)
-        attn = attn.reshape(b, w, -1)
-        x = x + attn @ _w(lp, "wo", dt)
+        if par:
+            attn = attn.reshape(b, w, -1)
+            x = x + lax.psum(attn @ _w(lp, "wo", dt), "tp")
+        else:
+            if tp_shards > 1:
+                attn = lax.all_gather(attn, "tp", axis=2, tiled=True)
+            attn = attn.reshape(b, w, -1)
+            x = x + attn @ _w(lp, "wo", dt)
         h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         if cfg.moe_experts:
             down, _aux = tfm._moe_ffn(moe_cfg, _dense_lp(lp, dt), h2)
@@ -1566,7 +1785,8 @@ def _verify_step_paged_impl(
         else:
             gate = jax.nn.silu(h2 @ _w(lp, "w_gate", dt))
             up = h2 @ _w(lp, "w_up", dt)
-            x = x + (gate * up) @ _w(lp, "w_down", dt)
+            down = (gate * up) @ _w(lp, "w_down", dt)
+            x = x + (lax.psum(down, "tp") if par else down)
         return x, (k, v)                             # [B, W, KVH, D]
 
     x, (k_win, v_win) = lax.scan(
@@ -1644,6 +1864,7 @@ def verify_step_paged(
     max_commit: jax.Array,      # [B] int32 — commit budget cap, >= 1
     mesh: Optional[Mesh] = None,
     view_width: Optional[int] = None,
+    tp_compute: str = "gathered",
 ) -> Tuple[jax.Array, jax.Array, jax.Array, PagedKVCache]:
     """``verify_step_slots`` over the paged pool: the K+1 verify window
     attends to each slot's table-gathered page view, and ONLY the
@@ -1652,19 +1873,22 @@ def verify_step_paged(
     by never committing). Acceptance, budget/EOS truncation, and the
     carried logits are the contiguous verifier's code verbatim, so the
     fp paged path commits the bitwise-identical stream. ``mesh`` /
-    ``view_width``: see :func:`decode_step_paged` — acceptance runs on
-    replicated logits, so every shard commits the same ``n``."""
+    ``view_width`` / ``tp_compute``: see :func:`decode_step_paged` —
+    acceptance runs on replicated logits (psum results are identical on
+    every shard), so every shard commits the same ``n``."""
     tp = tp_size(mesh)
     if tp <= 1:
         return _verify_step_paged_impl(
             cfg, params, draft, draft_len, logits, cache, eos,
             max_commit, 1, view_width)
-    check_tp_heads(cfg, tp)
+    check_tp_heads(cfg, tp, tp_compute)
+    parallel = tp_compute == "parallel"
     fn = shard_map(
         functools.partial(_verify_step_paged_impl, cfg,
-                          tp_shards=tp, view_width=view_width),
+                          tp_shards=tp, view_width=view_width,
+                          tp_parallel=parallel),
         mesh=mesh,
-        in_specs=(_replicated_specs(params), P(), P(), P(),
+        in_specs=(_tp_param_specs(params, parallel), P(), P(), P(),
                   paged_cache_specs(cache), P(), P()),
         out_specs=(P(), P(), P(), paged_cache_specs(cache)),
         check_rep=False,
@@ -1689,6 +1913,7 @@ def verify_step_paged_sampled(
     pos: jax.Array,             # [B] i32 — emitted-token count per row
     mesh: Optional[Mesh] = None,
     view_width: Optional[int] = None,
+    tp_compute: str = "gathered",
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, PagedKVCache]:
     """:func:`verify_step_paged` generalized to per-row sampling via the
     standard speculative-sampling acceptance rule specialized to this
@@ -1712,18 +1937,20 @@ def verify_step_paged_sampled(
         return _verify_step_paged_impl(
             cfg, params, draft, draft_len, logits, cache, eos,
             max_commit, 1, view_width, sampling)
-    check_tp_heads(cfg, tp)
+    check_tp_heads(cfg, tp, tp_compute)
+    parallel = tp_compute == "parallel"
 
     def _shard_body(params, draft, draft_len, logits, cache, eos,
                     max_commit, sampling):
         return _verify_step_paged_impl(
             cfg, params, draft, draft_len, logits, cache, eos, max_commit,
-            tp_shards=tp, view_width=view_width, sampling=sampling)
+            tp_shards=tp, view_width=view_width, sampling=sampling,
+            tp_parallel=parallel)
 
     fn = shard_map(
         _shard_body,
         mesh=mesh,
-        in_specs=(_replicated_specs(params), P(), P(), P(),
+        in_specs=(_tp_param_specs(params, parallel), P(), P(), P(),
                   paged_cache_specs(cache), P(), P(),
                   (P(), P(), P(), P(), P(), P())),
         out_specs=(P(), P(), P(), P(), paged_cache_specs(cache)),

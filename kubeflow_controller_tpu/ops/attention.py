@@ -107,8 +107,12 @@ def paged_kv_view(
 ) -> jax.Array:
     """Gather a dense KV view out of a block pool through block tables —
     the paged-attention primitive (vLLM PagedAttention semantics, XLA
-    gather path; a Pallas kernel that keeps the view in VMEM tiles is the
-    natural next rung and would slot in behind this same signature).
+    gather path). This is the repo's bit-exactness ORACLE: the fused
+    Pallas decode kernel (``ops/paged_attention_pallas.py``, selected
+    with ``attn_impl="pallas"``) reads the same pages in place through
+    the same tables without ever materializing this view, and tier-1
+    pins it against this path in interpret mode; the engine's default
+    ``attn_impl="xla"`` keeps every downstream bitwise guarantee.
 
     ``tables[..., i]`` names the pool page backing logical columns
     ``[i*bs, (i+1)*bs)``; the result is ``[*lead, *T, width, KVH, D]`` —
@@ -138,8 +142,12 @@ def paged_kv_view(
     consumer's reduction shape: the single-token decode matvec reduces
     width sequentially and is bitwise at any cap (pinned by
     tests/test_paged_attention.py); a multi-row matmul like the fused
-    verify gets retiled per width and drifts ~1 ulp, which is why the
-    serving engine caps only the decode path."""
+    verify or chunk prefill gets retiled per width and drifts ~1 ulp.
+    The serving engine caps ALL three paths (decode, spec-verify, chunk
+    prefill) with per-width memoized step fns — the verify/chunk drift
+    this admits is a declared tolerance contract
+    (tests/test_paged_attention.py:
+    test_verify_width_tolerance_contract), not test luck."""
     *lead, n_blocks, bsz, kvh, d = pool.shape
     nlead = len(lead)
     nb = -(-width // bsz)

@@ -1,0 +1,197 @@
+"""Fused paged-attention decode kernel (Pallas).
+
+The XLA path (``ops.attention.paged_kv_view`` + dense softmax) pays for
+paging three times per step: it reads every pool page the table names,
+WRITES a dense ``[B, S, KVH, D]`` view, then reads that view back into
+the attention einsums. This kernel removes the round trip: a flash-style
+online softmax walks each slot's block table page by page, streaming K/V
+pool tiles straight into VMEM — pages are read once, in place, and the
+dense view never exists. int8 pools dequantize inside the page load (the
+per-(token, head) scale multiply fuses into the same tile), so a
+quantized pool never materializes an fp copy either.
+
+Contract vs the gather oracle: the same pages, masks, and fp32 score
+math — but an *online* softmax normalizes through running (max, sum)
+accumulators, a different reduction order than ``jax.nn.softmax`` over
+the full row, so outputs agree within a few ulps rather than bitwise.
+``tests/test_paged_attention_pallas.py`` pins that tolerance contract
+with the kernel in interpret mode on CPU against the gather path, which
+remains the repo's bit-exactness oracle (the engine's default
+``attn_impl="xla"`` keeps every existing bitwise guarantee).
+
+Grid layout: ``(batch, kv_group, page)`` with pages innermost. The block
+table and per-slot positions ride in scalar-prefetch operands, so each
+page step's BlockSpec index map dereferences ``tables[b, j]`` on the
+scalar core and the DMA fetches the *pool* page directly — the paging
+indirection costs an index load, not a gather. Sentinel table entries
+(page id == n_blocks, meaning "unallocated") clamp to the last real page
+and are fully masked by the position test, the same
+garbage-is-masked argument ``paged_kv_view``'s ``mode="clip"`` uses.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific helpers; interpret mode emulates them on CPU.
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover - pallas TPU backend not built
+    pltpu = None
+
+_MASK_VALUE = -1e30
+
+
+def _decode_kernel(
+    # closure statics
+    nb: int, bs: int, sm_scale: float, quantized: bool,
+    # scalar-prefetch refs
+    tables_ref, pos_ref,
+    # input refs (ks/vs present only when quantized)
+    *refs,
+):
+    if quantized:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)              # [rep, D]
+    k = k_ref[0, :, 0].astype(jnp.float32)           # [bs, D]
+    v = v_ref[0, :, 0].astype(jnp.float32)
+    if quantized:
+        k = k * ks_ref[0, :, 0][:, None]
+        v = v * vs_ref[0, :, 0][:, None]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale                                     # [rep, bs]
+    # Decode mask: column c is visible iff c <= pos[b]. Page j covers
+    # columns j*bs + [0, bs). Page 0 always has a visible column
+    # (pos >= 0), so the running max is finite from the first step and
+    # masked scores exp() to exactly 0.
+    cols = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+    s = jnp.where(cols <= pos_ref[b], s, _MASK_VALUE)
+
+    m_prev = m_ref[...]                              # [rep, 1]
+    l_prev = l_ref[...]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                           # [rep, bs]
+    l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    m_ref[...] = m_new
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def paged_attention_decode(
+    q: jax.Array,               # [B, G, rep, D] — post-rope query groups
+    k_pool: jax.Array,          # [n_blocks(+1), bs, G, D] — one layer's pool
+    v_pool: jax.Array,
+    tables: jax.Array,          # [B, mb] int32 — page ids (n_blocks = sentinel)
+    pos: jax.Array,             # [B] int32 — column of this step's token
+    *,
+    k_scale: Optional[jax.Array] = None,   # [n_blocks(+1), bs, G] f32
+    v_scale: Optional[jax.Array] = None,
+    width: Optional[int] = None,
+    sm_scale: Optional[float] = None,
+    out_dtype: Optional[jnp.dtype] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Single-token paged decode attention: softmax(q·K/√d)·V over each
+    slot's table-resolved pages, masked to columns ``<= pos[b]``.
+
+    Drop-in for the ``paged_kv_view`` + einsum/softmax/einsum block in
+    ``models.generate._decode_layer_paged`` — same inputs (one layer's
+    pool, the full table, per-slot positions), same ``[B, G, rep, D]``
+    output — but pages stream through VMEM once instead of materializing
+    the dense view. ``width`` caps the walked span exactly like the
+    view's occupancy cap: only ``ceil(width / bs)`` table entries are
+    dereferenced. ``interpret`` defaults to "not on TPU", which is what
+    tier-1 uses to pin the kernel against the gather oracle on CPU.
+    """
+    b, g, rep, hd = q.shape
+    bs = k_pool.shape[1]
+    mb = tables.shape[1]
+    span = mb * bs if width is None else min(width, mb * bs)
+    nb = max(1, -(-span // bs))                  # pages to walk, >= 1
+    nb = min(nb, mb)
+    # The pool may or may not carry a +1 sentinel page; clamp ids to the
+    # last real page either way (masked, so the bytes never matter).
+    last_page = k_pool.shape[0] - 1
+    if sm_scale is None:
+        sm_scale = hd ** -0.5
+    if out_dtype is None:
+        out_dtype = q.dtype
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    quantized = k_scale is not None
+    if pltpu is None:
+        raise NotImplementedError(
+            "pallas TPU backend unavailable in this jax build; use "
+            "attn_impl='xla'"
+        )
+
+    tables = jnp.minimum(tables.astype(jnp.int32), last_page)
+    pos = pos.astype(jnp.int32)
+
+    def q_map(b_i, g_i, j, tables, pos):
+        return (b_i, g_i, 0, 0)
+
+    def kv_map(b_i, g_i, j, tables, pos):
+        return (tables[b_i, j], 0, g_i, 0)
+
+    def scale_map(b_i, g_i, j, tables, pos):
+        return (tables[b_i, j], 0, g_i)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, rep, hd), q_map),
+        pl.BlockSpec((1, bs, 1, hd), kv_map),
+        pl.BlockSpec((1, bs, 1, hd), kv_map),
+    ]
+    args = [q, k_pool, v_pool]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, bs, 1), scale_map),
+            pl.BlockSpec((1, bs, 1), scale_map),
+        ]
+        args += [k_scale.astype(jnp.float32), v_scale.astype(jnp.float32)]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, g, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, rep, hd), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((rep, 1), jnp.float32),   # running max
+            pltpu.VMEM((rep, 1), jnp.float32),   # running sum
+            pltpu.VMEM((rep, hd), jnp.float32),  # output accumulator
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, nb, bs, float(sm_scale), quantized)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, g, rep, hd), out_dtype),
+        interpret=interpret,
+    )(tables, pos, *args)

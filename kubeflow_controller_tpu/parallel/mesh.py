@@ -153,7 +153,12 @@ def serving_mesh(
 
     The serving engine shards KV heads and the paged pool's KVH axis over
     ``tp`` and replicates everything host-visible (block tables, lengths,
-    logits), so the scheduler never notices the mesh. Returns ``None`` for
+    logits), so the scheduler never notices the mesh. The same mesh serves
+    both compute modes: ``tp_compute="gathered"`` all-gathers the stored
+    weight shards at dispatch (tp as a capacity knob), ``"parallel"`` runs
+    Megatron column/row-parallel matmuls on the shards in place, with one
+    psum per block on this axis's ICI links (tp as a speed knob —
+    docs/serving.md "Tensor-parallel serving"). Returns ``None`` for
     ``tp <= 1``: the single-chip engine runs the exact unsharded code path,
     not a degenerate 1-device mesh — bit-exactness baselines compare
     against real single-chip traces.

@@ -76,6 +76,55 @@ def shard_params(params: Any, shardings: Any) -> Any:
     )
 
 
+# Megatron-style compute placement for the serving kernels
+# (``tp_compute="parallel"``): column-parallel projections shard their
+# OUTPUT axis (each shard computes its own slice of the projection, no
+# collective — q/k/v land directly on the shard's KV-head group, gate/up
+# on its d_ff slice), row-parallel projections shard their CONTRACTION
+# axis (each shard contributes a partial product; one psum per block —
+# after wo and after w_down — completes the sum). Everything else
+# (embed, norms, lm_head, the int8 scale of a row-parallel weight, whose
+# contraction axis is size 1) stays replicated.
+_TP_COLUMN_KEYS = frozenset(("wq", "wk", "wv", "w_gate", "w_up"))
+_TP_ROW_KEYS = frozenset(("wo", "w_down"))
+
+
+def tp_compute_param_specs(params: Any) -> Any:
+    """Per-leaf ``shard_map`` in_specs for the column/row-parallel
+    serving kernels (``models/generate.py`` with ``tp_compute=
+    "parallel"``): column-parallel weights put their last (output) axis
+    on ``tp``, row-parallel weights their second-to-last (contraction)
+    axis, everything else replicates.
+
+    Weight-only-int8 ``(q, scale)`` pairs split the same way the values
+    do: a column-parallel weight's per-output-channel scale rides the
+    output axis onto ``tp`` (each shard dequantizes its own columns
+    exactly); a row-parallel weight's scale is size-1 on the sharded
+    contraction axis, so it replicates and every shard's dequant is
+    bitwise the full-weight dequant of its rows. MoE trees never get
+    here — ``generate.check_tp_heads`` refuses them up front."""
+    def spec(path, x):
+        key = next(
+            (getattr(p, "key", None) for p in reversed(path)
+             if getattr(p, "key", None)), None,
+        )
+        pair = isinstance(x, tuple)
+        arr = x[0] if pair else x
+        nd = arr.ndim
+        if key in _TP_COLUMN_KEYS:
+            w = P(*((None,) * (nd - 1)), "tp")
+            s = w
+        elif key in _TP_ROW_KEYS:
+            w = P(*((None,) * (nd - 2)), "tp", None)
+            s = P()
+        else:
+            w = s = P()
+        return (w, s) if pair else w
+
+    return jax.tree_util.tree_map_with_path(
+        spec, params, is_leaf=lambda x: isinstance(x, tuple))
+
+
 def serving_param_shardings(
     cfg: Any, mesh: Mesh, quant: str = "",
 ) -> Any:
@@ -87,11 +136,14 @@ def serving_param_shardings(
     Dropping instead of erroring matters for serving: the tp axis must
     shard attention/MLP projections (that's the HBM win), but a tiny
     model's vocab or d_ff may not divide tp — those weights replicate and
-    the engine still runs. The per-shard attention kernels declare their
-    weights replicated (``in_specs=P()``) anyway and let XLA all-gather
-    the stored shards at dispatch, which moves bytes but never changes
-    them — the storage sharding halves per-device weight HBM per tp
-    doubling while greedy outputs stay bitwise those of one chip."""
+    the engine still runs. Under ``tp_compute="gathered"`` the per-shard
+    kernels declare their weights replicated (``in_specs=P()``) and let
+    XLA all-gather the stored shards at dispatch, which moves bytes but
+    never changes them; under ``tp_compute="parallel"`` the kernels
+    consume the stored column/row shards in place
+    (:func:`tp_compute_param_specs`) and each shard runs 1/tp of every
+    projection. Either way the storage sharding halves per-device weight
+    HBM per tp doubling."""
     from kubeflow_controller_tpu.models import generate as gen
 
     specs = gen.inference_param_specs(cfg, quant)

@@ -1,19 +1,34 @@
-"""Tensor-parallel serving equivalence (ISSUE 9 tentpole tripwires).
+"""Tensor-parallel serving equivalence (ISSUE 9 tentpole tripwires,
+extended with the ISSUE 13 compute-parallel mode).
 
 The tp engine shards the paged pool's KV-head axis over a 1-D mesh and
-runs every paged kernel under ``shard_map`` with each shard computing
-its contiguous KV-head group via the math of one chip — full replicated
-q/k/v projections, a dynamic head-group slice, unchanged per-group
-einsums, and an exact-concatenation ``all_gather`` before the out
-projection. Nothing in that pipeline reassociates a floating-point
-reduction, so fp greedy streams must be BITWISE identical to the
-single-chip engine — under churn, with spec decode on, with int8 KV on.
-These tests pin that construction on the 8-virtual-device CPU mesh
+runs every paged kernel under ``shard_map``. Two compute modes share
+that mesh:
+
+* ``tp_compute="gathered"`` (default) — each shard computes its
+  contiguous KV-head group via the math of one chip: full replicated
+  q/k/v projections, a dynamic head-group slice, unchanged per-group
+  einsums, and an exact-concatenation ``all_gather`` before the out
+  projection. Nothing reassociates a floating-point reduction, so fp
+  greedy streams must be BITWISE identical to the single-chip engine —
+  under churn, with spec decode on, with int8 KV on.
+* ``tp_compute="parallel"`` — Megatron column/row-parallel matmuls on
+  the stored weight shards: each shard runs 1/tp of every projection
+  with one psum per block as the only new collective. The psum
+  REASSOCIATES the contraction sum, so logits carry a declared per-tp
+  tolerance (``gen.tp_parallel_tolerance``) instead of bitwiseness —
+  but greedy token STREAMS still match the 1-chip engine on this
+  workload, which is the acceptance gate tp_bench asserts before
+  timing.
+
+These tests pin both constructions on the 8-virtual-device CPU mesh
 (conftest.py forces ``--xla_force_host_platform_device_count=8``), plus
-the sharded pool's leak accounting and the per-device capacity model.
+the sharded pool's leak accounting, the per-device capacity model, and
+the structured config refusal.
 """
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -33,6 +48,17 @@ pytestmark = pytest.mark.skipif(
     reason="tp serving tests need >= 4 devices "
            "(XLA_FLAGS=--xla_force_host_platform_device_count=8)",
 )
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _drop_compiled_kernels():
+    """shard_map compiles one executable per (tp, tp_compute, kernel,
+    shape) and nothing after this module reuses any of them; release
+    them at teardown so the single-process tier-1 run's executable
+    footprint stays at the baseline the rest of the suite was sized
+    for."""
+    yield
+    jax.clear_caches()
 
 
 @pytest.fixture(scope="module")
@@ -148,12 +174,104 @@ def test_tp_pool_capacity_scales_linearly(cfg):
             == kv_blocks.kv_bytes_per_token(cfg, "") // 4)
 
 
+def test_tp_parallel_streams_match_single_chip(cfg, params):
+    """tp_compute='parallel' at tp in {2, 4}: greedy streams under the
+    same churn workload must equal the 1-chip engine's token for token
+    — psum drift lives in the logits (within the declared tolerance)
+    and never flips this workload's argmax. Asserted for both attention
+    impls, since the Pallas kernel composes with the parallel
+    projections (local-head q/k/v feed the same kernel shape). tp=4
+    engine streams are asserted by every `make bench-tp` run BEFORE
+    timing; tp=4 parallel logits are pinned kernel-level by
+    test_tp_parallel_tolerance_contract below."""
+    base = _CACHE.get("base") or _run(cfg, params, tp=1)[0]
+    for tp, attn in ((2, "xla"), (2, "pallas")):
+        got, eng = _run(cfg, params, tp=tp, tp_compute="parallel",
+                        attn_impl=attn)
+        assert got == base, f"tp={tp}/{attn} parallel diverged"
+        assert eng.tp_compute == "parallel"
+
+
+def test_tp_parallel_tolerance_contract(cfg, params):
+    """The per-tp psum tolerance contract, kernel-level: one prefill +
+    decode tail at tp=4 parallel vs single-chip, logits within
+    gen.tp_parallel_tolerance(cfg, 4) at every step and argmax equal.
+    The bound is the row-parallel error model (2L+1 psum'd blocks of
+    tp partials, modeled on the int8 KV error model in
+    docs/serving.md), so it must hold with slack, not by luck."""
+    mesh = serving_mesh(4)
+    tol = gen.tp_parallel_tolerance(cfg, 4)
+    rng = np.random.default_rng(31)
+    # Two rows, one prompt SHAPE: distinct contents exercise batch
+    # composition while prefill compiles once per mode, not per row.
+    prompts = [rng.integers(0, cfg.vocab_size, s).astype(np.int32)
+               for s in (11, 11)]
+    mb = MAX_SEQ // BS
+    caches, logits = {}, {}
+    for mode in ("base", "par"):
+        kw = {} if mode == "base" else dict(mesh=mesh,
+                                            tp_compute="parallel")
+        cache = gen.init_paged_cache(cfg, 2, mb, 2 * mb, BS, "")
+        tables = np.arange(2 * mb, dtype=np.int32).reshape(2, mb)
+        cache = cache._replace(tables=jnp.asarray(tables))
+        rows = []
+        for i, pr in enumerate(prompts):
+            lg, cache = gen.prefill_into_paged(
+                cfg, params, jnp.asarray(pr[None]), cache,
+                jnp.asarray(i, jnp.int32), **kw)
+            rows.append(np.asarray(lg))
+        caches[mode], logits[mode] = cache, jnp.asarray(
+            np.concatenate(rows, axis=0))
+    scale = float(jnp.max(jnp.abs(logits["base"]))) + 1e-30
+    for _ in range(6):
+        toks = logits["base"].argmax(-1).astype(jnp.int32)
+        assert np.array_equal(
+            np.asarray(toks),
+            np.asarray(logits["par"].argmax(-1).astype(jnp.int32)))
+        err = float(jnp.max(jnp.abs(logits["base"] - logits["par"])))
+        assert err <= tol["atol"] + tol["rtol"] * scale, (
+            f"psum drift {err:.2e} exceeds the declared contract "
+            f"{tol}")
+        logits["base"], caches["base"] = gen.decode_step_paged(
+            cfg, params, toks[:, None], caches["base"])
+        logits["par"], caches["par"] = gen.decode_step_paged(
+            cfg, params, toks[:, None], caches["par"], mesh=mesh,
+            tp_compute="parallel")
+
+
 def test_tp_rejects_indivisible_heads(cfg, params):
     """n_kv_heads % tp != 0 must refuse with the divisibility message,
     not shard garbage."""
     with pytest.raises(ValueError, match="n_kv_heads"):
         ServingEngine(cfg, params, n_slots=2, max_seq=MAX_SEQ,
                       prefill_mode="bucketed", block_size=BS, tp=3)
+
+
+def test_tp_structured_refusal(cfg):
+    """check_tp_heads emits ONE structured refusal listing every
+    violated constraint — n_kv_heads divisibility, d_ff divisibility
+    (parallel mode only), and MoE — instead of failing on the first."""
+    # d_ff=90 breaks d_ff % 4 while n_kv_heads=4 still divides.
+    odd_ff = tfm.tiny_config(n_kv_heads=4, d_ff=90)
+    with pytest.raises(ValueError, match="d_ff"):
+        gen.check_tp_heads(odd_ff, 4, "parallel")
+    # Gathered mode never touches d_ff: same config passes.
+    gen.check_tp_heads(odd_ff, 4, "gathered")
+    # MoE refuses in EVERY mode (expert tensors have no serving-shard
+    # layout), and the refusal names MoE.
+    moe = tfm.tiny_moe_config(n_kv_heads=4)
+    for mode in ("gathered", "parallel"):
+        with pytest.raises(ValueError, match="[Mm]o[Ee]"):
+            gen.check_tp_heads(moe, 2, mode)
+    # All violations at once -> one message carrying each of them.
+    bad = tfm.tiny_moe_config(n_kv_heads=2, d_ff=90)
+    with pytest.raises(ValueError) as ei:
+        gen.check_tp_heads(bad, 4, "parallel")
+    msg = str(ei.value)
+    assert "n_kv_heads" in msg and "d_ff" in msg and "moe" in msg.lower()
+    assert msg.count("\n") >= 2       # one bullet per violation
+    # tp=1 is always a no-op refusal-wise.
+    gen.check_tp_heads(moe, 1, "parallel")
 
 
 def test_tp_stats_record_mesh_shape(cfg, params):
