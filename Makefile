@@ -6,6 +6,7 @@ PY ?= python
 .PHONY: all native test test-fast test-tp test-obs test-sampling \
 	test-pallas bench \
 	bench-cp bench-serve bench-overload bench-prefix bench-fleet \
+	bench-disagg \
 	bench-spec bench-paged bench-tp bench-obs bench-sampling clean stamp
 
 # Build-stamp analog of the reference's ldflags version injection
@@ -101,6 +102,22 @@ bench-fleet:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_bench.py --smoke \
 		--trace /tmp/fleet_trace.json \
 		--json benchmarks/fleet_bench_summary.json
+
+# Prefill/decode disaggregation leg only (capacity probe + leg 5 of
+# fleet_bench.py): one prefill-role replica feeding decode-role
+# replicas by KV-page migration vs the best colocated router at EQUAL
+# replica count, on a hot-prefix workload with tight deadlines. Gates
+# on >=1.15x goodput, TTFT p99 no worse (deadline-censored over ALL
+# arrivals, paired with first-token SLO attainment — uncensored
+# percentiles reward routers that starve their stragglers), at least
+# one zero-copy (pointer-transfer) migration, and the
+# migrate_export/migrate_install spans stitching under one rid in the
+# exported trace — see
+# docs/serving.md. The checked-in summary comes from bench-fleet (all
+# legs); this target is the fast iteration loop.
+bench-disagg:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_bench.py --smoke \
+		--only-disagg --trace /tmp/disagg_trace.json
 
 # Speculative-decoding benchmark: radix drafting on repeat traffic
 # (greedy outputs asserted bit-identical before timing; exits nonzero

@@ -21,6 +21,14 @@ legs, each with a hard acceptance gate:
 * **rollout** — mid-traffic ``rolling_restart`` of every replica
   (cordon -> drain -> re-dispatch sheds -> replace): ZERO dropped
   requests — every arrival completes, none rejected, none lost.
+* **disagg** — prefill/decode disaggregation at EQUAL replica count:
+  one prefill-role replica (its trie sees every system prompt, so
+  prefill is almost always a radix hit) hands finished prefills to
+  decode-role replicas by KV-page migration, vs the best colocated
+  router (affinity or random) on the identical Poisson arrival
+  schedule. Gates: goodput(disagg) >= 1.15x best colocated, TTFT p99
+  no worse, and at least one migration rode the zero-copy
+  pointer-transfer path (``migrated_zero_copy_tokens > 0``).
 
 Prints one JSON object; ``--json`` also writes it to a file. Run via
 ``make bench-fleet`` (smoke config) — full numbers live in
@@ -44,10 +52,15 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 def make_fleet_requests(cfg, n: int, n_prompts: int, shared_len: int,
                         tail_max: int, budgets, seed: int,
-                        deadline_s: Optional[float], rid0: int = 0):
+                        deadline_s: Optional[float], rid0: int = 0,
+                        hot: float = 0.0):
     """Shared-system-prompt traffic: each request draws one of
     ``n_prompts`` system prompts plus a short unique tail — the shape
-    prefix caching (and therefore affinity routing) exists for."""
+    prefix caching (and therefore affinity routing) exists for.
+    ``hot`` skews popularity: that fraction of requests all use system
+    prompt 0 (a "hot" assistant persona), the rest draw uniformly —
+    the shape that punishes routers which couple decode placement to
+    prefix locality."""
     import numpy as np
 
     from kubeflow_controller_tpu.dataplane.serving_engine import Request
@@ -57,7 +70,10 @@ def make_fleet_requests(cfg, n: int, n_prompts: int, shared_len: int,
                for _ in range(n_prompts)]
     out = []
     for i in range(n):
-        sysp = systems[int(rng.integers(0, n_prompts))]
+        if hot > 0.0 and rng.random() < hot:
+            sysp = systems[0]
+        else:
+            sysp = systems[int(rng.integers(0, n_prompts))]
         tail = rng.integers(0, cfg.vocab_size,
                             1 + int(rng.integers(0, tail_max)))
         out.append(Request(
@@ -192,6 +208,25 @@ def main(argv=None) -> int:
     p.add_argument("--max-queue", type=int, default=8)
     p.add_argument("--grace-s", type=float, default=10.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--disagg-n-prompts", type=int, default=2,
+                   help="distinct system prompts in the disagg leg "
+                        "(few + long = prefill-heavy)")
+    p.add_argument("--disagg-shared-len", type=int, default=48)
+    p.add_argument("--disagg-load", type=float, default=0.85,
+                   help="offered load for the disagg leg as a fraction "
+                        "of colocated fleet capacity")
+    p.add_argument("--disagg-hot", type=float, default=0.6,
+                   help="fraction of disagg-leg requests that share ONE "
+                        "hot system prompt (skew that punishes "
+                        "prefix-coupled decode placement)")
+    p.add_argument("--disagg-deadline-factor", type=float, default=3.0,
+                   help="disagg-leg deadline as a multiple of mean "
+                        "service time (tighter than the chaos leg: "
+                        "deadline misses are the failure mode "
+                        "disaggregation removes)")
+    p.add_argument("--only-disagg", action="store_true",
+                   help="skip legs 1-4: capacity probe + the "
+                        "disaggregation leg only (make bench-disagg)")
     p.add_argument("--smoke", action="store_true",
                    help="small fast config for CI")
     p.add_argument("--trace", default="",
@@ -227,7 +262,8 @@ def main(argv=None) -> int:
     params = gen.inference_params(
         cfg, tfm.init_params(cfg, jax.random.key(0)))
     budgets = [int(x) for x in args.budgets.split(",")]
-    max_seq = args.shared_len + args.tail_max + max(budgets) + args.block_size
+    max_seq = (max(args.shared_len, args.disagg_shared_len)
+               + args.tail_max + max(budgets) + args.block_size)
 
     # ONE tracer shared by every engine, every router, and the
     # controller runtime: spans from all hops land in one ring keyed by
@@ -248,6 +284,14 @@ def main(argv=None) -> int:
     warm = make_fleet_requests(
         cfg, 3, 1, args.shared_len, args.tail_max, budgets,
         seed=999, deadline_s=None, rid0=10_000_000)
+    # One n=2 warm request per engine: _fork_fn is a per-engine jit and
+    # also activates migrated slots, so the fork warm keeps the disagg
+    # leg's first admit_migrated out of the compile shadow.
+    from kubeflow_controller_tpu.dataplane.sampling import SamplingParams
+    from kubeflow_controller_tpu.dataplane.serving_engine import Request
+    warm.append(Request(
+        rid=10_000_100, prompt=warm[0].prompt.copy(), max_new_tokens=4,
+        params=SamplingParams(temperature=0.5, seed=7, n=2)))
     pool = EnginePool(mk_engine, warm)
     pool.prewarm(args.replicas + 1)
 
@@ -293,10 +337,11 @@ def main(argv=None) -> int:
         return {"prefix_hit_rate": router.prefix_hit_rate,
                 "affinity_hits": float(router.affinity_hits)}
 
-    aff = run_affinity_leg(affinity=True)
-    rnd = run_affinity_leg(affinity=False)
-    hit_ratio = (aff["prefix_hit_rate"] / rnd["prefix_hit_rate"]
-                 if rnd["prefix_hit_rate"] > 0 else float("inf"))
+    if not args.only_disagg:
+        aff = run_affinity_leg(affinity=True)
+        rnd = run_affinity_leg(affinity=False)
+        hit_ratio = (aff["prefix_hit_rate"] / rnd["prefix_hit_rate"]
+                     if rnd["prefix_hit_rate"] > 0 else float("inf"))
 
     # -- legs 2+3 share the controller-reconciled fleet -------------------
     ns = "default"
@@ -383,40 +428,149 @@ def main(argv=None) -> int:
             "prefix_hit_rate": round(router.prefix_hit_rate, 3),
         }
 
-    baseline = run_traffic(chaos_kills=0, seed=args.seed + 10)
-    chaos_run = run_traffic(chaos_kills=args.kills, seed=args.seed + 10)
-    retention = (chaos_run["goodput_tps"] / baseline["goodput_tps"]
-                 if baseline["goodput_tps"] > 0 else 0.0)
+    if not args.only_disagg:
+        baseline = run_traffic(chaos_kills=0, seed=args.seed + 10)
+        chaos_run = run_traffic(chaos_kills=args.kills, seed=args.seed + 10)
+        retention = (chaos_run["goodput_tps"] / baseline["goodput_tps"]
+                     if baseline["goodput_tps"] > 0 else 0.0)
 
-    # -- leg 4: rolling restart, zero drops -------------------------------
-    router = FleetRouter(clock=time.perf_counter,
-                         block_size=args.block_size, tracer=tracer)
-    factory = pool.factory(router)
-    for r in range(args.replicas):
-        router.add_replica(f"replica-{r}", factory(f"replica-{r}"))
-    rate = 0.5 * fleet_rps
-    arrivals = poisson_arrivals(rate, args.duration_s, args.seed + 20)
-    reqs = make_fleet_requests(
-        cfg, len(arrivals), args.n_prompts, args.shared_len,
-        args.tail_max, budgets, seed=args.seed + 21, deadline_s=None)
-    restart = [(args.duration_s / 2,
-                lambda: router.rolling_restart(factory, args.grace_s))]
-    drive_open_loop(router, reqs, arrivals, chaos=restart)
-    assert_conserved(router, len(arrivals), "rollout")
-    rollout_counts = router.outcome_counts
-    rollout_zero_drop = (
-        rollout_counts["completed"] == len(arrivals)
-        and rollout_counts["rejected"] == 0
-        and all(c.finish_reason in ("eos", "length")
-                for c in router.completions))
+        # -- leg 4: rolling restart, zero drops ---------------------------
+        router = FleetRouter(clock=time.perf_counter,
+                             block_size=args.block_size, tracer=tracer)
+        factory = pool.factory(router)
+        for r in range(args.replicas):
+            router.add_replica(f"replica-{r}", factory(f"replica-{r}"))
+        rate = 0.5 * fleet_rps
+        arrivals = poisson_arrivals(rate, args.duration_s, args.seed + 20)
+        reqs = make_fleet_requests(
+            cfg, len(arrivals), args.n_prompts, args.shared_len,
+            args.tail_max, budgets, seed=args.seed + 21, deadline_s=None)
+        restart = [(args.duration_s / 2,
+                    lambda: router.rolling_restart(factory, args.grace_s))]
+        drive_open_loop(router, reqs, arrivals, chaos=restart)
+        assert_conserved(router, len(arrivals), "rollout")
+        rollout_counts = router.outcome_counts
+        rollout_zero_drop = (
+            rollout_counts["completed"] == len(arrivals)
+            and rollout_counts["rejected"] == 0
+            and all(c.finish_reason in ("eos", "length")
+                    for c in router.completions))
 
-    gates = {
-        "hit_ratio_ge_1_5": hit_ratio >= 1.5,
-        "retention_ge_0_8": retention >= 0.8,
-        "chaos_conserved": True,     # assert_conserved already enforced
-        "at_most_once": chaos_run["duplicate_completions"] == 0,
-        "rollout_zero_drop": rollout_zero_drop,
-    }
+    # -- leg 5: prefill/decode disaggregation vs colocated ----------------
+    # Equal replica count, identical Poisson arrival schedule, skewed
+    # popularity (one hot system prompt), tight deadlines. The
+    # colocated routers are caught in a bind disaggregation removes:
+    # affinity routing converges the hot prefix's cache on one replica
+    # but then DECODES the hot traffic there too (queueing -> deadline
+    # misses), while random dispatch balances load but re-prefills the
+    # prefix everywhere (per-slot prefill chunks stall co-resident
+    # decodes). The disagg fleet decouples the two — the prefill
+    # replica's trie sees every prompt (near-total radix hits), and
+    # decode placement follows slot/page HEADROOM, not prefix locality.
+    import copy as copy_mod
+
+    d_rate = args.disagg_load * fleet_rps
+    d_deadline = args.disagg_deadline_factor * mean_service_s
+    d_arrivals = poisson_arrivals(d_rate, args.duration_s, args.seed + 30)
+    d_reqs = make_fleet_requests(
+        cfg, len(d_arrivals), args.disagg_n_prompts,
+        args.disagg_shared_len, args.tail_max, budgets,
+        seed=args.seed + 31, deadline_s=d_deadline,
+        hot=args.disagg_hot)
+
+    # Compile-before-timing, migration edition: gather/install are
+    # module-level jits with one variant per power-of-two page count,
+    # and the first timed migration would otherwise pay every variant's
+    # compile inside its TTFT (a ~1 s tail pinned on whichever request
+    # migrates first). Warm them on a scratch copy of a pool engine's
+    # cache; the donated scratch buffers are discarded.
+    import jax.numpy as jnp
+    spare = pool.engines[0]
+    scratch = jax.tree_util.tree_map(jnp.copy, spare.cache)
+    for m in (1, 2, 4, 8, 16):
+        ids = list(range(m))
+        pk, pv, sk, sv = gen.gather_pool_pages(spare.cache, ids)
+        scratch = gen.install_pool_pages(scratch, pk, pv, sk, sv, ids)
+    del scratch
+
+    def run_disagg_leg(mode: str) -> Dict[str, float]:
+        router = FleetRouter(clock=time.perf_counter,
+                             block_size=args.block_size,
+                             affinity=(mode != "random"), tracer=tracer)
+        factory = pool.factory(router)
+        if mode == "disagg":
+            router.add_replica("prefill-0", factory("prefill-0"),
+                               role="prefill")
+            for r in range(args.replicas - 1):
+                router.add_replica(f"decode-{r}", factory(f"decode-{r}"),
+                                   role="decode")
+        else:
+            for r in range(args.replicas):
+                router.add_replica(f"replica-{r}", factory(f"replica-{r}"))
+        reqs = [copy_mod.deepcopy(r) for r in d_reqs]
+        wall = drive_open_loop(router, reqs, d_arrivals)
+        assert_conserved(router, len(d_arrivals), f"disagg:{mode}")
+        fs = router.fleet_summary()
+        # TTFT p99 over ALL arrivals, censored at the deadline: a
+        # request that never produced a first token (starved in a
+        # queue, shed, deadline-killed while parked) counts AT the
+        # deadline, and a first token past the deadline counts the
+        # same — the request already failed its SLO. Without censoring
+        # the percentile rewards routers that starve their stragglers
+        # outright: the excluded requests are exactly the worst ones,
+        # and a router delivering 3/21 first tokens would post a
+        # better "p99" than one delivering 11/21 on time.
+        ttfts = [c.ttft_s for c in router.completions
+                 if c.ttft_s is not None]
+        vals = sorted(min(t, d_deadline) for t in ttfts)
+        vals += [d_deadline] * max(0, len(d_arrivals) - len(vals))
+        vals.sort()
+        p99_ms = (vals[min(len(vals) - 1, int(0.99 * len(vals)))] * 1e3
+                  if vals else float("inf"))
+        attainment = (sum(1 for t in ttfts if t <= d_deadline)
+                      / len(d_arrivals) if d_arrivals else 0.0)
+        counts = router.outcome_counts
+        return {
+            "goodput_tps": round(goodput_tps(router, d_deadline, wall), 1),
+            "ttft_p99_ms": round(p99_ms, 2),
+            "ttft_attainment": round(attainment, 3),
+            "completed": counts["completed"],
+            "rejected": counts["rejected"],
+            "migrations": int(fs.get("migrations", 0)),
+            "pages_migrated": int(fs.get("pages_migrated", 0)),
+            "migration_bytes": int(fs.get("migration_bytes", 0)),
+            "migrated_zero_copy_tokens":
+                int(fs.get("migrated_zero_copy_tokens", 0)),
+            "prefix_hit_rate": round(router.prefix_hit_rate, 3),
+        }
+
+    disagg = run_disagg_leg("disagg")
+    colo_aff = run_disagg_leg("affinity")
+    colo_rnd = run_disagg_leg("random")
+    best_colo = max((colo_aff, colo_rnd), key=lambda d: d["goodput_tps"])
+    disagg_ratio = (disagg["goodput_tps"] / best_colo["goodput_tps"]
+                    if best_colo["goodput_tps"] > 0 else float("inf"))
+
+    gates = {}
+    if not args.only_disagg:
+        gates.update({
+            "hit_ratio_ge_1_5": hit_ratio >= 1.5,
+            "retention_ge_0_8": retention >= 0.8,
+            "chaos_conserved": True,  # assert_conserved already enforced
+            "at_most_once": chaos_run["duplicate_completions"] == 0,
+            "rollout_zero_drop": rollout_zero_drop,
+        })
+    gates.update({
+        "disagg_goodput_ge_1_15": disagg_ratio >= 1.15,
+        # Censored p99 saturates at the deadline once either side
+        # misses 1% of first tokens, so the no-worse check pairs it
+        # with first-token SLO attainment — the quantity the censoring
+        # protects.
+        "disagg_ttft_p99_no_worse":
+            disagg["ttft_p99_ms"] <= best_colo["ttft_p99_ms"]
+            and disagg["ttft_attainment"] >= best_colo["ttft_attainment"],
+        "disagg_zero_copy": disagg["migrated_zero_copy_tokens"] > 0,
+    })
     obs = {}
     if tracer is not None:
         from kubeflow_controller_tpu.obs.trace import load_chrome_trace
@@ -444,18 +598,32 @@ def main(argv=None) -> int:
                  or ("dataplane", "admit") in names)
             and ("dataplane", "retire") in names)
         gates["trace_stitched"] = stitched > 0
-        gates["trace_has_control_plane"] = "control" in cats_seen
+        if not args.only_disagg:
+            gates["trace_has_control_plane"] = "control" in cats_seen
+        # Migration-stitched gate: the prefill replica's migrate_export
+        # span and the decode replica's migrate_install span land in
+        # the ONE exported file under the same rid — the cross-engine
+        # handoff is a single causal chain in the trace.
+        mig_stitched = sum(
+            1 for names in by_rid.values()
+            if ("dataplane", "migrate_export") in names
+            and ("dataplane", "migrate_install") in names)
+        gates["migrate_spans_stitched"] = mig_stitched > 0
         obs = {
             "trace_file": args.trace,
             "spans_recorded": tracer.spans_recorded,
             "spans_dropped": tracer.spans_dropped,
             "stitched_requests": stitched,
+            "migrate_stitched_requests": mig_stitched,
             "tracks": sorted(c for c in cats_seen if c),
         }
     out = {
-        "metric": "fleet_chaos_goodput_retention",
-        "value": round(retention, 3),
-        "unit": "goodput(chaos) / goodput(no chaos), same arrivals",
+        "metric": ("disagg_goodput_ratio" if args.only_disagg
+                   else "fleet_chaos_goodput_retention"),
+        "value": round(disagg_ratio if args.only_disagg else retention, 3),
+        "unit": ("goodput(disagg) / goodput(best colocated), same arrivals"
+                 if args.only_disagg
+                 else "goodput(chaos) / goodput(no chaos), same arrivals"),
         "acceptance": all(gates.values()),
         "gates": gates,
         "capacity": {
@@ -463,14 +631,15 @@ def main(argv=None) -> int:
             "fleet_rps": round(fleet_rps, 2),
             "deadline_s": round(deadline_s, 3),
         },
-        "affinity": {
-            "hit_rate": round(aff["prefix_hit_rate"], 3),
-            "random_hit_rate": round(rnd["prefix_hit_rate"], 3),
-            "ratio": round(hit_ratio, 2),
+        "disagg": {
+            "goodput_ratio": round(disagg_ratio, 3),
+            "arrivals": len(d_arrivals),
+            "offered_rps": round(d_rate, 2),
+            "deadline_s": round(d_deadline, 3),
+            "disagg": disagg,
+            "colocated_affinity": colo_aff,
+            "colocated_random": colo_rnd,
         },
-        "baseline": baseline,
-        "chaos": chaos_run,
-        "rollout": rollout_counts,
         "observability": obs,
         "workload": {
             "replicas": args.replicas, "slots": args.slots,
@@ -479,8 +648,22 @@ def main(argv=None) -> int:
             "shared_len": args.shared_len,
             "budgets": budgets, "load": args.load,
             "duration_s": args.duration_s, "kills": args.kills,
+            "disagg_n_prompts": args.disagg_n_prompts,
+            "disagg_shared_len": args.disagg_shared_len,
+            "disagg_load": args.disagg_load,
+            "disagg_hot": args.disagg_hot,
+            "disagg_deadline_factor": args.disagg_deadline_factor,
         },
     }
+    if not args.only_disagg:
+        out["affinity"] = {
+            "hit_rate": round(aff["prefix_hit_rate"], 3),
+            "random_hit_rate": round(rnd["prefix_hit_rate"], 3),
+            "ratio": round(hit_ratio, 2),
+        }
+        out["baseline"] = baseline
+        out["chaos"] = chaos_run
+        out["rollout"] = rollout_counts
     line = json.dumps(out)
     print(line)
     if args.json:

@@ -504,6 +504,12 @@ class LMServiceSpec(Sealable):
     slo: SLOSpec = field(default_factory=SLOSpec)
     # Per-replica bounded admission queue depth (engine.max_queue).
     max_queue: int = 8
+    # Prefill/decode disaggregation (docs/lmservice.md): the first
+    # ``prefill_replicas`` indices run as dedicated prefill replicas and
+    # the rest as decode replicas. 0 (the default) keeps every replica
+    # "mixed" — the pre-disaggregation behavior. Must be < replicas when
+    # set: a fleet of only-prefill replicas could never decode a token.
+    prefill_replicas: int = 0
     # Stamped once at first reconcile, immutable after — same contract as
     # TPUJobSpec.runtime_id.
     runtime_id: str = ""
@@ -514,6 +520,7 @@ class LMServiceSpec(Sealable):
             replicas=self.replicas,
             slo=self.slo.deepcopy(),
             max_queue=self.max_queue,
+            prefill_replicas=self.prefill_replicas,
             runtime_id=self.runtime_id,
         )
 

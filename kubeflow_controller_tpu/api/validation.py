@@ -104,6 +104,14 @@ def validate_lmservice(svc: types.LMService) -> None:
         errs.append("spec.replicas must be an integer >= 1")
     if type(svc.spec.max_queue) is not int or svc.spec.max_queue < 1:
         errs.append("spec.maxQueue must be an integer >= 1")
+    if (type(svc.spec.prefill_replicas) is not int
+            or svc.spec.prefill_replicas < 0):
+        errs.append("spec.prefillReplicas must be an integer >= 0")
+    elif (type(svc.spec.replicas) is int
+            and svc.spec.prefill_replicas >= max(svc.spec.replicas, 1)
+            and svc.spec.prefill_replicas > 0):
+        errs.append("spec.prefillReplicas must be < spec.replicas "
+                    "(some replica has to decode)")
     if svc.spec.slo.ttft_p99_ms < 0:
         errs.append("spec.slo.ttftP99Ms must be >= 0")
     if svc.spec.slo.deadline_s < 0:

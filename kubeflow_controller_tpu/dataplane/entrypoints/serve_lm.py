@@ -166,6 +166,7 @@ def serve(
     attn_impl: str = "xla",
     mesh_devices: str = "",
     trace: str = "",
+    disagg: bool = False,
     stop=None,
 ) -> Dict[str, float]:
     """``stop`` is a ``threading.Event`` (e.g. from
@@ -204,6 +205,12 @@ def serve(
     if (n > 1 or grammar) and turns > 1:
         raise ValueError(
             "--n / --grammar are single-turn engine features (turns == 1)")
+    if disagg and turns > 1:
+        raise ValueError("--disagg is a single-turn engine feature")
+    if disagg and not paged:
+        raise ValueError(
+            "--disagg migrates KV pages between engines and requires "
+            "the paged block pool (drop --no-paged)")
     if (top_k > 0 or top_p < 1.0) and turns > 1 and not prefix_cache:
         raise ValueError(
             "top-k/top-p serve through the engine; the contiguous "
@@ -258,17 +265,21 @@ def serve(
         # --eos-id set, finished rows retire early and their slots admit
         # the next queued request instead of idling to batch completion.
         n_slots = min(slots, b) if slots > 0 else b
-        engine = ServingEngine(
-            cfg, params, n_slots=n_slots, max_seq=s + max_new_tokens,
-            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
-            max_queue=max_queue,
-            prefill_mode=("bucketed" if prefix_cache else prefill_mode),
-            prefix_cache=prefix_cache, block_size=block_size,
-            kv_hbm_budget_mb=kv_pool_mb, kv_quant=kv_quant, paged=paged,
-            spec_decode=speculative, draft_k=draft_k, proposer=proposer,
-            tp=tp, mesh=mesh, tp_compute=tp_compute, attn_impl=attn_impl,
-            tracer=tracer,
-        )
+
+        def _mk_engine(pm: str, pc: bool) -> ServingEngine:
+            return ServingEngine(
+                cfg, params, n_slots=n_slots,
+                max_seq=s + max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                seed=seed, max_queue=max_queue,
+                prefill_mode=pm, prefix_cache=pc, block_size=block_size,
+                kv_hbm_budget_mb=kv_pool_mb, kv_quant=kv_quant,
+                paged=paged, spec_decode=speculative, draft_k=draft_k,
+                proposer=proposer, tp=tp, mesh=mesh,
+                tp_compute=tp_compute, attn_impl=attn_impl,
+                tracer=tracer,
+            )
+
         # One shared per-request params object: sampling state is keyed
         # on (seed, gen, position), so requests never share mutable RNG
         # state; the grammar mask object is stateless too (FSM state
@@ -284,45 +295,100 @@ def serve(
             )
         prompts_np = np.asarray(prompts)
         completions = []
-        for i in range(b):
-            try:
-                engine.submit(Request(
+        if disagg:
+            # Prefill/decode disaggregation in one process
+            # (docs/serving.md): a two-replica fleet — one prefill, one
+            # decode — over the FleetRouter, on ONE tracer, so the
+            # migrate_export/migrate_install spans stitch per rid. The
+            # streams are bit-identical to the single-engine path.
+            from kubeflow_controller_tpu.dataplane.router import (
+                FleetRouter,
+            )
+            engines = {
+                "prefill-0": _mk_engine("bucketed", True),
+                "decode-0": _mk_engine("bucketed", True),
+            }
+            router = FleetRouter(clock=time.perf_counter,
+                                 block_size=block_size, tracer=tracer)
+            router.add_replica("prefill-0", engines["prefill-0"],
+                               role="prefill")
+            router.add_replica("decode-0", engines["decode-0"],
+                               role="decode")
+            for i in range(b):
+                router.submit(Request(
                     rid=i, prompt=prompts_np[i],
                     max_new_tokens=max_new_tokens, eos_id=eos_id,
                     deadline_s=deadline_s, params=req_params,
                 ))
-            except Rejected as e:
-                logger.warning("request %d rejected: %s", i, e.reason)
-        max_steps = b * n * max_new_tokens + 2 * b * n + 4
-        announced = False
-        for _ in range(max_steps):
-            if stop is not None and stop.is_set():
-                logger.info(
-                    "stop requested: draining engine (grace %.1fs)",
-                    drain_grace_s)
-                completions.extend(engine.drain(drain_grace_s))
-                interrupted = True
-                break
-            completions.extend(engine.step())
-            if not announced and engine.stats.tokens_out > 0:
-                # Marker for harnesses that want to interrupt mid-decode
-                # (tests/test_signals.py) — decoding has really started.
-                logger.info("serving: first tokens decoded")
-                announced = True
-            if engine.idle:
-                break
-        if not interrupted and not engine.idle:
-            # Step-budget overrun is an engine bug, but the operator
-            # still gets every completion that did finish.
-            logger.error("engine failed to drain; flushing partials")
-            completions.extend(engine.drain(0.0))
+            max_steps = 2 * (b * n * max_new_tokens + 2 * b * n + 4)
+            for _ in range(max_steps):
+                if stop is not None and stop.is_set():
+                    logger.info(
+                        "stop requested: draining fleet (grace %.1fs)",
+                        drain_grace_s)
+                    for e in engines.values():
+                        completions.extend(e.drain(drain_grace_s))
+                    interrupted = True
+                    break
+                completions.extend(router.step())
+                if router.idle:
+                    break
+            if not interrupted and not router.idle:
+                logger.error("fleet failed to drain; flushing partials")
+                for e in engines.values():
+                    completions.extend(e.drain(0.0))
+            dt = time.perf_counter() - t0
+            # Decode-side stats carry the tokens; migration counters
+            # come from the fleet aggregate (both engines + router).
+            serving = engines["decode-0"].stats.summary(wall_s=dt)
+            fleet = router.fleet_summary()
+            for k in ("migrations", "pages_migrated", "migration_bytes",
+                      "migrated_zero_copy_tokens"):
+                serving[k] = fleet[k]
+        else:
+            engine = _mk_engine(
+                "bucketed" if prefix_cache else prefill_mode,
+                prefix_cache)
+            for i in range(b):
+                try:
+                    engine.submit(Request(
+                        rid=i, prompt=prompts_np[i],
+                        max_new_tokens=max_new_tokens, eos_id=eos_id,
+                        deadline_s=deadline_s, params=req_params,
+                    ))
+                except Rejected as e:
+                    logger.warning("request %d rejected: %s", i, e.reason)
+            max_steps = b * n * max_new_tokens + 2 * b * n + 4
+            announced = False
+            for _ in range(max_steps):
+                if stop is not None and stop.is_set():
+                    logger.info(
+                        "stop requested: draining engine (grace %.1fs)",
+                        drain_grace_s)
+                    completions.extend(engine.drain(drain_grace_s))
+                    interrupted = True
+                    break
+                completions.extend(engine.step())
+                if not announced and engine.stats.tokens_out > 0:
+                    # Marker for harnesses that want to interrupt
+                    # mid-decode (tests/test_signals.py) — decoding has
+                    # really started.
+                    logger.info("serving: first tokens decoded")
+                    announced = True
+                if engine.idle:
+                    break
+            if not interrupted and not engine.idle:
+                # Step-budget overrun is an engine bug, but the operator
+                # still gets every completion that did finish.
+                logger.error("engine failed to drain; flushing partials")
+                completions.extend(engine.drain(0.0))
+            dt = time.perf_counter() - t0
+            serving = engine.stats.summary(wall_s=dt)
         completions.sort(key=lambda c: (c.rid, c.gen))
         rids = [c.rid for c in completions]
         gens = [c.gen for c in completions]
         finish_reasons = [c.finish_reason for c in completions]
         tok_rows = [c.tokens for c in completions]
-        dt = time.perf_counter() - t0
-        serving = engine.stats.summary(wall_s=dt)
     elif prefix_cache:
         # Multi-turn through the ENGINE with the radix prefix cache:
         # every turn submits the FULL conversation so far as a fresh
@@ -540,6 +606,12 @@ def main(argv=None) -> int:
                    help="radix-trie prefix reuse over a shared KV block "
                         "pool (implies bucketed prefill); with --turns, "
                         "each turn reuses the previous turn's blocks")
+    p.add_argument("--disagg", action="store_true",
+                   help="prefill/decode disaggregation: serve through a "
+                        "two-replica in-process fleet (one prefill + "
+                        "one decode engine) with cross-engine KV-page "
+                        "migration — bit-identical streams, one "
+                        "stitched trace (docs/serving.md)")
     p.add_argument("--block-size", type=int, default=16,
                    help="KV page size in tokens (power of two) for the "
                         "block pool and prefill chunking")
@@ -681,6 +753,7 @@ def main(argv=None) -> int:
         attn_impl=args.attn_impl,
         mesh_devices=args.mesh,
         trace=args.trace,
+        disagg=args.disagg,
         stop=stop,
     )
     if metrics["interrupted"]:

@@ -166,6 +166,21 @@ class ServingStats:
     cow_page_copies: int = 0
     fork_shared_tokens: int = 0
     mask_tokens_filtered: int = 0
+    # Cross-engine KV migration (docs/serving.md "Prefill/decode
+    # disaggregation"): ``migrated_out`` counts requests this engine
+    # prefilled and handed off, ``migrated_in`` requests it adopted
+    # mid-flight; ``pages_migrated`` pool pages installed from a
+    # payload, ``migration_bytes`` the payload bytes this engine
+    # exported (counted once fleet-wide, on the export side), and
+    # ``migrated_zero_copy_tokens`` prompt tokens whose pages arrived
+    # as POINTERS — the decode-side trie already held the prefix, so
+    # the hop shipped refcounts instead of bytes (the migration twin of
+    # ``prefix_zero_copy_tokens``).
+    migrated_in: int = 0
+    migrated_out: int = 0
+    pages_migrated: int = 0
+    migration_bytes: int = 0
+    migrated_zero_copy_tokens: int = 0
 
     def record(self, completion) -> None:
         self.finished += 1
@@ -237,6 +252,12 @@ class ServingStats:
             "cow_page_copies": float(self.cow_page_copies),
             "fork_shared_tokens": float(self.fork_shared_tokens),
             "mask_tokens_filtered": float(self.mask_tokens_filtered),
+            "migrated_in": float(self.migrated_in),
+            "migrated_out": float(self.migrated_out),
+            "pages_migrated": float(self.pages_migrated),
+            "migration_bytes": float(self.migration_bytes),
+            "migrated_zero_copy_tokens": float(
+                self.migrated_zero_copy_tokens),
             "prefill_compiles": float(self.prefill_compiles),
             "prefill_chunks": float(self.prefill_chunks),
             "admit_cache_size": float(self.admit_cache_size),

@@ -76,6 +76,17 @@ def _fnv(data: bytes) -> int:
     return h
 
 
+#: replica roles for prefill/decode disaggregation. "mixed" (the
+#: default) serves requests end-to-end — a fleet of all-mixed replicas
+#: behaves exactly as before this field existed. When the fleet holds
+#: BOTH a "prefill" and a "decode" replica, the router goes two-stage:
+#: fresh requests dispatch to prefill/mixed replicas only (prefix
+#: affinity preserved), prefill-role replicas run prefill_only
+#: admissions, and finished prefills migrate to decode/mixed replicas
+#: by KV-page transfer (docs/serving.md).
+ROLES = ("prefill", "decode", "mixed")
+
+
 @dataclass
 class ReplicaHandle:
     """One replica as the router sees it. ``healthy``/``cordoned`` gate
@@ -84,6 +95,7 @@ class ReplicaHandle:
 
     name: str
     engine: ServingEngine
+    role: str = "mixed"
     healthy: bool = True
     cordoned: bool = False
     strikes: int = 0        # consecutive bad health checks
@@ -97,6 +109,15 @@ class ReplicaHandle:
     @property
     def load(self) -> int:
         return len(self.engine.queue) + self.engine.n_active
+
+    @property
+    def free_slots(self) -> int:
+        return self.engine.n_slots - self.engine.n_active
+
+    @property
+    def free_pages(self) -> int:
+        pool = self.engine.pool
+        return pool.n_blocks - pool.used_blocks
 
 
 @dataclass
@@ -154,6 +175,10 @@ class FleetRouter:
         self._outcomes: Dict[int, Tuple[str, object]] = {}
         self._parked: List[_Parked] = []
         self.completions: List[Completion] = []
+        # rid -> delivered generation ids, for n>1 requests: every gen's
+        # Completion delivers (dedup key is (rid, gen)), and the rid's
+        # single terminal outcome records only when the LAST gen lands.
+        self._gens_done: Dict[int, set] = {}
 
         # Fleet counters (see docstring accounting contract).
         self.submitted = 0
@@ -163,13 +188,20 @@ class FleetRouter:
         self.ejections = 0
         self.readmissions = 0
         self.affinity_hits = 0
-        # Prefix + speculative-decoding accounting folded in from
-        # killed/replaced engines so fleet hit/acceptance rates survive
-        # chaos.
+        # Completed prefill->decode handoffs (two-stage fleets).
+        self.migrations = 0
+        # Prefix + speculative-decoding + migration accounting folded in
+        # from killed/replaced engines so fleet rates and counters
+        # survive chaos AND rolling restarts (every engine passes
+        # through _fold_stats before the router lets go of it).
         self._retired_hit_tokens = 0
         self._retired_lookup_tokens = 0
         self._retired_draft_proposed = 0
         self._retired_draft_accepted = 0
+        self._retired_pages_migrated = 0
+        self._retired_migration_bytes = 0
+        self._retired_migrated_zero_copy = 0
+        self._retired_samples_dropped = 0
 
     # -- fleet membership --------------------------------------------------
 
@@ -180,12 +212,32 @@ class FleetRouter:
     def get_replica(self, name: str) -> Optional[ReplicaHandle]:
         return self._replicas.get(name)
 
-    def add_replica(self, name: str, engine: ServingEngine) -> ReplicaHandle:
+    def add_replica(self, name: str, engine: ServingEngine,
+                    role: str = "mixed") -> ReplicaHandle:
         if name in self._replicas:
             raise ValueError(f"replica {name!r} already registered")
-        h = ReplicaHandle(name=name, engine=engine)
+        if role not in ROLES:
+            raise ValueError(f"replica {name!r}: role must be one of "
+                             f"{ROLES} (got {role!r})")
+        if role == "prefill" and engine.prefill_mode != "bucketed":
+            # prefill_only admissions require the chunked path (the
+            # engine rejects them at submit otherwise) — catch the
+            # misconfiguration at membership time, not per request.
+            raise ValueError(
+                f"replica {name!r}: prefill role requires "
+                "prefill_mode='bucketed'")
+        h = ReplicaHandle(name=name, engine=engine, role=role)
         self._replicas[name] = h
         return h
+
+    @property
+    def two_stage(self) -> bool:
+        """True while the fleet holds BOTH a prefill- and a decode-role
+        replica — the condition for disaggregated scheduling. Degenerate
+        fleets (chaos killed every decode replica) fall back to
+        single-stage dispatch: serving beats starving."""
+        roles = {h.role for h in self._replicas.values()}
+        return "prefill" in roles and "decode" in roles
 
     def kill(self, name: str) -> List[int]:
         """Chaos: the replica dies with NO drain (SIGKILL/preemption).
@@ -283,8 +335,14 @@ class FleetRouter:
 
     def _route(self, req: Request,
                excluded: FrozenSet[str]) -> Optional[ReplicaHandle]:
+        # Two-stage fleets dispatch fresh requests to prefill/mixed
+        # replicas only; decode-role replicas receive work exclusively
+        # through migration (_run_migrations), placed by slot/page
+        # headroom rather than affinity.
+        two = self.two_stage
         usable = [h for h in self._replicas.values()
-                  if h.routable and h.name not in excluded]
+                  if h.routable and h.name not in excluded
+                  and not (two and h.role == "decode")]
         if not usable:
             return None
         if not self.affinity:
@@ -322,6 +380,10 @@ class FleetRouter:
             if h is None:
                 self._park_or_shed(rid, attempt)
                 return
+            # Per-dispatch, not per-request: a re-dispatch (failover,
+            # restart shed) may land on a mixed replica, which serves
+            # it end-to-end.
+            req.prefill_only = self.two_stage and h.role == "prefill"
             try:
                 h.engine.submit(req)
             except Rejected as e:
@@ -368,6 +430,7 @@ class FleetRouter:
         self._outcomes[rid] = (kind, payload)
         self._requests.pop(rid, None)
         self._assigned.pop(rid, None)
+        self._gens_done.pop(rid, None)
         if self._tracer is not None:
             self._tracer.add_event("fleet_outcome", track="router",
                                    rid=str(rid), kind=kind)
@@ -378,6 +441,24 @@ class FleetRouter:
                 else "completed")
         if comp.rid in self._outcomes:
             self.duplicate_completions += 1
+            return
+        req = self._requests.get(comp.rid)
+        n = (req.params.n if req is not None and req.params is not None
+             else 1)
+        if n > 1 and kind == "completed":
+            # Parallel generations: each gen delivers its own
+            # Completion; the rid stays live (and re-dispatchable on a
+            # kill) until every gen has landed, and at-most-once holds
+            # per (rid, gen) instead of per rid.
+            done = self._gens_done.setdefault(comp.rid, set())
+            if comp.gen in done:
+                self.duplicate_completions += 1
+                return
+            done.add(comp.gen)
+            self.completions.append(comp)
+            if len(done) < n:
+                return
+            self._finish(comp.rid, kind, comp)
             return
         self._finish(comp.rid, kind, comp)
         self.completions.append(comp)
@@ -420,8 +501,71 @@ class FleetRouter:
             for c in h.engine.step():
                 self._complete(c)
                 out.append(c)
+        self._run_migrations()
         self._update_health()
         return out
+
+    # -- prefill -> decode migration ---------------------------------------
+
+    def _run_migrations(self) -> None:
+        """Move every export-ready prefill to a decode-capable replica.
+        A rid with no receiver this quantum stays parked on its prefill
+        replica and retries next step — its deadline (or a drain) bounds
+        the wait, so starvation is typed, never silent."""
+        for src in list(self._replicas.values()):
+            if src.role != "prefill":
+                continue
+            for rid in src.engine.export_ready_rids():
+                self._migrate_one(src, rid)
+
+    def _migrate_one(self, src: ReplicaHandle, rid: int) -> bool:
+        """One prefill->decode handoff: pick receivers by decode
+        headroom (free slots, then free pages), probe the receiver's
+        trie for the prompt's cached prefix (zero-copy rule), export
+        only the uncached suffix pages, install, and ONLY THEN release
+        the prefill replica's copy — at no point does any page of the
+        request exist zero times, so a crash on either side leaves a
+        re-runnable request, never a lost one (at-most-once on
+        COMPLETION, the same contract kill() keeps)."""
+        req = self._requests.get(rid)
+        if req is None or rid in self._outcomes:
+            return False
+        candidates = sorted(
+            (d for d in self._replicas.values()
+             if d.role != "prefill" and d.routable
+             and d.name != src.name and d.free_slots > 0),
+            key=lambda d: (-d.free_slots, -d.free_pages, d.name))
+        tr = self._tracer
+        for d in candidates:
+            path, matched = d.engine.migration_probe(req.prompt)
+            try:
+                payload = src.engine.export_request(
+                    rid, skip_tokens=matched)
+            except KeyError:
+                # Retired between listing and export (deadline/cancel
+                # raced the clock) — the probe pin must not leak.
+                d.engine.release_probe(path)
+                return False
+            try:
+                d.engine.admit_migrated(payload, path=path)
+            except Rejected as e:
+                # admit_migrated released the probe pin itself. Try the
+                # next receiver; the re-probe re-pins.
+                if tr is not None:
+                    tr.add_event("migrate_reject", track="router",
+                                 rid=str(rid), replica=d.name,
+                                 reason=e.reason)
+                continue
+            src.engine.finish_export(rid)
+            self._assigned[rid] = d.name
+            self.migrations += 1
+            if tr is not None:
+                tr.add_event(
+                    "migrate", track="router", rid=str(rid),
+                    src=src.name, dst=d.name, bytes=payload.nbytes,
+                    zero_copy_tokens=payload.skip_tokens)
+            return True
+        return False
 
     def run_until_idle(self, max_steps: int = 10000) -> None:
         for _ in range(max_steps):
@@ -474,10 +618,20 @@ class FleetRouter:
     # -- stats -------------------------------------------------------------
 
     def _fold_stats(self, engine: ServingEngine) -> None:
+        """Fold a departing engine's counters into the fleet aggregate.
+        EVERY path that discards an engine object — kill() AND
+        rolling_restart's replace — must call this first, or the fleet
+        summary silently loses that replica's history (the
+        rolling-restart fold is pinned by tests/test_fleet.py)."""
         self._retired_hit_tokens += engine.stats.prefix_hit_tokens
         self._retired_lookup_tokens += engine.stats.prefix_lookup_tokens
         self._retired_draft_proposed += engine.stats.draft_proposed
         self._retired_draft_accepted += engine.stats.draft_accepted
+        self._retired_pages_migrated += engine.stats.pages_migrated
+        self._retired_migration_bytes += engine.stats.migration_bytes
+        self._retired_migrated_zero_copy += (
+            engine.stats.migrated_zero_copy_tokens)
+        self._retired_samples_dropped += engine.stats.samples_dropped
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -524,6 +678,22 @@ class FleetRouter:
             "affinity_hits": float(self.affinity_hits),
             "prefix_hit_rate": self.prefix_hit_rate,
             "spec_acceptance_rate": self.spec_acceptance_rate,
+            # Prefill/decode disaggregation: completed handoffs plus the
+            # engine-side migration counters (live + retired engines, so
+            # chaos/restart cannot lose them).
+            "migrations": float(self.migrations),
+            "pages_migrated": float(
+                self._retired_pages_migrated + sum(
+                    h.engine.stats.pages_migrated
+                    for h in self._replicas.values())),
+            "migration_bytes": float(
+                self._retired_migration_bytes + sum(
+                    h.engine.stats.migration_bytes
+                    for h in self._replicas.values())),
+            "migrated_zero_copy_tokens": float(
+                self._retired_migrated_zero_copy + sum(
+                    h.engine.stats.migrated_zero_copy_tokens
+                    for h in self._replicas.values())),
             # Observability counters ride in the fleet JSONL so a
             # postmortem knows whether the trace it is reading is
             # complete (spans_dropped > 0 means the ring wrapped).
@@ -533,9 +703,10 @@ class FleetRouter:
             "spans_dropped": float(
                 self._tracer.spans_dropped
                 if self._tracer is not None else 0),
-            "samples_dropped": float(sum(
-                h.engine.stats.samples_dropped
-                for h in self._replicas.values())),
+            "samples_dropped": float(
+                self._retired_samples_dropped + sum(
+                    h.engine.stats.samples_dropped
+                    for h in self._replicas.values())),
         }
 
 
@@ -553,18 +724,32 @@ def sync_fleet_from_pods(
     This is the dataplane half of the LMService reconcile loop: the
     controller converges pods onto spec.replicas, and this converges
     engines onto pods — both level-triggered, so calling it repeatedly
-    is idempotent."""
+    is idempotent.
+
+    Each pod's serving role rides on its ``naming.LABEL_ROLE`` label
+    (set by the controller from ``spec.prefill_replicas`` — see
+    ``naming.lmservice_pod_role``); pods without the label join as
+    "mixed", so pre-disaggregation controllers keep byte-identical
+    router membership."""
+    # Local import: naming sits in the control-plane layer, and the
+    # dataplane must stay importable without it at module load.
+    from kubeflow_controller_tpu.tpu import naming
     running = set()
+    roles: Dict[str, str] = {}
     for pod in pods:
         phase = getattr(pod.status, "phase", None)
         if (getattr(phase, "value", phase) == "Running"
                 and pod.metadata.deletion_timestamp is None):
-            running.add(pod.metadata.name)
+            name = pod.metadata.name
+            running.add(name)
+            labels = getattr(pod.metadata, "labels", None) or {}
+            roles[name] = labels.get(naming.LABEL_ROLE, "mixed")
     added, removed = [], []
     for name in sorted(set(router._replicas) - running):
         router.kill(name)
         removed.append(name)
     for name in sorted(running - set(router._replicas)):
-        router.add_replica(name, engine_factory(name))
+        router.add_replica(name, engine_factory(name),
+                           role=roles.get(name, "mixed"))
         added.append(name)
     return added, removed

@@ -208,6 +208,13 @@ class Request:
     # the prefilled slot into n copy-on-write generations; all n
     # completions carry this rid and are distinguished by ``gen``.
     params: Optional[SamplingParams] = None
+    # Prefill/decode disaggregation (docs/serving.md): True means this
+    # engine only PREFILLS the request — the finished prefill parks as
+    # export-ready (never decodes a token here) until the router
+    # migrates its pages to a decode replica via export_request /
+    # admit_migrated. Set by the two-stage FleetRouter per dispatch
+    # target; the default keeps every direct caller end-to-end.
+    prefill_only: bool = False
 
 
 @dataclass
@@ -342,6 +349,15 @@ class _Slot:
     # synchronous chunk=1 constrained quanta and never speculate.
     mask: Optional[LogitMask] = None
     mask_state: object = None
+    # Prefill/decode disaggregation: a prefill_only request parks here
+    # once its prefill finishes — the device row stays INACTIVE (decode
+    # dispatches must keep skipping it), its pages stay pinned, and the
+    # captured logits row seeds the first decode token on the replica
+    # that receives the migration. The capture happens at final-chunk
+    # time because the next dispatch donates self.logits and would
+    # destroy the row.
+    export_ready: bool = False
+    export_logits: Optional[jax.Array] = None
 
 
 @dataclass
@@ -366,6 +382,46 @@ class _ForkSource:
     logits_row: jax.Array             # [vocab] parent logits at prefill end
     shared: List[int]                 # fully-immutable prompt page ids
     boundary_bid: Optional[int]       # partial last prompt page (COW target)
+
+
+@dataclass
+class MigrationPayload:
+    """The cross-engine wire format for one finished prefill
+    (docs/serving.md, "Prefill/decode disaggregation").
+
+    Everything the decode replica needs to resume the request exactly
+    where the prefill engine left it: the raw page payload (int8 bytes +
+    scales under kv_quant="int8" — never dequantized, so the hop is
+    bit-invisible), the prompt/length metadata, and the final-chunk
+    logits row that seeds the first decode token. ``page_starts[i]`` is
+    the absolute token offset of ``pages_*[:, i]`` within the prompt —
+    always a multiple of ``block_size`` — and ``skip_tokens`` records
+    how many leading prompt tokens the payload deliberately omits
+    because the receiver's radix trie already held them (the zero-copy
+    rule: shared prefixes travel as pointers, only the uncached suffix
+    travels as bytes). All arrays are host numpy — the export is one
+    device_get on the prefill side and one bulk install on the decode
+    side."""
+
+    rid: int
+    prompt: np.ndarray                # [prompt_len] int32
+    max_new_tokens: int
+    eos_id: Optional[int]
+    params: Optional[SamplingParams]
+    submit_t: float                   # fleet clock — TTFT spans the hop
+    admit_t: float
+    deadline_t: Optional[float]
+    logits_row: np.ndarray            # [vocab] f32, prefill-final logits
+    pages_k: np.ndarray               # [L, m, bs, KVH, D] pool dtype
+    pages_v: np.ndarray
+    scales_k: Optional[np.ndarray]    # [L, m, bs, KVH] f32 (int8 KV only)
+    scales_v: Optional[np.ndarray]
+    page_starts: List[int]            # token offset of each shipped page
+    prompt_len: int
+    skip_tokens: int                  # leading tokens omitted (zero-copy)
+    block_size: int
+    kv_quant: str
+    nbytes: int = 0                   # payload bytes (pages + scales)
 
 
 class ServingEngine:
@@ -1032,7 +1088,21 @@ class ServingEngine:
                 f"request {req.rid}: prompt {prompt.size} + "
                 f"{req.max_new_tokens} new exceeds max_seq {self.max_seq}"
             )
-        needed = self._blocks_needed(prompt.size, req.max_new_tokens)
+        if req.prefill_only and self.prefill_mode != "bucketed":
+            # Disaggregation parks the finished prefill as export-ready;
+            # only the chunked path leaves the device row inactive with
+            # the final logits in hand. One-shot prefill would need a
+            # separate capture path — refuse rather than silently decode.
+            raise ValueError(
+                f"request {req.rid}: prefill_only requires "
+                "prefill_mode='bucketed'")
+        # A prefill-only request never decodes here, so admission only
+        # reserves the PROMPT span — the decode budget is reserved on the
+        # replica that receives the migration. This is what lets a
+        # prefill-role replica run many more concurrent prefills than a
+        # colocated one.
+        needed = self._blocks_needed(
+            prompt.size, 0 if req.prefill_only else req.max_new_tokens)
         if needed > self._kv_pool_blocks:
             # Admission reserves the request's FULL page span up front;
             # a request the empty pool cannot hold would requeue forever.
@@ -1194,6 +1264,7 @@ class ServingEngine:
         — such quanta run synchronously at chunk=1 so the FSM advances
         per token (mid-prefill masked slots don't count yet)."""
         return any(s is not None and s.prefill is None
+                   and not s.export_ready
                    and s.mask is not None for s in self.slots)
 
     # -- block-table plumbing --------------------------------------------
@@ -1525,7 +1596,8 @@ class ServingEngine:
                 self.stats.prefix_hit_tokens += matched
                 self.stats.prefix_zero_copy_tokens += matched
             needed = self._blocks_needed(
-                req.prompt.size, req.max_new_tokens)
+                req.prompt.size,
+                0 if req.prefill_only else req.max_new_tokens)
             owned: List[int] = []
             while len(path) + len(owned) < needed:
                 bid = self._alloc_block()
@@ -1649,7 +1721,10 @@ class ServingEngine:
                 jnp.asarray(w_real, jnp.int32),
                 jnp.asarray(p.eos_val, jnp.int32),
                 jnp.asarray(p.budget_val, jnp.int32),
-                jnp.asarray(final),
+                # A prefill_only slot NEVER activates: its row must stay
+                # invisible to decode dispatches while it parks export-
+                # ready awaiting migration to a decode replica.
+                jnp.asarray(final and not slot.req.prefill_only),
             )
             if self._tracer is not None:
                 # Dispatch time, not device time: the chunk call is
@@ -1684,7 +1759,16 @@ class ServingEngine:
                     self._prefix_store.trie.acquire(ext)
                     slot.path = slot.path + ext
                 slot.prefill = None
-                if slot.sp.n > 1:
+                if slot.req.prefill_only:
+                    # Park export-ready. Capture the prompt-final logits
+                    # row NOW — the very next dispatch donates
+                    # self.logits and replaces every row, including this
+                    # inactive one (same hazard _capture_fork_source
+                    # documents). Forks (n > 1) happen on the decode
+                    # side after migration, never here.
+                    slot.export_logits = self.logits[i]
+                    slot.export_ready = True
+                elif slot.sp.n > 1:
                     # Chunked prefill just finished: the parent is now
                     # fork-ready (its KV covers the whole prompt and its
                     # logits row is the prompt-final distribution).
@@ -1840,6 +1924,274 @@ class ServingEngine:
             self._rid_done(src.req.rid)
         src.gens_left = []
 
+    # -- cross-engine migration (prefill/decode disaggregation) ----------
+
+    def export_ready_rids(self) -> List[int]:
+        """Rids parked export-ready (finished prefill_only requests)
+        awaiting migration. Computed fresh from the slots each call —
+        never stale. Cancelled or already-deadlined slots are excluded
+        (the next step's _retire_due surfaces their real outcome; an
+        export would waste the transfer)."""
+        now = self._clock()
+        return [s.req.rid for s in self.slots
+                if s is not None and s.export_ready and not s.cancelled
+                and (s.deadline_t is None or now < s.deadline_t)]
+
+    def _find_export(self, rid: int) -> Tuple[int, _Slot]:
+        for i, s in enumerate(self.slots):
+            if s is not None and s.req.rid == rid and s.export_ready:
+                return i, s
+        raise KeyError(f"rid {rid} is not export-ready on this engine")
+
+    def migration_probe(self, prompt) -> Tuple[List, int]:
+        """Receiver-side half of the zero-copy rule: match ``prompt``
+        against THIS engine's radix trie and pin the matched chain.
+        Returns ``(path, matched_tokens)`` — the exporter then ships
+        only pages at offsets >= matched_tokens, and the matched blocks
+        transfer as pointers (the pin taken here IS the migrated
+        request's prefix pin). Unlike admission there is no
+        one-block-short cap: the payload carries the prefill-final
+        logits row, so nothing needs re-prefilling here. The caller MUST
+        balance this pin with :meth:`admit_migrated` (which adopts it)
+        or :meth:`release_probe` (abandoned handoff)."""
+        if self._prefix_store is None:
+            return [], 0
+        path = self._prefix_store.trie.match(
+            np.asarray(prompt, np.int32))
+        self._prefix_store.trie.acquire(path)
+        return path, len(path) * self.block_size
+
+    def release_probe(self, path) -> None:
+        """Drop a :meth:`migration_probe` pin whose handoff was
+        abandoned (receiver rejected, exporter died)."""
+        if self._prefix_store is not None and path:
+            self._prefix_store.release(list(path))
+
+    def export_request(self, rid: int,
+                       skip_tokens: int = 0) -> MigrationPayload:
+        """Extract an export-ready request's state for migration: one
+        bulk device->host gather of its pool pages (minus the first
+        ``skip_tokens`` worth — blocks the receiver's trie already
+        holds, per :meth:`migration_probe`) plus the captured
+        prefill-final logits row. Does NOT free anything: the slot
+        stays parked so a failed install can re-export (possibly with a
+        different ``skip_tokens`` for a different receiver); call
+        :meth:`finish_export` once the receiver has admitted.
+        ``migration_bytes`` is counted here, on the export side, once
+        per shipped payload."""
+        i, slot = self._find_export(rid)
+        bs = self.block_size
+        L = int(slot.req.prompt.size)
+        if skip_tokens % bs or not (0 <= skip_tokens <= L):
+            raise ValueError(
+                f"rid {rid}: skip_tokens {skip_tokens} not a block "
+                f"multiple within the prompt ({L} tokens)")
+        t0 = self._clock()
+        nb = -(-L // bs)
+        ship = list(range(skip_tokens // bs, nb))
+        ids = [int(self._tables[i, b]) for b in ship]
+        pk, pv, sk, sv = gen.gather_pool_pages(self.cache, ids)
+        logits_row = np.asarray(
+            jax.device_get(slot.export_logits), np.float32)
+        nbytes = int(pk.nbytes + pv.nbytes
+                     + (0 if sk is None else sk.nbytes + sv.nbytes))
+        self.stats.migration_bytes += nbytes
+        now = self._clock()
+        if self._tracer is not None:
+            self._tracer.add_span(
+                "migrate_export", t0, now, rid=str(rid),
+                pages=len(ids), bytes=nbytes,
+                skip_tokens=int(skip_tokens))
+        return MigrationPayload(
+            rid=rid, prompt=slot.req.prompt,
+            max_new_tokens=slot.req.max_new_tokens,
+            eos_id=slot.req.eos_id,
+            # Ship the RESOLVED sampling contract (request params or
+            # this engine's defaults), so the stream the receiver
+            # decodes is the one a single-engine run would have.
+            params=slot.sp,
+            submit_t=slot.submit_t, admit_t=slot.admit_t,
+            deadline_t=slot.deadline_t, logits_row=logits_row,
+            pages_k=pk, pages_v=pv, scales_k=sk, scales_v=sv,
+            page_starts=[b * bs for b in ship], prompt_len=L,
+            skip_tokens=int(skip_tokens), block_size=bs,
+            kv_quant=self.kv_quant, nbytes=nbytes,
+        )
+
+    def finish_export(self, rid: int) -> None:
+        """Release an exported request's local tenancy after the
+        receiver admitted it: pins, owned pages, table row, slot — the
+        same funnel every retirement takes, minus the Completion (the
+        request is not DONE, it moved; its outcome is produced by the
+        receiving engine). The engine's books close with
+        ``submitted == finished + rejected + migrated_out``."""
+        i, slot = self._find_export(rid)
+        self._release_pins(slot)
+        self._free_owned(slot)
+        self._free_shared(slot)
+        self._clear_table_row(i)
+        self.slots[i] = None
+        self._rids.discard(rid)
+        self._rid_gens.pop(rid, None)
+        self.stats.migrated_out += 1
+        if self._tracer is not None:
+            self._tracer.add_event("migrate_out", self._clock(),
+                                   rid=str(rid))
+
+    def admit_migrated(self, payload: MigrationPayload,
+                       path=()) -> None:
+        """Receiver-side install of a migrated prefill: reserve the
+        request's FULL prompt+budget span (pointer assembly over
+        ``path`` — the chain :meth:`migration_probe` pinned — plus
+        fresh pages), bulk-install the shipped page bytes, and activate
+        the slot from the payload's prefill-final logits row, exactly
+        as a COW fork activates from its parent's. On ANY failure the
+        probe pin is released here — the caller never double-releases.
+        Raises :class:`Rejected` when this replica cannot take the
+        request right now (no slot / no pages / draining — the router
+        tries another receiver or retries later) and ``ValueError`` on
+        wire-format mismatches (caller bug)."""
+        try:
+            bs = self.block_size
+            if payload.block_size != bs:
+                raise ValueError(
+                    f"rid {payload.rid}: block_size "
+                    f"{payload.block_size} != engine {bs}")
+            if payload.kv_quant != self.kv_quant:
+                raise ValueError(
+                    f"rid {payload.rid}: kv_quant "
+                    f"{payload.kv_quant!r} != engine {self.kv_quant!r}")
+            if payload.logits_row.size != self.cfg.vocab_size:
+                raise ValueError(
+                    f"rid {payload.rid}: logits vocab "
+                    f"{payload.logits_row.size} != model "
+                    f"{self.cfg.vocab_size}")
+            if payload.skip_tokens != len(path) * bs:
+                raise ValueError(
+                    f"rid {payload.rid}: payload skips "
+                    f"{payload.skip_tokens} tokens but the probe path "
+                    f"covers {len(path) * bs}")
+            if (payload.prompt_len + payload.max_new_tokens
+                    > self.max_seq):
+                raise ValueError(
+                    f"rid {payload.rid}: prompt {payload.prompt_len} + "
+                    f"{payload.max_new_tokens} new exceeds max_seq "
+                    f"{self.max_seq}")
+            if payload.rid in self._rids:
+                raise ValueError(f"rid {payload.rid}: duplicate rid "
+                                 "among queued/in-flight requests")
+            if self._draining:
+                raise Rejected(payload.rid, "draining")
+            try:
+                slot_idx = self.slots.index(None)
+            except ValueError:
+                raise Rejected(payload.rid, "no_slot") from None
+            needed = self._blocks_needed(payload.prompt_len,
+                                         payload.max_new_tokens)
+            if needed > self._kv_pool_blocks:
+                raise Rejected(payload.rid, "pool_too_small")
+            owned: List[int] = []
+            while len(path) + len(owned) < needed:
+                bid = self._alloc_block()
+                if bid is None:
+                    for b in owned:
+                        self.pool.unref(b)
+                    raise Rejected(payload.rid, "no_pages")
+                owned.append(bid)
+        except BaseException:
+            self.release_probe(path)
+            raise
+        t0 = self._clock()
+        row = self._tables[slot_idx]
+        row[:] = self._kv_pool_blocks
+        row[:len(path)] = [n.block for n in path]
+        row[len(path):needed] = owned
+        self._slot_blocks[slot_idx] = needed
+        self._tables_dirty = True
+        # Install the shipped page bytes into the freshly-owned pages
+        # covering [skip_tokens, prompt_len) — raw payload, so the
+        # installed KV is bit-identical to the exporter's (int8 pools
+        # included). Pages before skip_tokens transferred as pointers.
+        dst_ids, sel = [], []
+        for j, start in enumerate(payload.page_starts):
+            if start >= payload.skip_tokens:
+                dst_ids.append(int(row[start // bs]))
+                sel.append(j)
+        if dst_ids:
+            self.cache = gen.install_pool_pages(
+                self.cache,
+                payload.pages_k[:, sel], payload.pages_v[:, sel],
+                None if payload.scales_k is None
+                else payload.scales_k[:, sel],
+                None if payload.scales_v is None
+                else payload.scales_v[:, sel],
+                dst_ids, mesh=self._mesh)
+        (self.cache, self.logits, self.eos, self.budget,
+         self.emitted) = self._fork_fn(
+            self.cache, self.logits, self.eos, self.budget,
+            self.emitted,
+            jnp.asarray(slot_idx, jnp.int32),
+            self._replicate(jnp.asarray(payload.logits_row)),
+            jnp.asarray(payload.prompt_len, jnp.int32),
+            jnp.asarray(
+                -1 if payload.eos_id is None else payload.eos_id,
+                jnp.int32),
+            jnp.asarray(payload.max_new_tokens, jnp.int32),
+        )
+        sp = (payload.params if payload.params is not None
+              else self._default_params)
+        req = Request(
+            rid=payload.rid, prompt=payload.prompt,
+            max_new_tokens=payload.max_new_tokens,
+            eos_id=payload.eos_id, params=payload.params,
+        )
+        slot = _Slot(
+            req=req, submit_t=payload.submit_t,
+            admit_t=payload.admit_t, deadline_t=payload.deadline_t,
+            path=list(path), spec_k=self.draft_k, owned=owned, sp=sp,
+            mask=sp.logit_mask,
+            mask_state=(sp.logit_mask.init_state()
+                        if sp.logit_mask is not None else None),
+        )
+        self.slots[slot_idx] = slot
+        self._set_slot_sampling(slot_idx, sp, 0)
+        self._rids.add(payload.rid)
+        if self._prefix_store is not None:
+            # Publish the migrated prompt's full blocks to THIS trie —
+            # the receiving half of the zero-copy rule: the next
+            # shared-prefix migration (or local admission) finds them
+            # here and transfers pointers instead of bytes.
+            owned_map = {
+                o: int(row[o // bs])
+                for o in range(len(path) * bs,
+                               (payload.prompt_len // bs) * bs, bs)
+            }
+            full, adopted = self._prefix_store.trie.insert_owned(
+                payload.prompt, owned_map, known_path=list(path))
+            for o in adopted:
+                slot.owned.remove(owned_map[o])
+            ext = full[len(path):]
+            self._prefix_store.trie.acquire(ext)
+            slot.path = list(path) + ext
+        if sp.n > 1:
+            # Forks materialize HERE, on the decode side — the prefill
+            # engine never captured a fork source for this request.
+            self._rid_gens[payload.rid] = sp.n
+            self._capture_fork_source(slot_idx, slot)
+        if not sp.is_greedy:
+            self.stats.sampled_requests += 1
+        self.stats.submitted += 1
+        self.stats.admitted += 1
+        self.stats.migrated_in += 1
+        self.stats.pages_migrated += len(dst_ids)
+        self.stats.migrated_zero_copy_tokens += payload.skip_tokens
+        now = self._clock()
+        if self._tracer is not None:
+            self._tracer.add_span(
+                "migrate_install", t0, now, rid=str(payload.rid),
+                slot=slot_idx, pages=len(dst_ids),
+                zero_copy_tokens=int(payload.skip_tokens))
+
     @property
     def n_active(self) -> int:
         return sum(s is not None for s in self.slots)
@@ -1894,7 +2246,8 @@ class ServingEngine:
         # row is inactive, and snapshotting it as None keeps its chunk
         # garbage out of the books.
         snapshot: List[Optional[_Slot]] = [
-            s if (s is not None and s.prefill is None) else None
+            s if (s is not None and s.prefill is None
+                  and not s.export_ready) else None
             for s in self.slots
         ]
         n_decoding = sum(s is not None for s in snapshot)
@@ -1939,7 +2292,8 @@ class ServingEngine:
         # BEFORE dispatching: booking order is the stream order.
         finished.extend(self._process_pending())
         snapshot: List[Optional[_Slot]] = [
-            s if (s is not None and s.prefill is None) else None
+            s if (s is not None and s.prefill is None
+                  and not s.export_ready) else None
             for s in self.slots
         ]
         vocab = self.cfg.vocab_size
@@ -2049,7 +2403,8 @@ class ServingEngine:
             # caps hostile-traffic TPOT at plain-decode TPOT.
             dispatched = None
             snapshot_p: List[Optional[_Slot]] = [
-                s if (s is not None and s.prefill is None) else None
+                s if (s is not None and s.prefill is None
+                  and not s.export_ready) else None
                 for s in self.slots
             ]
             if sum(s is not None for s in snapshot_p) > 0:
@@ -2078,7 +2433,8 @@ class ServingEngine:
             return finished
         finished.extend(self._process_pending())
         snapshot: List[Optional[_Slot]] = [
-            s if (s is not None and s.prefill is None) else None
+            s if (s is not None and s.prefill is None
+                  and not s.export_ready) else None
             for s in self.slots
         ]
         n_decoding = sum(s is not None for s in snapshot)
@@ -2396,6 +2752,14 @@ class ServingEngine:
             self.stats.fork_shared_tokens)
         reg.gauge("mask_tokens_filtered", "serving").set(
             self.stats.mask_tokens_filtered)
+        # Migration counters (prefill/decode disaggregation): bytes on
+        # the export side, pages/zero-copy on the install side.
+        reg.gauge("pages_migrated", "serving").set(
+            self.stats.pages_migrated)
+        reg.gauge("migration_bytes", "serving").set(
+            self.stats.migration_bytes)
+        reg.gauge("migrated_zero_copy_tokens", "serving").set(
+            self.stats.migrated_zero_copy_tokens)
         # Analytic per-step traffic (satellite of the compute-parallel
         # PR): published under dataplane.* so tp_bench and fleet
         # dashboards read measured-model traffic next to tokens/sec.
@@ -2549,15 +2913,29 @@ class ServingEngine:
             out.append(comp)
         deadline = now + grace_s
         while not self.idle and self._clock() < deadline:
+            if (not self.queue and self._pending is None
+                    and not self._fork_sources
+                    and all(s is None or s.export_ready
+                            for s in self.slots)):
+                # Only export-parked prefills remain: stepping can never
+                # finish them (their rows are inactive by construction).
+                # Skip straight to the force-retire below instead of
+                # burning the grace window.
+                break
             out.extend(self.step())
         # Grace exhausted: book the chunk still in flight (those tokens
         # were decoded — keep them), then force-retire stragglers with
-        # partial output.
+        # partial output. An export-parked prefill_only slot retires as
+        # "shed", not "deadline": no token was lost, and the router's
+        # restart path re-dispatches sheds — the prefill simply re-runs
+        # on a surviving replica.
         out.extend(self._process_pending())
         now = self._clock()
         for i, slot in enumerate(self.slots):
             if slot is not None:
-                out.append(self._retire_slot(i, slot, "deadline", now))
+                reason = ("shed" if slot.req.prefill_only
+                          else "deadline")
+                out.append(self._retire_slot(i, slot, reason, now))
         # Pending fork generations never got a slot: shed them with
         # their page holds released (leak-free under drain).
         for src in self._fork_sources:

@@ -2390,3 +2390,156 @@ def copy_pool_pages(
     k, v, ks, vs = fn(cache.k, cache.v, cache.k_scale, cache.v_scale,
                       jnp.asarray(src), jnp.asarray(dst))
     return cache._replace(k=k, v=v, k_scale=ks, v_scale=vs)
+
+
+# ---------------------------------------------------------------------------
+# Cross-engine page migration (prefill/decode disaggregation)
+# ---------------------------------------------------------------------------
+
+
+def _pad_page_ids(ids, sentinel: int) -> np.ndarray:
+    """Pad a host id list to the next power of two with ``sentinel`` so
+    the migration kernels compile O(log) variants per pool, like the
+    COW/ingest kernels."""
+    m = 1
+    while m < len(ids):
+        m *= 2
+    out = np.full((m,), sentinel, np.int32)
+    out[:len(ids)] = ids
+    return out
+
+
+def _gather_pages_impl(pool_k, pool_v, k_scale, v_scale, ids):
+    # Sentinel (pad) ids clamp into an arbitrary real page whose bytes
+    # the host slices off — nothing is written, so clamping is harmless.
+    out_k = jnp.take(pool_k, ids, axis=1, mode="clip")
+    out_v = jnp.take(pool_v, ids, axis=1, mode="clip")
+    out_ks = (None if k_scale is None
+              else jnp.take(k_scale, ids, axis=1, mode="clip"))
+    out_vs = (None if v_scale is None
+              else jnp.take(v_scale, ids, axis=1, mode="clip"))
+    return out_k, out_v, out_ks, out_vs
+
+
+_gather_pool_pages_j = jax.jit(_gather_pages_impl)
+
+
+def gather_pool_pages(
+    cache: PagedKVCache,
+    ids,                        # page ids to extract (host list)
+) -> Tuple[np.ndarray, np.ndarray,
+           Optional[np.ndarray], Optional[np.ndarray]]:
+    """Extract whole pool pages to HOST memory — the device->host half
+    of cross-engine KV migration (one transfer per exported request).
+    Quantized pools come out as raw int8 payload plus fp32 scales, never
+    dequantized: the wire format is the storage format, so an installed
+    page is bit-identical to its source (same argument as the COW copy).
+    Under tp the pool's KVH axis is sharded; ``device_get`` assembles
+    the full-head pages, which is exactly what a receiving engine of any
+    mesh width can re-shard on install. Returns ``(k, v, k_scale,
+    v_scale)`` numpy arrays of shape ``[L, n, bs, KVH(, D)]`` (scales
+    ``None`` for fp pools)."""
+    if not len(ids):
+        empty_k = np.zeros((cache.k.shape[0], 0) + cache.k.shape[2:],
+                           dtype=cache.k.dtype)
+        empty_s = (None if cache.k_scale is None else
+                   np.zeros((cache.k.shape[0], 0) + cache.k_scale.shape[2:],
+                            np.float32))
+        return empty_k, empty_k.copy(), empty_s, (
+            None if empty_s is None else empty_s.copy())
+    ids_arr = _pad_page_ids(ids, sentinel=0)
+    k, v, ks, vs = _gather_pool_pages_j(
+        cache.k, cache.v, cache.k_scale, cache.v_scale,
+        jnp.asarray(ids_arr))
+    k, v, ks, vs = jax.device_get((k, v, ks, vs))
+    n = len(ids)
+    return (np.asarray(k)[:, :n], np.asarray(v)[:, :n],
+            None if ks is None else np.asarray(ks)[:, :n],
+            None if vs is None else np.asarray(vs)[:, :n])
+
+
+def _install_pages_impl(pool_k, pool_v, k_scale, v_scale,
+                        pg_k, pg_v, pg_ks, pg_vs, dst, tp_shards=1):
+    # Raw byte install: the payload is already in the pool's storage
+    # format (int8 + scales for quantized pools), so no quantization
+    # happens here — requantizing would break the bit-exactness of
+    # greedy decode across the migration hop. Sentinel dst drops.
+    if tp_shards > 1:
+        g = pool_k.shape[-2]                 # pool shard's local KVH
+        pg_k = _tp_slice_heads(pg_k, g, axis=3)
+        pg_v = _tp_slice_heads(pg_v, g, axis=3)
+        if k_scale is not None:
+            pg_ks = _tp_slice_heads(pg_ks, g, axis=3)
+            pg_vs = _tp_slice_heads(pg_vs, g, axis=3)
+    pool_k = pool_k.at[:, dst].set(pg_k.astype(pool_k.dtype), mode="drop")
+    pool_v = pool_v.at[:, dst].set(pg_v.astype(pool_v.dtype), mode="drop")
+    if k_scale is not None:
+        k_scale = k_scale.at[:, dst].set(pg_ks, mode="drop")
+        v_scale = v_scale.at[:, dst].set(pg_vs, mode="drop")
+    return pool_k, pool_v, k_scale, v_scale
+
+
+_install_pool_pages_j = jax.jit(
+    _install_pages_impl, static_argnums=(9,), donate_argnums=(0, 1, 2, 3))
+
+
+@functools.lru_cache(maxsize=16)
+def _install_pages_tp_fn(mesh: Mesh, tp: int, has_scale: bool):
+    scale_spec = _TP_SCALE_SPEC if has_scale else None
+    inner = functools.partial(_install_pages_impl, tp_shards=tp)
+    return jax.jit(shard_map(
+        inner, mesh=mesh,
+        in_specs=(_TP_POOL_SPEC, _TP_POOL_SPEC, scale_spec, scale_spec,
+                  P(), P(), P(), P(), P()),
+        out_specs=(_TP_POOL_SPEC, _TP_POOL_SPEC, scale_spec, scale_spec),
+        check_rep=False,
+    ), donate_argnums=(0, 1, 2, 3))
+
+
+def install_pool_pages(
+    cache: PagedKVCache,
+    pages_k: np.ndarray,        # [L, n, bs, KVH, D] — gather_pool_pages
+    pages_v: np.ndarray,
+    scales_k: Optional[np.ndarray],
+    scales_v: Optional[np.ndarray],
+    dst_ids,                    # destination page ids (host list)
+    mesh: Optional[Mesh] = None,
+) -> PagedKVCache:
+    """Install migrated pages (``gather_pool_pages`` output) into this
+    pool's ``dst_ids`` — the host->device half of cross-engine KV
+    migration. Bytes move verbatim (int8 payload + scales as-is), so the
+    installed pages are bit-identical to the exporting engine's. Under
+    tp each shard keeps its KV-head slice of the replicated payload (the
+    ingest-scatter pattern). Id lists pad to a power of two with a
+    dropped sentinel — O(log) compiles per pool."""
+    if not len(dst_ids):
+        return cache
+    sentinel = cache.k.shape[1]                  # OOB -> dropped
+    dst = _pad_page_ids(dst_ids, sentinel)
+    m = dst.size
+    n = len(dst_ids)
+    if m != n:                                   # pad payload to match
+        pad = ((0, 0), (0, m - n)) + ((0, 0),) * (pages_k.ndim - 2)
+        pages_k = np.pad(pages_k, pad)
+        pages_v = np.pad(pages_v, pad)
+        if scales_k is not None:
+            spad = ((0, 0), (0, m - n)) + ((0, 0),) * (scales_k.ndim - 2)
+            scales_k = np.pad(scales_k, spad)
+            scales_v = np.pad(scales_v, spad)
+    tp = tp_size(mesh)
+    if tp <= 1:
+        k, v, ks, vs = _install_pool_pages_j(
+            cache.k, cache.v, cache.k_scale, cache.v_scale,
+            jnp.asarray(pages_k), jnp.asarray(pages_v),
+            None if scales_k is None else jnp.asarray(scales_k),
+            None if scales_v is None else jnp.asarray(scales_v),
+            jnp.asarray(dst))
+    else:
+        fn = _install_pages_tp_fn(mesh, tp, cache.k_scale is not None)
+        k, v, ks, vs = fn(
+            cache.k, cache.v, cache.k_scale, cache.v_scale,
+            jnp.asarray(pages_k), jnp.asarray(pages_v),
+            None if scales_k is None else jnp.asarray(scales_k),
+            None if scales_v is None else jnp.asarray(scales_v),
+            jnp.asarray(dst))
+    return cache._replace(k=k, v=v, k_scale=ks, v_scale=vs)

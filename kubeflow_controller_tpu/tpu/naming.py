@@ -33,6 +33,9 @@ LABEL_RUNTIME_ID = f"{PREFIX}/runtime-id"
 LABEL_REPLICA_TYPE = f"{PREFIX}/replica-type"
 LABEL_INDEX = f"{PREFIX}/index"
 LABEL_EPOCH = f"{PREFIX}/epoch"
+#: serving-replica role for prefill/decode disaggregation
+#: ("prefill" / "decode" / "mixed" — docs/lmservice.md).
+LABEL_ROLE = f"{PREFIX}/role"
 
 COORDINATOR_PORT = 8476  # jax.distributed default coordinator port
 
@@ -77,12 +80,25 @@ def lmservice_selector(svc: LMService) -> Dict[str, str]:
     }
 
 
+def lmservice_pod_role(svc: LMService, index: int) -> str:
+    """The serving role replica ``index`` plays. With
+    ``spec.prefill_replicas == 0`` (the default) every replica is
+    "mixed" — byte-identical labels to before the field existed. With
+    P > 0, the first P indices are "prefill" and the rest "decode"
+    (index-stable names make the split stable across pod churn)."""
+    p = getattr(svc.spec, "prefill_replicas", 0)
+    if not p:
+        return "mixed"
+    return "prefill" if index < p else "decode"
+
+
 def lmservice_pod_labels(svc: LMService, index: int) -> Dict[str, str]:
     return {
         LABEL_LMSERVICE: svc.metadata.name,
         LABEL_RUNTIME_ID: svc.spec.runtime_id,
         LABEL_REPLICA_TYPE: "serving",
         LABEL_INDEX: str(index),
+        LABEL_ROLE: lmservice_pod_role(svc, index),
     }
 
 

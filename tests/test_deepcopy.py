@@ -114,7 +114,7 @@ def full_lmservice() -> types.LMService:
         spec=types.LMServiceSpec(
             model="tiny", replicas=3,
             slo=types.SLOSpec(ttft_p99_ms=250.0, deadline_s=30.0),
-            max_queue=16, runtime_id="r",
+            max_queue=16, prefill_replicas=1, runtime_id="r",
         ),
         status=types.LMServiceStatus(
             phase=types.LMServicePhase.DEGRADED, reason="rr",
@@ -172,10 +172,12 @@ class TestCopies:
         assert cp == svc and cp == copy.deepcopy(svc)
         cp.spec.slo.deadline_s = 1.0
         cp.spec.replicas = 9
+        cp.spec.prefill_replicas = 2
         cp.status.conditions[0].reason = "x"
         cp.status.ready_replicas = 0
         assert svc.spec.slo.deadline_s == 30.0
         assert svc.spec.replicas == 3
+        assert svc.spec.prefill_replicas == 1
         assert svc.status.conditions[0].reason == "cr"
         assert svc.status.ready_replicas == 2
 
@@ -232,7 +234,8 @@ EXPECTED_FIELDS = {
     types.TPUJob: {"metadata", "spec", "status", "kind", "api_version"},
     types.SLOSpec: {"ttft_p99_ms", "deadline_s"},
     types.LMServiceSpec: {
-        "model", "replicas", "slo", "max_queue", "runtime_id"},
+        "model", "replicas", "slo", "max_queue", "prefill_replicas",
+        "runtime_id"},
     types.LMServiceStatus: {
         "phase", "reason", "ready_replicas", "conditions",
         "observed_generation"},

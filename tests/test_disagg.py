@@ -1,0 +1,554 @@
+"""Prefill/decode disaggregation: cross-engine KV-page migration.
+
+The contract under test (docs/serving.md "Prefill/decode
+disaggregation"):
+
+1. **Bit-exactness across the hop** — a request prefilled on one engine
+   and decoded on another must produce the BYTE-identical token stream a
+   single engine would: the payload ships raw pool pages (int8 payload +
+   scales under ``kv_quant`` — never dequantized), and the prefill-final
+   logits row seeds the first decode token on the receiver. Holds for
+   greedy, seeded sampling, and n>1 forks (which materialize on the
+   decode side).
+2. **Zero-copy rule** — pages whose block-aligned prefix the receiver's
+   radix trie already holds transfer as POINTERS (refcount++ on the
+   receiver, suffix bytes only on the wire), counted in
+   ``migrated_zero_copy_tokens``.
+3. **Leak-freedom** — pins and pool refcounts survive cancel, deadline
+   expiry, and chaos kills mid-handoff: after drain, every used block on
+   both replicas is a trie-owned cache block with zero request pins.
+4. **At-most-once** — a decode replica dying mid-install loses work,
+   never duplicates it: the router re-runs prefill and the rid still
+   reaches exactly one outcome.
+"""
+
+import copy
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_controller_tpu.api import types
+from kubeflow_controller_tpu.api.core import ObjectMeta
+from kubeflow_controller_tpu.api.validation import (
+    ValidationError, validate_lmservice,
+)
+from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
+from kubeflow_controller_tpu.dataplane.router import (
+    FleetRouter, sync_fleet_from_pods,
+)
+from kubeflow_controller_tpu.dataplane.sampling import SamplingParams
+from kubeflow_controller_tpu.dataplane.serving_engine import (
+    Rejected, Request, ServingEngine,
+)
+from kubeflow_controller_tpu.models import generate as gen
+from kubeflow_controller_tpu.models import transformer as tfm
+from kubeflow_controller_tpu.runtime import LocalRuntime
+from kubeflow_controller_tpu.tpu import naming
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tfm.tiny_config()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return gen.inference_params(cfg, tfm.init_params(cfg, jax.random.key(0)))
+
+
+def mk_engine(cfg, params, clock, kv_quant="", tracer=None, n_slots=2,
+              max_queue=None):
+    return ServingEngine(
+        cfg, params, n_slots=n_slots, max_seq=64,
+        prefill_mode="bucketed", block_size=4, prefix_cache=True,
+        max_queue=max_queue, kv_quant=kv_quant, clock=clock,
+        tracer=tracer)
+
+
+def mk_fleet(cfg, params, clock, n_decode=2, kv_quant="", tracer=None,
+             decode_slots=2):
+    router = FleetRouter(clock=clock, block_size=4, tracer=tracer)
+    router.add_replica(
+        "prefill-0", mk_engine(cfg, params, clock, kv_quant, tracer),
+        role="prefill")
+    for i in range(n_decode):
+        router.add_replica(
+            f"decode-{i}",
+            mk_engine(cfg, params, clock, kv_quant, tracer,
+                      n_slots=decode_slots),
+            role="decode")
+    return router
+
+
+def shared_prefix_requests(cfg, n=6, shared=12, seed=3, max_new=5,
+                           params_fn=None):
+    rng = np.random.default_rng(seed)
+    sysp = rng.integers(0, cfg.vocab_size, shared)
+    out = []
+    for i in range(n):
+        tail = rng.integers(0, cfg.vocab_size, 1 + i % 3)
+        out.append(Request(
+            rid=i, prompt=np.concatenate([sysp, tail]).astype(np.int32),
+            max_new_tokens=max_new,
+            params=params_fn(i) if params_fn else None))
+    return out
+
+
+def pump(router, clock, steps=600, dt=0.05):
+    for _ in range(steps):
+        if router.idle:
+            return
+        clock.t += dt
+        router.step()
+    raise AssertionError(
+        f"fleet not idle: {router.pending} pending, "
+        f"{router.outcome_counts}")
+
+
+def leak_check(eng):
+    """After drain: no occupied slots, every used pool block is a
+    trie-owned cache block, zero live request pins."""
+    assert all(s is None for s in eng.slots)
+    trie = eng._prefix_store.trie
+    assert eng.pool.used_blocks == trie.n_nodes(), (
+        f"{eng.pool.used_blocks} used blocks vs {trie.n_nodes()} trie "
+        f"nodes: pages leaked outside the cache")
+    refs, stack = 0, list(trie.root.children.values())
+    while stack:
+        nd = stack.pop()
+        refs += nd.refs
+        stack.extend(nd.children.values())
+    assert refs == 0, f"{refs} request pins leaked"
+
+
+def drain_and_leak_check(router):
+    for h in router.replicas:
+        h.engine.drain(grace_s=0.0)
+        leak_check(h.engine)
+
+
+def fleet_tokens(router):
+    return {(c.rid, c.gen): list(c.tokens) for c in router.completions
+            if c.finish_reason in ("eos", "length")}
+
+
+def single_engine_tokens(cfg, params, reqs, kv_quant=""):
+    eng = mk_engine(cfg, params, FakeClock(), kv_quant)
+    comps = eng.run([copy.deepcopy(r) for r in reqs])
+    return {(c.rid, c.gen): list(c.tokens) for c in comps
+            if c.finish_reason in ("eos", "length")}
+
+
+# -- bit-exactness across the hop ------------------------------------------
+
+
+@pytest.mark.parametrize("kv_quant", ["", "int8"])
+def test_disagg_greedy_bit_identical(cfg, params, kv_quant):
+    reqs = shared_prefix_requests(cfg, n=6)
+    want = single_engine_tokens(cfg, params, reqs, kv_quant)
+    clock = FakeClock()
+    router = mk_fleet(cfg, params, clock, kv_quant=kv_quant)
+    for r in reqs:
+        router.submit(copy.deepcopy(r))
+    pump(router, clock)
+    assert fleet_tokens(router) == want
+    fs = router.fleet_summary()
+    assert fs["migrations"] == len(reqs)
+    assert fs["pages_migrated"] > 0
+    drain_and_leak_check(router)
+
+
+def test_disagg_sampled_and_forked_identical(cfg, params):
+    """Seeded sampling and n>1 forks cross the hop unchanged: draws are
+    keyed by (seed, gen, position), the logits row ships with the
+    payload, and forks materialize on the DECODE side."""
+    def sp(i):
+        if i == 2:
+            return SamplingParams(temperature=0.7, seed=42, n=2)
+        return SamplingParams(temperature=0.8, top_k=8, seed=100 + i)
+
+    reqs = shared_prefix_requests(cfg, n=4, params_fn=sp)
+    want = single_engine_tokens(cfg, params, reqs)
+    clock = FakeClock()
+    router = mk_fleet(cfg, params, clock)
+    for r in reqs:
+        router.submit(copy.deepcopy(r))
+    pump(router, clock)
+    got = fleet_tokens(router)
+    assert (2, 0) in got and (2, 1) in got   # both fork gens surfaced
+    assert got == want
+    drain_and_leak_check(router)
+
+
+# -- zero-copy rule --------------------------------------------------------
+
+
+def test_migrated_zero_copy_tokens_positive(cfg, params):
+    """First migration of a shared prefix ships bytes AND publishes the
+    prompt's blocks to the receiver's trie; later migrations of the
+    same prefix match there and transfer those pages as pointers."""
+    reqs = shared_prefix_requests(cfg, n=6, shared=16)
+    clock = FakeClock()
+    router = mk_fleet(cfg, params, clock, n_decode=1)
+    for r in reqs:
+        router.submit(r)
+    pump(router, clock)
+    fs = router.fleet_summary()
+    assert fs["migrations"] == 6
+    assert fs["migrated_zero_copy_tokens"] > 0
+    p = router.get_replica("prefill-0").engine
+    d = router.get_replica("decode-0").engine
+    assert p.stats.migrated_out == 6 and d.stats.migrated_in == 6
+    # Source books close without Completions; receiver owns the outcome.
+    assert p.stats.submitted == p.stats.migrated_out
+    drain_and_leak_check(router)
+
+
+# -- handoff failure semantics --------------------------------------------
+
+
+def _park_one(cfg, params, busy_new_tokens=32):
+    """1 prefill + 1 single-slot decode replica: rid 0 occupies the
+    decode slot for a long budget, rids 1..2 finish prefill and PARK
+    export-ready on the prefill replica."""
+    clock = FakeClock()
+    router = mk_fleet(cfg, params, clock, n_decode=1, decode_slots=1)
+    reqs = shared_prefix_requests(cfg, n=3, max_new=5)
+    reqs[0].max_new_tokens = busy_new_tokens
+    for r in reqs:
+        router.submit(r)
+    p = router.get_replica("prefill-0").engine
+    for _ in range(200):
+        clock.t += 0.05
+        router.step()
+        if 1 in p.export_ready_rids():
+            return router, clock, p
+    raise AssertionError("rid 1 never parked export-ready")
+
+
+def test_cancel_while_parked_leak_free(cfg, params):
+    router, clock, p = _park_one(cfg, params)
+    assert router.cancel(1)
+    pump(router, clock)
+    counts = router.outcome_counts
+    assert counts["cancelled"] == 1
+    assert counts["completed"] == 2
+    drain_and_leak_check(router)
+
+
+def test_deadline_while_parked_leak_free(cfg, params):
+    clock = FakeClock()
+    router = mk_fleet(cfg, params, clock, n_decode=1, decode_slots=1)
+    reqs = shared_prefix_requests(cfg, n=3, max_new=5)
+    reqs[0].max_new_tokens = 32
+    reqs[1].deadline_s = 3.0
+    for r in reqs:
+        router.submit(r)
+    p = router.get_replica("prefill-0").engine
+    for _ in range(200):
+        clock.t += 0.05
+        router.step()
+        if 1 in p.export_ready_rids():
+            break
+    else:
+        raise AssertionError("rid 1 never parked export-ready")
+    clock.t += 10.0                      # blow rid 1's deadline parked
+    pump(router, clock)
+    comp = {c.rid: c for c in router.completions}
+    assert comp[1].finish_reason == "deadline"
+    total = sum(router.outcome_counts.values())
+    assert total == 3 and router.pending == 0
+    drain_and_leak_check(router)
+
+
+def test_kill_decode_mid_handoff_reruns_prefill(cfg, params):
+    """Decode replica SIGKILLed with migrated requests mid-decode: the
+    router re-dispatches them to the prefill replica (re-prefill — the
+    trie makes it cheap) and they migrate to the survivor. Exactly one
+    outcome per rid."""
+    clock = FakeClock()
+    router = mk_fleet(cfg, params, clock, n_decode=2)
+    reqs = shared_prefix_requests(cfg, n=6, max_new=8)
+    for r in reqs:
+        router.submit(r)
+    for _ in range(200):
+        clock.t += 0.05
+        router.step()
+        if router.migrations >= 2:
+            break
+    victim = next(n for n in ("decode-0", "decode-1")
+                  if any(d == n for d in router._assigned.values()))
+    moved = router.kill(victim)
+    assert moved, "no in-flight rids on the killed decode replica"
+    pump(router, clock)
+    counts = router.outcome_counts
+    assert counts["completed"] == 6
+    assert router.duplicate_completions == 0
+    rids = sorted(c.rid for c in router.completions)
+    assert rids == list(range(6))
+    drain_and_leak_check(router)
+
+
+def test_kill_prefill_mid_handoff_falls_back_single_stage(cfg, params):
+    """Prefill replica dies: the fleet degenerates to decode-only, the
+    two-stage policy switches off, and the re-dispatched requests are
+    served end-to-end by the (bucketed) decode replicas."""
+    clock = FakeClock()
+    router = mk_fleet(cfg, params, clock, n_decode=2)
+    reqs = shared_prefix_requests(cfg, n=6, max_new=6)
+    for r in reqs:
+        router.submit(r)
+    for _ in range(30):
+        clock.t += 0.05
+        router.step()
+    assert router.two_stage
+    router.kill("prefill-0")
+    assert not router.two_stage
+    pump(router, clock)
+    counts = router.outcome_counts
+    assert counts["completed"] == 6
+    assert router.duplicate_completions == 0
+    drain_and_leak_check(router)
+
+
+def test_admit_migrated_rejected_releases_probe_pin(cfg, params):
+    """A receiver with no free slot rejects the install and MUST release
+    the probe pin itself — the probe/export/admit triple is the only
+    migration path, so a leaked pin here would poison eviction."""
+    clock = FakeClock()
+    p = mk_engine(cfg, params, clock)
+    d = mk_engine(cfg, params, clock, n_slots=1)
+    reqs = shared_prefix_requests(cfg, n=2, max_new=4)
+    d.submit(Request(rid=99, prompt=reqs[0].prompt.copy(),
+                     max_new_tokens=24))
+    for _ in range(20):
+        d.step()
+        if d.n_active == 1 and not d.queue:
+            break
+    reqs[0].prefill_only = True
+    p.submit(reqs[0])
+    for _ in range(40):
+        p.step()
+        if 0 in p.export_ready_rids():
+            break
+    else:
+        raise AssertionError("prefill never parked")
+
+    def trie_refs(eng):
+        refs, stack = 0, list(eng._prefix_store.trie.root.children.values())
+        while stack:
+            nd = stack.pop()
+            refs += nd.refs
+            stack.extend(nd.children.values())
+        return refs
+
+    refs_before = trie_refs(d)
+    used_before = d.pool.used_blocks
+    path, matched = d.migration_probe(reqs[0].prompt)
+    payload = p.export_request(0, skip_tokens=matched)
+    with pytest.raises(Rejected):
+        d.admit_migrated(payload, path=path)
+    assert trie_refs(d) == refs_before
+    assert d.pool.used_blocks == used_before
+    # The source still holds the request — a later export succeeds.
+    assert 0 in p.export_ready_rids()
+    while d.n_active:                      # free the receiver slot
+        d.step()
+    path, matched = d.migration_probe(reqs[0].prompt)
+    d.admit_migrated(p.export_request(0, skip_tokens=matched), path=path)
+    p.finish_export(0)
+    comps = []
+    for _ in range(40):
+        comps.extend(d.step())
+        if any(c.rid == 0 for c in comps):
+            break
+    assert any(c.rid == 0 and c.finish_reason in ("eos", "length")
+               for c in comps)
+    p.drain(0.0), d.drain(0.0)
+    leak_check(p), leak_check(d)
+
+
+# -- observability ---------------------------------------------------------
+
+
+def test_migrate_spans_stitched_under_one_rid(cfg, params, tmp_path):
+    from kubeflow_controller_tpu.obs.trace import Tracer, load_chrome_trace
+
+    out = tmp_path / "disagg_trace.json"
+    tracer = Tracer(capacity=1 << 16, path=str(out))
+    clock = FakeClock()
+    router = mk_fleet(cfg, params, clock, n_decode=1, tracer=tracer)
+    for r in shared_prefix_requests(cfg, n=3):
+        router.submit(r)
+    pump(router, clock)
+    tracer.flush()
+    doc = load_chrome_trace(str(out))
+    by_rid = {}
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") == "M":
+            continue
+        rid = ev.get("args", {}).get("rid")
+        if rid is not None:
+            by_rid.setdefault(rid, set()).add((ev.get("cat"), ev["name"]))
+    stitched = [rid for rid, names in by_rid.items()
+                if ("dataplane", "migrate_export") in names
+                and ("dataplane", "migrate_install") in names]
+    assert stitched, "no rid carries both migrate spans in one trace"
+    assert any(("router", "migrate") in names for names in by_rid.values())
+
+
+def test_rolling_restart_folds_migration_counters(cfg, params):
+    """The _fold_stats pin: fleet-level migration and sampling counters
+    must survive rolling_restart's engine replacement, exactly like the
+    prefix-hit fold."""
+    clock = FakeClock()
+    router = mk_fleet(cfg, params, clock, n_decode=1)
+    for r in shared_prefix_requests(cfg, n=4):
+        router.submit(r)
+    pump(router, clock)
+    d = router.get_replica("decode-0").engine
+    # Synthetic reservoir eviction: samples_dropped derives from each
+    # reservoir's (total - retained), so age the logical counter.
+    d.stats.ttfts_s._total += 3
+    assert d.stats.samples_dropped == 3
+    before = router.fleet_summary()
+    assert before["pages_migrated"] > 0
+    router.rolling_restart(
+        lambda name: mk_engine(cfg, params, clock), grace_s=1.0)
+    after = router.fleet_summary()
+    for key in ("pages_migrated", "migration_bytes",
+                "migrated_zero_copy_tokens", "samples_dropped"):
+        assert after[key] == before[key], f"{key} lost in restart"
+    drain_and_leak_check(router)
+
+
+# -- role plumbing: spec -> pod labels -> router membership ----------------
+
+
+class _StubEngine:
+    """Just enough surface for add_replica's role validation."""
+
+    prefill_mode = "bucketed"
+    n_slots = 2
+    max_queue = None
+    queue = ()
+    n_active = 0
+
+
+def test_role_label_flows_spec_to_router():
+    rt = LocalRuntime(default_policy=PodRunPolicy(
+        start_delay=0.1, run_duration=1e9))
+    try:
+        svc = types.LMService(
+            metadata=ObjectMeta(name="chat", namespace="default"),
+            spec=types.LMServiceSpec(model="tiny", replicas=3,
+                                     prefill_replicas=1))
+        rt.submit_lmservice(svc)
+        assert rt.run_until(lambda: (
+            (s := rt.get_lmservice("default", "chat")) is not None
+            and s.status.ready_replicas == 3))
+        pods = rt.client.list_pods(
+            "default", {naming.LABEL_LMSERVICE: "chat"})
+        roles = {p.metadata.labels[naming.LABEL_INDEX]:
+                 p.metadata.labels[naming.LABEL_ROLE] for p in pods}
+        assert roles == {"0": "prefill", "1": "decode", "2": "decode"}
+        router = FleetRouter(clock=FakeClock(), block_size=4)
+        sync_fleet_from_pods(router, pods, lambda n: _StubEngine())
+        by_role = {h.name: h.role for h in router.replicas}
+        assert sorted(by_role.values()) == ["decode", "decode", "prefill"]
+        assert router.two_stage
+    finally:
+        rt.stop()
+
+
+def test_role_defaults_and_validation():
+    svc = types.LMService(
+        metadata=ObjectMeta(name="chat", namespace="default"),
+        spec=types.LMServiceSpec(model="tiny", replicas=2))
+    assert all(
+        naming.lmservice_pod_labels(svc, i)[naming.LABEL_ROLE] == "mixed"
+        for i in range(2))
+    validate_lmservice(svc)
+    svc.spec.prefill_replicas = 2          # nobody left to decode
+    with pytest.raises(ValidationError):
+        validate_lmservice(svc)
+    svc.spec.prefill_replicas = -1
+    with pytest.raises(ValidationError):
+        validate_lmservice(svc)
+    svc.spec.prefill_replicas = 1
+    validate_lmservice(svc)
+
+    router = FleetRouter(clock=FakeClock(), block_size=4)
+    with pytest.raises(ValueError):
+        router.add_replica("r0", _StubEngine(), role="turbo")
+
+    class _ExactEngine(_StubEngine):
+        prefill_mode = "exact"
+
+    with pytest.raises(ValueError):
+        router.add_replica("r1", _ExactEngine(), role="prefill")
+
+
+# -- bench contract --------------------------------------------------------
+
+
+def test_disagg_bench_contract(cfg, params, tmp_path):
+    """The open-loop harness contract the disagg benchmark gates on:
+    arrivals == completions + rejections (+ cancellations) with zero
+    pending, and the shared tracer stitches the handoff spans. Runs the
+    bench's own driver over a small 1P+1D fleet so the contract is
+    pinned tier-1 while the full sweep stays slow-marked."""
+    from kubeflow_controller_tpu.obs.trace import Tracer, load_chrome_trace
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks"))
+    import fleet_bench
+
+    import time as time_mod
+
+    out = tmp_path / "contract_trace.json"
+    tracer = Tracer(capacity=1 << 16, path=str(out))
+    router = FleetRouter(clock=time_mod.perf_counter, block_size=4,
+                         tracer=tracer)
+    router.add_replica(
+        "prefill-0",
+        mk_engine(cfg, params, time_mod.perf_counter, tracer=tracer),
+        role="prefill")
+    router.add_replica(
+        "decode-0",
+        mk_engine(cfg, params, time_mod.perf_counter, tracer=tracer),
+        role="decode")
+    reqs = fleet_bench.make_fleet_requests(
+        cfg, 8, 2, 12, 3, [4, 6], seed=5, deadline_s=None, hot=0.5)
+    arrivals = [0.02 * i for i in range(8)]
+    fleet_bench.drive_open_loop(router, reqs, arrivals, max_wall_s=60.0)
+    fleet_bench.assert_conserved(router, 8, "contract")
+    fs = router.fleet_summary()
+    assert fs["migrations"] > 0
+    tracer.flush()
+    doc = load_chrome_trace(str(out))
+    by_rid = {}
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") == "M":
+            continue
+        rid = ev.get("args", {}).get("rid")
+        if rid is not None:
+            by_rid.setdefault(rid, set()).add((ev.get("cat"), ev["name"]))
+    stitched = sum(
+        1 for names in by_rid.values()
+        if ("dataplane", "migrate_export") in names
+        and ("dataplane", "migrate_install") in names)
+    assert stitched > 0
+    drain_and_leak_check(router)
