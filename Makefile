@@ -7,7 +7,8 @@ PY ?= python
 	test-pallas bench \
 	bench-cp bench-serve bench-overload bench-prefix bench-fleet \
 	bench-disagg \
-	bench-spec bench-paged bench-tp bench-obs bench-sampling clean stamp
+	bench-spec bench-paged bench-tp bench-prefill bench-obs bench-sampling \
+	clean stamp
 
 # Build-stamp analog of the reference's ldflags version injection
 # (/root/reference/Makefile:23-26): export the sha for build_version().
@@ -39,12 +40,15 @@ test-tp:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tp_serving.py -q
 
-# Pallas kernel guard: the fused paged-attention decode kernel in
+# Pallas kernel guard: the fused paged-attention kernels (single-row
+# decode, width-W flash prefill, K+1-wide speculative verify) in
 # INTERPRET mode on CPU against the XLA gather oracle — the declared
-# kernel tolerance contract, int8 fused dequant, width caps, sentinel
-# clamping, and the engine-level stream equality + traffic gauges.
-# Tier-1 (tests/conftest.py runs it under plain `make test` too); this
-# target is the cheap CI gate for kernel-touching changes.
+# kernel tolerance contracts, int8 fused dequant, width caps, sentinel
+# clamping, verify accept/reject decision equality, the pltpu-absent
+# refusal on every entry point, and the engine-level stream equality +
+# per-phase traffic gauges (spec decode, tp in {1,2}). Tier-1
+# (tests/conftest.py runs it under plain `make test` too); this target
+# is the cheap CI gate for kernel-touching changes.
 test-pallas:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_paged_attention_pallas.py -q
@@ -151,6 +155,17 @@ bench-paged:
 bench-tp:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/tp_bench.py \
 		--json benchmarks/tp_bench_summary.json
+
+# Long-prompt prefill benchmark: pallas flash-prefill leg vs the XLA
+# gather, greedy streams asserted equal BEFORE timing; gates on the
+# phase-aware modeled traffic (hbm_bytes_per_step.prefill pallas
+# strictly below xla — deterministic) and, on TPU only, long-prompt
+# TTFT p50 pallas <= xla within the noise band (CPU runs the kernel in
+# interpret mode, so the measured leg is reported honestly with a note
+# instead of gated) — see benchmarks/RESULTS.md and docs/serving.md.
+bench-prefill:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/prefill_bench.py \
+		--json benchmarks/prefill_bench_summary.json
 
 # Observability overhead benchmark: greedy outputs asserted
 # bit-identical across tracer-off/tracer-on engines before timing;

@@ -665,11 +665,15 @@ def main(argv=None) -> int:
                         "(docs/serving.md; requires d_ff %% tp == 0)")
     p.add_argument("--attn-impl", default="xla",
                    choices=["xla", "pallas"],
-                   help="paged decode attention: xla = dense KV view "
-                        "gather (the bit-exactness oracle); pallas = "
-                        "fused flash-style kernel streaming pool pages "
-                        "through VMEM once, int8 dequant fused into the "
-                        "page load (output within a few ulps of xla)")
+                   help="paged attention for ALL three phases — chunked "
+                        "prefill, decode, and K+1 speculative verify: "
+                        "xla = dense KV view gather (the bit-exactness "
+                        "oracle, 3x HBM per KV byte); pallas = fused "
+                        "flash-style kernels streaming pool pages "
+                        "through VMEM once (factor-1), int8 dequant "
+                        "fused into the page load, greedy streams and "
+                        "accept/reject decisions identical to xla with "
+                        "logits within a few ulps")
     p.add_argument("--mesh", default="",
                    help="comma-separated device indices to build the "
                         "serving mesh from (e.g. '0,1,2,3'; default: "

@@ -126,11 +126,18 @@ class ServingStats:
     # width (tp_compute="parallel" divides the col/row-parallel weight
     # bytes by tp; attn_impl="pallas" drops the 3x gather round trip to
     # 1x), and ``flops_per_token_per_shard`` the matmul + attention
-    # FLOPs a shard spends per decoded token. Gauges, refreshed by the
-    # engine every quantum and mirrored to the obs registry under
-    # ``dataplane.*`` — the numbers tp_bench's Pareto sweep reports
-    # next to tokens/sec.
+    # FLOPs a shard spends per decoded token. The ``_prefill`` /
+    # ``_decode`` / ``_verify`` variants split the gauge per attention
+    # phase, each keyed on the kernel that phase's most recent quantum
+    # actually dispatched — a pallas engine only claims factor-1 for
+    # phases genuinely running the Pallas kernel. Gauges, refreshed by
+    # the engine every quantum and mirrored to the obs registry under
+    # ``dataplane.*`` (per-phase as ``hbm_bytes_per_step.<phase>``) —
+    # the numbers tp_bench's Pareto sweep reports next to tokens/sec.
     hbm_bytes_per_step: float = 0.0
+    hbm_bytes_per_step_prefill: float = 0.0
+    hbm_bytes_per_step_decode: float = 0.0
+    hbm_bytes_per_step_verify: float = 0.0
     flops_per_token_per_shard: float = 0.0
     # Speculative decoding (docs/serving.md "Speculative decoding"):
     # ``draft_proposed`` counts draft tokens sent to the verifier,
@@ -269,6 +276,12 @@ class ServingStats:
             "pool_blocks_per_shard": float(self.pool_blocks_per_shard),
             "kv_hbm_per_device_mb": float(self.kv_hbm_per_device_mb),
             "hbm_bytes_per_step": float(self.hbm_bytes_per_step),
+            "hbm_bytes_per_step_prefill": float(
+                self.hbm_bytes_per_step_prefill),
+            "hbm_bytes_per_step_decode": float(
+                self.hbm_bytes_per_step_decode),
+            "hbm_bytes_per_step_verify": float(
+                self.hbm_bytes_per_step_verify),
             "flops_per_token_per_shard": float(
                 self.flops_per_token_per_shard),
             "draft_proposed": float(self.draft_proposed),

@@ -561,6 +561,15 @@ class ServingEngine:
         # View width of the most recent dispatch (refreshed by
         # _view_width); feeds the analytic per-step traffic model.
         self._last_vw = 0
+        # Which attention impl each phase's most recent dispatch ran —
+        # the phase-aware half of the traffic model. A phase that has
+        # never dispatched models at the configured impl (every phase
+        # honors ``attn_impl`` since the prefill/verify kernels landed,
+        # but the gauge reports what the engine DID, not what it was
+        # asked for — the misreport this replaces keyed the KV factor
+        # on ``attn_impl`` alone, claiming factor-1 prefill while the
+        # chunk path still ran the factor-3 gather).
+        self._phase_impl: Dict[str, str] = {}
         if mesh is not None:
             self._mesh = mesh
             self.tp = gen.tp_size(mesh)
@@ -913,7 +922,7 @@ class ServingEngine:
                     window, n, new_logits, cache = gen.verify_step_paged(
                         cfg, params, draft, dlen, logits, cache, eos,
                         max_commit, mesh=mesh_, view_width=vw,
-                        tp_compute=tp_compute_)
+                        tp_compute=tp_compute_, attn_impl=attn_impl_)
                     emitted = emitted + n      # n = 0 on inactive rows
                     in_commit = (jnp.arange(k_draft + 1, dtype=jnp.int32)
                                  [None, :] < n[:, None])
@@ -947,7 +956,7 @@ class ServingEngine:
                         cfg, params, draft, dlen, logits, cache, eos,
                         max_commit, temp, tk, tp_p, seed_v, gen_v,
                         emitted, mesh=mesh_, view_width=vw,
-                        tp_compute=tp_compute_)
+                        tp_compute=tp_compute_, attn_impl=attn_impl_)
                     emitted = emitted + n
                     in_commit = (jnp.arange(k_draft + 1, dtype=jnp.int32)
                                  [None, :] < n[:, None])
@@ -1315,6 +1324,7 @@ class ServingEngine:
         """Dispatch the fused decode chunk compiled for the current
         view width (compile-on-first-use per width)."""
         vw = self._view_width()
+        self._phase_impl["decode"] = self.attn_impl
         fn = self._step_fns.get(vw)
         if fn is None:
             fn = self._step_fns[vw] = self._make_step(vw)
@@ -1328,6 +1338,7 @@ class ServingEngine:
         if self._sampled_in(snapshot):
             self._push_sampling()
             vw = self._view_width()
+            self._phase_impl["decode"] = self.attn_impl
             fn = self._step_fns_sampled.get(vw)
             if fn is None:
                 fn = self._step_fns_sampled[vw] = \
@@ -1344,6 +1355,7 @@ class ServingEngine:
         [n_slots, vocab] admissibility mask."""
         self._push_sampling()
         vw = self._view_width()
+        self._phase_impl["decode"] = self.attn_impl
         fn = self._step_fns_masked.get(vw)
         if fn is None:
             fn = self._step_fns_masked[vw] = self._make_step_masked(vw)
@@ -1358,6 +1370,7 @@ class ServingEngine:
         decode; the retiling drift this admits is a declared tolerance
         contract — see _make_spec)."""
         vw = self._view_width()
+        self._phase_impl["verify"] = self.attn_impl
         fn = self._spec_steps.get(vw)
         if fn is None:
             fn = self._spec_steps[vw] = self._make_spec(vw)
@@ -1367,6 +1380,7 @@ class ServingEngine:
     def _spec_fn_sampled(self, *args):
         """Sampled twin of :meth:`_spec_fn` (same per-width memo)."""
         vw = self._view_width()
+        self._phase_impl["verify"] = self.attn_impl
         fn = self._spec_steps_sampled.get(vw)
         if fn is None:
             fn = self._spec_steps_sampled[vw] = self._make_spec_sampled(vw)
@@ -1504,12 +1518,14 @@ class ServingEngine:
         cfg = self.cfg
         mesh_ = self._mesh
         tp_compute_ = self.tp_compute
+        attn_impl_ = self.attn_impl
 
         def chunk(params, toks, cache, logits_buf, eos, budget, emitted,
                   slot, offset, n_real, eos_val, budget_val, activate):
             row_logits, cache = gen.prefill_chunk_paged(
                 cfg, params, toks, cache, slot, offset, n_real,
-                mesh=mesh_, view_width=vw, tp_compute=tp_compute_)
+                mesh=mesh_, view_width=vw, tp_compute=tp_compute_,
+                attn_impl=attn_impl_)
             logits_buf = jax.lax.dynamic_update_slice(
                 logits_buf, row_logits.astype(logits_buf.dtype),
                 (slot, 0))
@@ -1710,6 +1726,7 @@ class ServingEngine:
             buf = np.zeros((1, w), np.int32)
             buf[0, :w_real] = tokens[off:off + w_real]
             fn = self._chunk_fn(w)
+            self._phase_impl["prefill"] = self.attn_impl
             self._push_tables()
             t0 = self._clock() if self._tracer is not None else 0.0
             (self.cache, self.logits, self.eos, self.budget,
@@ -2658,12 +2675,13 @@ class ServingEngine:
             self._record_completion(c)
         return finished
 
-    def _traffic_model(self) -> Tuple[float, float]:
+    def _traffic_model(self, phase: str = "decode") -> Tuple[float, float]:
         """Analytic per-step traffic this engine's configuration moves,
-        per shard: ``(hbm_bytes_per_step, flops_per_token_per_shard)``.
+        per shard, for one attention ``phase``:
+        ``(hbm_bytes_per_step, flops_per_token_per_shard)``.
 
-        Decode is bandwidth-bound, so the model counts the two streams
-        that dominate a step's HBM reads and lets tp_bench report
+        Serving is bandwidth-bound, so the model counts the two streams
+        that dominate a step's HBM reads and lets the benches report
         *traffic*, not just tokens/sec:
 
         * **weights** — every projection is read once per step. Under
@@ -2673,10 +2691,20 @@ class ServingEngine:
           dispatch (the all-gather moves the missing (tp-1)/tp from
           peers, but the shard still reads/writes full-size operands).
           int8 weight-only cuts the per-element cost to one byte.
-        * **KV** — each live slot's view-width span of pool pages. The
-          XLA gather path pays 3x per byte (pool read, dense-view
-          write, view read back into attention); the Pallas kernel
-          streams pages through VMEM once.
+        * **KV** — the view-width span of pool pages the phase's query
+          rows attend: every live slot for decode and verify, ONE slot
+          row for chunk prefill (``_advance_prefills`` dispatches one
+          slot per chunk). The XLA gather path pays 3x per byte (pool
+          read, dense-view write, view read back into attention); the
+          Pallas kernels stream pages through VMEM once.
+
+        The KV factor is *phase-aware*: it keys on what the phase's
+        most recent quantum actually dispatched (``_phase_impl``,
+        recorded at every dispatch site), falling back to the
+        configured ``attn_impl`` for a phase that has not run yet. The
+        pre-kernel model keyed on ``attn_impl`` alone — a Pallas engine
+        claimed factor-1 even while its prefill/verify steps still ran
+        the factor-3 gather.
 
         FLOPs per token per shard: 2 flops per weight param touched
         (matmul), plus the two attention einsums over the view width on
@@ -2701,8 +2729,10 @@ class ServingEngine:
                     else jnp.dtype(cfg.dtype).itemsize)
         weight_bytes = local_params * per_elem
         vw = self._last_vw or self._view_width()
-        kv_factor = 1 if self.attn_impl == "pallas" else 3
-        kv_bytes = (kv_factor * self.n_slots * vw
+        impl = self._phase_impl.get(phase, self.attn_impl)
+        kv_factor = 1 if impl == "pallas" else 3
+        kv_rows = 1 if phase == "prefill" else self.n_slots
+        kv_bytes = (kv_factor * kv_rows * vw
                     * kv_blocks.kv_bytes_per_token(cfg, self.kv_quant, tp))
         # Attention runs on the shard's head slice in BOTH tp modes
         # (gathered slices heads, parallel projects them locally).
@@ -2763,10 +2793,22 @@ class ServingEngine:
         # Analytic per-step traffic (satellite of the compute-parallel
         # PR): published under dataplane.* so tp_bench and fleet
         # dashboards read measured-model traffic next to tokens/sec.
-        hbm_bytes, flops = self._traffic_model()
+        # The gauge is split per attention phase — each keyed on the
+        # kernel that phase actually dispatched, so a pallas engine
+        # stops claiming factor-1 for phases still running the gather.
+        # The legacy aggregate gauge keeps its decode meaning.
+        phase_bytes = {}
+        for phase in ("prefill", "decode", "verify"):
+            phase_bytes[phase], flops = self._traffic_model(phase)
+        hbm_bytes = phase_bytes["decode"]
         self.stats.hbm_bytes_per_step = hbm_bytes
+        self.stats.hbm_bytes_per_step_prefill = phase_bytes["prefill"]
+        self.stats.hbm_bytes_per_step_decode = phase_bytes["decode"]
+        self.stats.hbm_bytes_per_step_verify = phase_bytes["verify"]
         self.stats.flops_per_token_per_shard = flops
         reg.gauge("hbm_bytes_per_step", "dataplane").set(hbm_bytes)
+        for phase, val in phase_bytes.items():
+            reg.gauge(f"hbm_bytes_per_step.{phase}", "dataplane").set(val)
         reg.gauge("flops_per_token_per_shard", "dataplane").set(flops)
 
     def _book_token(self, i: int, slot: _Slot, tok: int,

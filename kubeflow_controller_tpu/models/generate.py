@@ -799,6 +799,41 @@ def _tp_param_specs(params: Params, parallel: bool) -> object:
     return tp_compute_param_specs(params)
 
 
+def _occupancy_cap(width: int, view_width: Optional[int]) -> int:
+    """The engine's occupancy cap on a slot-page span: the caller's
+    live view width, never past the table's full span. ONE definition,
+    shared by every attention phase (decode / chunk prefill / verify)
+    and both impls (the XLA gather's column count and the Pallas
+    kernels' page-walk cap), so the phases can never disagree on which
+    columns exist."""
+    return width if view_width is None else min(view_width, width)
+
+
+def _capped_kv_views(
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    tables: jax.Array,
+    width: int,
+    view_width: Optional[int],
+    k_scale: Optional[jax.Array],
+    v_scale: Optional[jax.Array],
+    out_dtype,
+) -> Tuple[jax.Array, jax.Array]:
+    """The table-resolved dense K/V view pair the XLA attention path
+    reads (``attn_impl="xla"`` — the bit-exactness oracle), gathered at
+    the occupancy-capped width with int8 scales applied at gather time.
+    Shared by the decode, chunk-prefill, and verify impls — one call
+    site shape for the factor-3 round trip the Pallas kernels remove."""
+    from kubeflow_controller_tpu.ops.attention import paged_kv_view
+
+    vw = _occupancy_cap(width, view_width)
+    k = paged_kv_view(k_pool, tables, vw, scale=k_scale,
+                      out_dtype=out_dtype)
+    v = paged_kv_view(v_pool, tables, vw, scale=v_scale,
+                      out_dtype=out_dtype)
+    return k, v
+
+
 def _decode_layer_paged(
     cfg: TransformerConfig,
     lp: Params,
@@ -824,8 +859,6 @@ def _decode_layer_paged(
     ``tp_parallel``: consume column/row-sharded weights — local
     projections, one psum after wo and one after w_down (see the
     placement comment above :func:`check_tp_heads`)."""
-    from kubeflow_controller_tpu.ops.attention import paged_kv_view
-
     b = x.shape[0]
     hd = cfg.head_dim
     dt = cfg.dtype
@@ -834,7 +867,7 @@ def _decode_layer_paged(
     width = mb * bs
     # The gathered view (and its masks) may be capped to the engine's
     # live occupancy; pool WRITES always guard against the full span.
-    vw = width if view_width is None else min(view_width, width)
+    vw = _occupancy_cap(width, view_width)
     par = tp_shards > 1 and tp_parallel
     rep = cfg.n_heads // cfg.n_kv_heads
     # Column-parallel projections produce this shard's contiguous
@@ -880,14 +913,12 @@ def _decode_layer_paged(
             width=vw, sm_scale=hd ** -0.5, out_dtype=dt,
         )[:, None]                               # [B, 1, G, rep, D]
     else:
-        k_cache = paged_kv_view(
-            k_pool[layer], cache.tables, vw,
-            scale=None if k_scale is None else k_scale[layer],
-            out_dtype=dt)                        # [B, vw, KVH, D]
-        v_cache = paged_kv_view(
-            v_pool[layer], cache.tables, vw,
-            scale=None if v_scale is None else v_scale[layer],
-            out_dtype=dt)
+        k_cache, v_cache = _capped_kv_views(
+            k_pool[layer], v_pool[layer], cache.tables, width,
+            view_width,
+            None if k_scale is None else k_scale[layer],
+            None if v_scale is None else v_scale[layer],
+            dt)                                  # [B, vw, KVH, D]
 
         s = jnp.einsum(
             "bqgrd,bkgd->bgrqk", qg, k_cache,
@@ -1349,9 +1380,8 @@ def _prefill_chunk_paged_impl(
     tp_shards: int = 1,
     view_width: Optional[int] = None,
     tp_parallel: bool = False,
+    attn_impl: str = "xla",
 ) -> Tuple[jax.Array, PagedKVCache]:
-    from kubeflow_controller_tpu.ops.attention import paged_kv_view
-
     b, w = toks.shape
     dt = cfg.dtype
     hd = cfg.head_dim
@@ -1363,19 +1393,22 @@ def _prefill_chunk_paged_impl(
     # width always covers the slot's reserved span >= offset + n_real,
     # so capping the gather loses nothing. Writes still span the full
     # table via the sentinel guard below.
-    vw = width if view_width is None else min(view_width, width)
+    vw = _occupancy_cap(width, view_width)
     rep = cfg.n_heads // cfg.n_kv_heads
     par = tp_shards > 1 and tp_parallel
     g_local = (cfg.n_kv_heads // tp_shards if tp_shards > 1
                else cfg.n_kv_heads)
     gp = g_local if par else cfg.n_kv_heads      # projection head groups
     trow = cache.tables[slot]                    # [mb]
-    kc_row = paged_kv_view(
-        cache.k, trow, vw, scale=cache.k_scale, out_dtype=dt,
-    )                                            # [L, vw, KVH, D]
-    vc_row = paged_kv_view(
-        cache.v, trow, vw, scale=cache.v_scale, out_dtype=dt,
-    )
+    if attn_impl == "pallas":
+        # The kernel streams pool pages in place through the table row;
+        # the dense per-layer view never exists. The scan walks a layer
+        # INDEX instead of gathered views.
+        kc_row = vc_row = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    else:
+        kc_row, vc_row = _capped_kv_views(
+            cache.k, cache.v, trow, width, view_width,
+            cache.k_scale, cache.v_scale, dt)    # [L, vw, KVH, D]
 
     x = params["embed"].astype(dt)[toks]         # [1, W, D]
     positions = offset + jnp.broadcast_to(
@@ -1404,26 +1437,45 @@ def _prefill_chunk_paged_impl(
             k = _tp_slice_heads(k, g_local, axis=2)
             v = _tp_slice_heads(v, g_local, axis=2)
         scale = hd ** -0.5
-        s_cache = jnp.einsum(
-            "bqgrd,kgd->bgrqk", qg, kc,
-            preferred_element_type=jnp.float32,
-        ) * scale                                # [1,G,rep,W,vw]
-        s_cache = jnp.where(
-            (cache_cols < offset)[None, None, None, None, :],
-            s_cache, -1e30,
-        )
-        s_new = jnp.einsum(
-            "bqgrd,bkgd->bgrqk", qg, k,
-            preferred_element_type=jnp.float32,
-        ) * scale                                # [1,G,rep,W,W]
-        s_new = jnp.where(causal[None, None, None], s_new, -1e30)
-        p = jax.nn.softmax(
-            jnp.concatenate([s_cache, s_new], axis=-1), axis=-1
-        ).astype(dt)
-        attn = (
-            jnp.einsum("bgrqk,kgd->bqgrd", p[..., :vw], vc)
-            + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., vw:], v)
-        )
+        if attn_impl == "pallas":
+            from kubeflow_controller_tpu.ops.paged_attention_pallas import (
+                paged_attention_prefill,
+            )
+            layer = kc                           # [] int32 pool index
+            attn = paged_attention_prefill(
+                qg[0], k[0], v[0],
+                lax.dynamic_index_in_dim(cache.k, layer, keepdims=False),
+                lax.dynamic_index_in_dim(cache.v, layer, keepdims=False),
+                trow, offset,
+                k_scale=None if cache.k_scale is None else
+                lax.dynamic_index_in_dim(
+                    cache.k_scale, layer, keepdims=False),
+                v_scale=None if cache.v_scale is None else
+                lax.dynamic_index_in_dim(
+                    cache.v_scale, layer, keepdims=False),
+                width=vw, sm_scale=scale, out_dtype=dt,
+            )[None]                              # [1, W, G, rep, D]
+        else:
+            s_cache = jnp.einsum(
+                "bqgrd,kgd->bgrqk", qg, kc,
+                preferred_element_type=jnp.float32,
+            ) * scale                            # [1,G,rep,W,vw]
+            s_cache = jnp.where(
+                (cache_cols < offset)[None, None, None, None, :],
+                s_cache, -1e30,
+            )
+            s_new = jnp.einsum(
+                "bqgrd,bkgd->bgrqk", qg, k,
+                preferred_element_type=jnp.float32,
+            ) * scale                            # [1,G,rep,W,W]
+            s_new = jnp.where(causal[None, None, None], s_new, -1e30)
+            p = jax.nn.softmax(
+                jnp.concatenate([s_cache, s_new], axis=-1), axis=-1
+            ).astype(dt)
+            attn = (
+                jnp.einsum("bgrqk,kgd->bqgrd", p[..., :vw], vc)
+                + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., vw:], v)
+            )
         if par:
             attn = attn.reshape(b, w, -1)
             x = x + lax.psum(attn @ _w(lp, "wo", dt), "tp")
@@ -1477,34 +1529,50 @@ def prefill_chunk_paged(
     mesh: Optional[Mesh] = None,
     view_width: Optional[int] = None,
     tp_compute: str = "gathered",
+    attn_impl: str = "xla",
 ) -> Tuple[jax.Array, PagedKVCache]:
     """``prefill_chunk_into_slot`` over the paged pool: the chunk
-    attends to the table-gathered view of the slot's prior pages (a
-    shared radix prefix reads IN PLACE — no copy ever ran) plus
-    intra-chunk causal, and its k/v scatter straight into the slot's
-    own pages at absolute columns ``offset + [0, W)``. Same bucketing
-    and padding discipline, same math at the same width — the fp path
-    is bitwise the contiguous kernel. ``view_width``: cap the slot's
-    page view to the engine's live occupancy (must cover the slot's
-    reserved span; the engine's pow2-rounded width does by
-    construction). ``mesh`` / ``tp_compute``: see
-    :func:`decode_step_paged` (the slot's page view and k/v scatter are
-    per-shard; the chunk's logits come out replicated)."""
+    attends to the slot's prior pages (a shared radix prefix reads IN
+    PLACE — no copy ever ran) plus intra-chunk causal, and its k/v
+    scatter straight into the slot's own pages at absolute columns
+    ``offset + [0, W)``. Same bucketing and padding discipline, same
+    math at the same width — the fp path is bitwise the contiguous
+    kernel under the default ``attn_impl="xla"`` (the table-gathered
+    dense view, the repo's oracle). ``attn_impl="pallas"`` swaps the
+    gather + concat-softmax for the fused flash-prefill kernel
+    (``ops.paged_attention_pallas.paged_attention_prefill``): pool
+    pages stream through VMEM once, factor-3 -> factor-1 HBM traffic,
+    logits within the declared tolerance contract and greedy streams
+    equal. ``view_width``: cap the slot's page view to the engine's
+    live occupancy (must cover the slot's reserved span; the engine's
+    pow2-rounded width does by construction). ``mesh`` /
+    ``tp_compute``: see :func:`decode_step_paged` (the slot's page view
+    and k/v scatter are per-shard; the chunk's logits come out
+    replicated)."""
+    problems = []
     if toks.shape[0] != 1:
+        problems.append(
+            f"toks must carry exactly ONE request row — chunked prefill "
+            f"advances a single slot per dispatch (got batch "
+            f"{toks.shape[0]}); loop slots on the host the way "
+            f"ServingEngine._advance_prefills does"
+        )
+    if problems:
         raise ValueError(
-            f"prefill_chunk_paged admits one request (got batch "
-            f"{toks.shape[0]})"
+            "prefill_chunk_paged refused this call:\n  - "
+            + "\n  - ".join(problems)
         )
     tp = tp_size(mesh)
     if tp <= 1:
         return _prefill_chunk_paged_impl(
             cfg, params, toks, cache, slot, offset, n_real,
-            1, view_width)
+            1, view_width, False, attn_impl)
     check_tp_heads(cfg, tp, tp_compute)
     parallel = tp_compute == "parallel"
     fn = shard_map(
         functools.partial(_prefill_chunk_paged_impl, cfg, tp_shards=tp,
-                          view_width=view_width, tp_parallel=parallel),
+                          view_width=view_width, tp_parallel=parallel,
+                          attn_impl=attn_impl),
         mesh=mesh,
         in_specs=(_tp_param_specs(params, parallel), P(),
                   paged_cache_specs(cache), P(), P(), P()),
@@ -1687,9 +1755,8 @@ def _verify_step_paged_impl(
     view_width: Optional[int] = None,
     sampling=None,              # (temperature, top_k, top_p, seed, gen, pos)
     tp_parallel: bool = False,
+    attn_impl: str = "xla",
 ) -> Tuple[jax.Array, ...]:
-    from kubeflow_controller_tpu.ops.attention import paged_kv_view
-
     b, k_draft = draft.shape
     w = k_draft + 1
     dt = cfg.dtype
@@ -1697,19 +1764,21 @@ def _verify_step_paged_impl(
     n_blocks, bs = cache.k.shape[1], cache.k.shape[2]
     mb = cache.tables.shape[1]
     width = mb * bs
-    vw = width if view_width is None else min(view_width, width)
+    vw = _occupancy_cap(width, view_width)
     rep = cfg.n_heads // cfg.n_kv_heads
     par = tp_shards > 1 and tp_parallel
     g_local = (cfg.n_kv_heads // tp_shards if tp_shards > 1
                else cfg.n_kv_heads)
     gp = g_local if par else cfg.n_kv_heads      # projection head groups
     pos0 = cache.length                              # [B]
-    kview = paged_kv_view(
-        cache.k, cache.tables, vw, scale=cache.k_scale, out_dtype=dt,
-    )                                                # [L, B, vw, KVH, D]
-    vview = paged_kv_view(
-        cache.v, cache.tables, vw, scale=cache.v_scale, out_dtype=dt,
-    )
+    if attn_impl == "pallas":
+        # The K+1-wide kernel streams every slot's pages in place; the
+        # scan walks a layer index instead of gathered views.
+        kview = vview = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    else:
+        kview, vview = _capped_kv_views(
+            cache.k, cache.v, cache.tables, width, view_width,
+            cache.k_scale, cache.v_scale, dt)        # [L, B, vw, KVH, D]
 
     if sampling is None:
         t0 = logits.argmax(-1).astype(jnp.int32)
@@ -1750,26 +1819,46 @@ def _verify_step_paged_impl(
             k = _tp_slice_heads(k, g_local, axis=2)
             v = _tp_slice_heads(v, g_local, axis=2)
         scale = hd ** -0.5
-        s_cache = jnp.einsum(
-            "bqgrd,bkgd->bgrqk", qg, kc,
-            preferred_element_type=jnp.float32,
-        ) * scale                                    # [B,G,rep,W,vw]
-        s_cache = jnp.where(
-            (cache_cols[None, :] < pos0[:, None])[:, None, None, None, :],
-            s_cache, -1e30,
-        )
-        s_new = jnp.einsum(
-            "bqgrd,bkgd->bgrqk", qg, k,
-            preferred_element_type=jnp.float32,
-        ) * scale                                    # [B,G,rep,W,W]
-        s_new = jnp.where(causal[None, None, None], s_new, -1e30)
-        p = jax.nn.softmax(
-            jnp.concatenate([s_cache, s_new], axis=-1), axis=-1
-        ).astype(dt)
-        attn = (
-            jnp.einsum("bgrqk,bkgd->bqgrd", p[..., :vw], vc)
-            + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., vw:], v)
-        )
+        if attn_impl == "pallas":
+            from kubeflow_controller_tpu.ops.paged_attention_pallas import (
+                paged_attention_verify,
+            )
+            layer = kc                               # [] int32 pool index
+            attn = paged_attention_verify(
+                qg, k, v,
+                lax.dynamic_index_in_dim(cache.k, layer, keepdims=False),
+                lax.dynamic_index_in_dim(cache.v, layer, keepdims=False),
+                cache.tables, pos0,
+                k_scale=None if cache.k_scale is None else
+                lax.dynamic_index_in_dim(
+                    cache.k_scale, layer, keepdims=False),
+                v_scale=None if cache.v_scale is None else
+                lax.dynamic_index_in_dim(
+                    cache.v_scale, layer, keepdims=False),
+                width=vw, sm_scale=scale, out_dtype=dt,
+            )                                        # [B, W, G, rep, D]
+        else:
+            s_cache = jnp.einsum(
+                "bqgrd,bkgd->bgrqk", qg, kc,
+                preferred_element_type=jnp.float32,
+            ) * scale                                # [B,G,rep,W,vw]
+            s_cache = jnp.where(
+                (cache_cols[None, :]
+                 < pos0[:, None])[:, None, None, None, :],
+                s_cache, -1e30,
+            )
+            s_new = jnp.einsum(
+                "bqgrd,bkgd->bgrqk", qg, k,
+                preferred_element_type=jnp.float32,
+            ) * scale                                # [B,G,rep,W,W]
+            s_new = jnp.where(causal[None, None, None], s_new, -1e30)
+            p = jax.nn.softmax(
+                jnp.concatenate([s_cache, s_new], axis=-1), axis=-1
+            ).astype(dt)
+            attn = (
+                jnp.einsum("bgrqk,bkgd->bqgrd", p[..., :vw], vc)
+                + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., vw:], v)
+            )
         if par:
             attn = attn.reshape(b, w, -1)
             x = x + lax.psum(attn @ _w(lp, "wo", dt), "tp")
@@ -1865,28 +1954,35 @@ def verify_step_paged(
     mesh: Optional[Mesh] = None,
     view_width: Optional[int] = None,
     tp_compute: str = "gathered",
+    attn_impl: str = "xla",
 ) -> Tuple[jax.Array, jax.Array, jax.Array, PagedKVCache]:
     """``verify_step_slots`` over the paged pool: the K+1 verify window
-    attends to each slot's table-gathered page view, and ONLY the
-    accepted positions' k/v scatter into the slot's own pages (rejected
-    and padded positions map to the drop sentinel — rollback is still
-    by never committing). Acceptance, budget/EOS truncation, and the
-    carried logits are the contiguous verifier's code verbatim, so the
-    fp paged path commits the bitwise-identical stream. ``mesh`` /
-    ``view_width`` / ``tp_compute``: see :func:`decode_step_paged` —
-    acceptance runs on replicated logits (psum results are identical on
-    every shard), so every shard commits the same ``n``."""
+    attends to each slot's pages, and ONLY the accepted positions' k/v
+    scatter into the slot's own pages (rejected and padded positions
+    map to the drop sentinel — rollback is still by never committing).
+    Acceptance, budget/EOS truncation, and the carried logits are the
+    contiguous verifier's code verbatim, so the fp paged path commits
+    the bitwise-identical stream under the default ``attn_impl="xla"``
+    (table-gathered page view — the oracle). ``attn_impl="pallas"``
+    swaps the gather for the fused K+1-wide kernel
+    (``ops.paged_attention_pallas.paged_attention_verify``); attention
+    output carries the declared tolerance contract while accept/reject
+    decisions and committed streams stay equal to the oracle engine's.
+    ``mesh`` / ``view_width`` / ``tp_compute``: see
+    :func:`decode_step_paged` — acceptance runs on replicated logits
+    (psum results are identical on every shard), so every shard commits
+    the same ``n``."""
     tp = tp_size(mesh)
     if tp <= 1:
         return _verify_step_paged_impl(
             cfg, params, draft, draft_len, logits, cache, eos,
-            max_commit, 1, view_width)
+            max_commit, 1, view_width, None, False, attn_impl)
     check_tp_heads(cfg, tp, tp_compute)
     parallel = tp_compute == "parallel"
     fn = shard_map(
         functools.partial(_verify_step_paged_impl, cfg,
                           tp_shards=tp, view_width=view_width,
-                          tp_parallel=parallel),
+                          tp_parallel=parallel, attn_impl=attn_impl),
         mesh=mesh,
         in_specs=(_tp_param_specs(params, parallel), P(), P(), P(),
                   paged_cache_specs(cache), P(), P()),
@@ -1914,6 +2010,7 @@ def verify_step_paged_sampled(
     mesh: Optional[Mesh] = None,
     view_width: Optional[int] = None,
     tp_compute: str = "gathered",
+    attn_impl: str = "xla",
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, PagedKVCache]:
     """:func:`verify_step_paged` generalized to per-row sampling via the
     standard speculative-sampling acceptance rule specialized to this
@@ -1936,7 +2033,7 @@ def verify_step_paged_sampled(
     if tp <= 1:
         return _verify_step_paged_impl(
             cfg, params, draft, draft_len, logits, cache, eos,
-            max_commit, 1, view_width, sampling)
+            max_commit, 1, view_width, sampling, False, attn_impl)
     check_tp_heads(cfg, tp, tp_compute)
     parallel = tp_compute == "parallel"
 
@@ -1945,7 +2042,7 @@ def verify_step_paged_sampled(
         return _verify_step_paged_impl(
             cfg, params, draft, draft_len, logits, cache, eos, max_commit,
             tp_shards=tp, view_width=view_width, sampling=sampling,
-            tp_parallel=parallel)
+            tp_parallel=parallel, attn_impl=attn_impl)
 
     fn = shard_map(
         _shard_body,

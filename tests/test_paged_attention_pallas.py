@@ -1,14 +1,20 @@
-"""Fused Pallas paged-attention decode kernel vs the gather oracle.
+"""Fused Pallas paged-attention kernels vs the gather oracle — all
+three phases: single-row decode, width-W flash prefill, and K+1-wide
+speculative verify.
 
 ``ops/paged_attention_pallas.py`` walks each slot's block table page by
 page with a flash-style online softmax, reading pool pages in place —
 the dense ``paged_kv_view`` never exists, and int8 dequant fuses into
-the page load. The gather path stays the repo's bit-exactness ORACLE;
-the kernel's contract is a declared tolerance (``PALLAS_TOL`` — online
-softmax reassociates the row reduction, so a few ulps, never bitwise).
-Tier-1 pins that contract here with the kernel in INTERPRET mode on CPU
-(`make test-pallas` runs exactly this file), so the kernel's math is
-exercised on every CI run, not just on TPU hardware.
+the page load. The prefill/verify kernels add an intra-chunk causal
+tile over the dispatch's fresh K/V (computed FIRST, so the running max
+is finite before any fully-masked pool page). The gather path stays
+the repo's bit-exactness ORACLE; the kernels' contract is a declared
+tolerance (``PALLAS_TOL`` — online softmax reassociates the row
+reduction, so a few ulps, never bitwise), while greedy streams and
+verify accept/reject decisions stay EQUAL. Tier-1 pins those contracts
+here with the kernels in INTERPRET mode on CPU (`make test-pallas`
+runs exactly this file), so the kernel math is exercised on every CI
+run, not just on TPU hardware.
 """
 
 import jax
@@ -210,10 +216,291 @@ def test_pallas_engine_streams_and_traffic_gauge():
             == eng_x.stats.flops_per_token_per_shard)
 
 
+def _chunk_oracle(q, k_new, v_new, k_pool, v_pool, tables, pos,
+                  k_scale=None, v_scale=None, width=None):
+    """Reference chunk attention THROUGH the gather oracle: dense view
+    via paged_kv_view masked ``cols < pos[b]``, plus the intra-chunk
+    causal tile over the fresh K/V, one softmax over the concat — the
+    exact math the XLA path runs in _prefill_chunk_paged_impl /
+    _verify_step_paged_impl."""
+    b, w = q.shape[0], q.shape[1]
+    S = tables.shape[1] * k_pool.shape[1] if width is None else width
+    k = paged_kv_view(k_pool, tables, S, k_scale, jnp.float32)
+    v = paged_kv_view(v_pool, tables, S, v_scale, jnp.float32)
+    qf = q.astype(jnp.float32)
+    scale = q.shape[-1] ** -0.5
+    s_cache = jnp.einsum("bqgrd,bkgd->bgrqk", qf, k) * scale
+    vis = jnp.arange(S)[None, :] < pos[:, None]          # [B, S]
+    s_cache = jnp.where(vis[:, None, None, None, :], s_cache, -1e30)
+    s_new = jnp.einsum(
+        "bqgrd,bkgd->bgrqk", qf,
+        k_new.astype(jnp.float32)) * scale               # [B,G,r,W,W]
+    causal = (jnp.arange(w)[:, None] >= jnp.arange(w)[None, :])
+    s_new = jnp.where(causal[None, None, None], s_new, -1e30)
+    p = jax.nn.softmax(jnp.concatenate([s_cache, s_new], axis=-1),
+                       axis=-1)
+    return (jnp.einsum("bgrqk,bkgd->bqgrd", p[..., :S], v)
+            + jnp.einsum("bgrqk,bkgd->bqgrd", p[..., S:],
+                         v_new.astype(jnp.float32)))
+
+
+def _chunk_setup(seed=0, b=4, w=BS, g=2, rep=2, hd=16, n_blocks=12,
+                 quant=False):
+    """Pools/tables/positions from _setup (sentinel tails, degenerate
+    positions) plus a width-W batch of fresh chunk queries and K/V. The
+    fresh K/V stay fp32 even when the pools are int8 — matching the
+    product path, where the dispatch's K/V are quantized only at the
+    post-attention pool scatter."""
+    _, k_pool, v_pool, tables, pos, ks, vs = _setup(
+        seed, b, g, rep, hd, n_blocks, quant)
+    rng = np.random.default_rng(seed + 100)
+    q = rng.standard_normal((b, w, g, rep, hd)).astype(np.float32)
+    k_new = rng.standard_normal((b, w, g, hd)).astype(np.float32)
+    v_new = rng.standard_normal((b, w, g, hd)).astype(np.float32)
+    return (jnp.asarray(q), jnp.asarray(k_new), jnp.asarray(v_new),
+            k_pool, v_pool, tables, pos, ks, vs)
+
+
+def test_pallas_prefill_matches_oracle_fp():
+    """Flash prefill-chunk kernel vs the gather oracle, at a chunk
+    landing mid-page (offset BS+3) and at offset 0 (NO visible cache
+    column — the intra-chunk tile must carry the softmax alone), for a
+    full block_size chunk and a pow2-padded tail width."""
+    for w in (BS, 4):
+        q, k_new, v_new, k_pool, v_pool, tables, pos, _, _ = \
+            _chunk_setup(seed=21, w=w)
+        want = _chunk_oracle(q, k_new, v_new, k_pool, v_pool, tables,
+                             pos)
+        for bi in (0, 1):                    # offsets BS+3 and 0
+            got = pap.paged_attention_prefill(
+                q[bi], k_new[bi], v_new[bi], k_pool, v_pool,
+                tables[bi], pos[bi])
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want[bi]), **PALLAS_TOL)
+
+
+def test_pallas_verify_matches_oracle_fp():
+    """K+1-wide verify kernel vs the gather oracle at W=3 (K=2 drafts)
+    across the degenerate position set, including pos=0 (fresh slot:
+    nothing cached, pure intra-window causal attention)."""
+    q, k_new, v_new, k_pool, v_pool, tables, pos, _, _ = _chunk_setup(
+        seed=23, w=3)
+    got = pap.paged_attention_verify(q, k_new, v_new, k_pool, v_pool,
+                                     tables, pos)
+    want = _chunk_oracle(q, k_new, v_new, k_pool, v_pool, tables, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               **PALLAS_TOL)
+
+
+def test_pallas_chunk_width_cap_walks_fewer_pages():
+    """``width`` caps the chunk kernels' table walk exactly like the
+    view's occupancy cap: while the cap covers every visible column the
+    output equals the full-span walk (masked pages contribute exact
+    zeros either way)."""
+    q, k_new, v_new, k_pool, v_pool, tables, pos, _, _ = _chunk_setup(
+        seed=31, w=4)
+    pos = jnp.minimum(pos, 2 * BS - 1)       # occupancy fits two pages
+    full = pap.paged_attention_verify(q, k_new, v_new, k_pool, v_pool,
+                                      tables, pos)
+    for w in (2 * BS, 3 * BS):
+        capped = pap.paged_attention_verify(
+            q, k_new, v_new, k_pool, v_pool, tables, pos, width=w)
+        np.testing.assert_allclose(np.asarray(capped),
+                                   np.asarray(full), **PALLAS_TOL)
+        want = _chunk_oracle(q, k_new, v_new, k_pool, v_pool, tables,
+                             pos, width=w)
+        np.testing.assert_allclose(np.asarray(capped),
+                                   np.asarray(want), **PALLAS_TOL)
+    capped_p = pap.paged_attention_prefill(
+        q[0], k_new[0], v_new[0], k_pool, v_pool, tables[0], pos[0],
+        width=2 * BS)
+    np.testing.assert_allclose(np.asarray(capped_p),
+                               np.asarray(full[0]), **PALLAS_TOL)
+
+
+def test_pallas_chunk_kernels_int8_dequant_fused():
+    """int8 pools dequantize inside the chunk kernels' page load (the
+    fresh K/V stay fp): same tolerance contract against the oracle's
+    gather-time dequant, for both prefill and verify."""
+    q, k_new, v_new, k_pool, v_pool, tables, pos, ks, vs = _chunk_setup(
+        seed=29, w=4, quant=True)
+    want = _chunk_oracle(q, k_new, v_new, k_pool, v_pool, tables, pos,
+                         k_scale=ks, v_scale=vs)
+    got = pap.paged_attention_verify(q, k_new, v_new, k_pool, v_pool,
+                                     tables, pos, k_scale=ks,
+                                     v_scale=vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               **PALLAS_TOL)
+    got_p = pap.paged_attention_prefill(
+        q[0], k_new[0], v_new[0], k_pool, v_pool, tables[0], pos[0],
+        k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(got_p), np.asarray(want[0]),
+                               **PALLAS_TOL)
+
+
+def test_pallas_chunked_prefill_path_matches_xla():
+    """prefill_chunk_paged with attn_impl='pallas' vs the XLA gather
+    over a full chunk schedule — two block_size chunks then a
+    pow2-padded tail (n_real < padded width): greedy argmax identical,
+    logits within the compounded tolerance, committed lengths equal."""
+    cfg = tfm.tiny_config(n_kv_heads=4)
+    params = gen.inference_params(
+        cfg, tfm.init_params(cfg, jax.random.key(2)))
+    rng = np.random.default_rng(17)
+    prompt = rng.integers(0, cfg.vocab_size, 21).astype(np.int32)
+    mb = 32 // BS
+    outs = {}
+    for impl in ("xla", "pallas"):
+        cache = gen.init_paged_cache(cfg, 1, mb, mb + 2, BS, "")
+        tables = np.random.default_rng(5).permutation(mb).astype(
+            np.int32).reshape(1, mb)
+        cache = cache._replace(tables=jnp.asarray(tables))
+        slot = jnp.asarray(0, jnp.int32)
+        for start in (0, BS):
+            lg, cache = gen.prefill_chunk_paged(
+                cfg, params,
+                jnp.asarray(prompt[None, start:start + BS]), cache,
+                slot, jnp.asarray(start, jnp.int32),
+                jnp.asarray(BS, jnp.int32), attn_impl=impl)
+        tail = prompt[2 * BS:]
+        padded = np.zeros(BS, np.int32)
+        padded[:len(tail)] = tail
+        lg, cache = gen.prefill_chunk_paged(
+            cfg, params, jnp.asarray(padded[None]), cache, slot,
+            jnp.asarray(2 * BS, jnp.int32),
+            jnp.asarray(len(tail), jnp.int32), attn_impl=impl)
+        outs[impl] = (np.asarray(lg), int(cache.length[0]))
+    assert outs["xla"][1] == outs["pallas"][1] == 21
+    assert (outs["xla"][0].argmax(-1)
+            == outs["pallas"][0].argmax(-1)).all()
+    np.testing.assert_allclose(outs["xla"][0], outs["pallas"][0],
+                               **PALLAS_LOGITS_TOL)
+
+
+def test_pallas_verify_decisions_bitwise():
+    """verify_step_paged with attn_impl='pallas': the accept/reject
+    DECISIONS — committed window and accepted count n, per slot — are
+    bitwise the oracle path's across the draft spectrum: garbage
+    drafts (reject all but the carried token), a perfect greedy draft
+    (accept everything), a budget-capped commit, and an EOS mid-draft
+    (truncate at the EOS token)."""
+    cfg = tfm.tiny_config(n_kv_heads=4)
+    params = gen.inference_params(
+        cfg, tfm.init_params(cfg, jax.random.key(3)))
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, cfg.vocab_size, s).astype(np.int32)
+               for s in (7, 11)]
+    mb = 32 // BS
+    K = 3
+
+    def fresh(impl):
+        cache = gen.init_paged_cache(cfg, 2, mb, 2 * mb + 2, BS, "")
+        tables = np.random.default_rng(7).permutation(
+            2 * mb).astype(np.int32).reshape(2, mb)
+        cache = cache._replace(tables=jnp.asarray(tables))
+        rows = []
+        for i, pr in enumerate(prompts):
+            lg, cache = gen.prefill_into_paged(
+                cfg, params, jnp.asarray(pr[None]), cache,
+                jnp.asarray(i, jnp.int32))
+            rows.append(np.asarray(lg))
+        return cache, jnp.asarray(np.concatenate(rows, axis=0))
+
+    # A perfect draft for row 0: greedy-decode K tokens on a scratch
+    # cache, then draft the continuation AFTER the carried t0.
+    scratch, lg = fresh("xla")
+    toks = []
+    for _ in range(K + 1):
+        t = lg.argmax(-1).astype(jnp.int32)
+        toks.append(np.asarray(t))
+        lg, scratch = gen.decode_step_paged(
+            cfg, params, t[:, None], scratch)
+    perfect = np.stack(toks, axis=1)         # [2, K+1]; col 0 == t0
+
+    eos_none = jnp.full((2,), -1, jnp.int32)
+    budget = jnp.full((2,), K + 1, jnp.int32)
+    cases = [
+        ("garbage", jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (2, K)).astype(np.int32)),
+         eos_none, budget),
+        ("perfect", jnp.asarray(perfect[:, 1:]), eos_none, budget),
+        ("max_commit", jnp.asarray(perfect[:, 1:]), eos_none,
+         jnp.full((2,), 2, jnp.int32)),
+        ("eos", jnp.asarray(perfect[:, 1:]),
+         jnp.asarray([int(perfect[0, 1]), -1], jnp.int32), budget),
+    ]
+    dlen = jnp.full((2,), K, jnp.int32)
+    for name, draft, eos, cap in cases:
+        got = {}
+        for impl in ("xla", "pallas"):
+            cache, lg0 = fresh(impl)
+            win, n, lg1, _ = gen.verify_step_paged(
+                cfg, params, draft, dlen, lg0, cache, eos, cap,
+                attn_impl=impl)
+            got[impl] = (np.asarray(win), np.asarray(n),
+                         np.asarray(lg1))
+        assert np.array_equal(got["xla"][0], got["pallas"][0]), name
+        assert np.array_equal(got["xla"][1], got["pallas"][1]), name
+        np.testing.assert_allclose(got["xla"][2], got["pallas"][2],
+                                   err_msg=name, **PALLAS_LOGITS_TOL)
+
+
+def test_pallas_engine_spec_tp_streams_and_phase_gauges():
+    """Engine-level gate with speculative decoding, at tp=1 and tp=2:
+    greedy streams under attn_impl='pallas' equal the oracle engine's
+    token for token, and every per-phase HBM gauge (prefill, decode,
+    verify) reports the 3x->1x saving — the phase-aware model stops a
+    pallas engine from claiming factor-1 for phases it never ran on
+    the kernel."""
+    cfg = tfm.tiny_config(n_kv_heads=4)
+    params = gen.inference_params(
+        cfg, tfm.init_params(cfg, jax.random.key(4)))
+    rng = np.random.default_rng(19)
+    reqs = [Request(rid=i,
+                    prompt=rng.integers(0, cfg.vocab_size, 5 + 4 * i)
+                    .astype(np.int32),
+                    max_new_tokens=5)
+            for i in range(3)]
+
+    def run(impl, tp):
+        eng = ServingEngine(cfg, params, n_slots=2, max_seq=48,
+                            prefill_mode="bucketed", block_size=BS,
+                            attn_impl=impl, tp=tp,
+                            spec_decode=True, draft_k=3,
+                            decode_chunk=1)
+        out = eng.run([Request(rid=r.rid, prompt=r.prompt.copy(),
+                               max_new_tokens=r.max_new_tokens)
+                       for r in reqs])
+        return {c.rid: list(c.tokens) for c in out}, eng
+
+    base, eng_x = run("xla", 1)
+    for tp in (1, 2):
+        got, eng_p = run("pallas", tp)
+        assert got == base, f"pallas tp={tp} diverged from oracle"
+    sx, sp = eng_x.stats, eng_p.stats
+    for phase in ("prefill", "decode", "verify"):
+        px = getattr(sx, f"hbm_bytes_per_step_{phase}")
+        pp = getattr(sp, f"hbm_bytes_per_step_{phase}")
+        assert 0 < pp < px, (phase, pp, px)
+    # The summary (and thus the metrics JSONL) mirrors the split.
+    summ = sp.summary()
+    assert summ["hbm_bytes_per_step_prefill"] == \
+        sp.hbm_bytes_per_step_prefill
+    assert summ["hbm_bytes_per_step_decode"] == sp.hbm_bytes_per_step
+
+
 def test_pallas_refuses_without_backend(monkeypatch):
     """A jax build without the pallas TPU backend must refuse loudly at
-    dispatch, pointing at attn_impl='xla' — not crash inside a trace."""
+    dispatch, pointing at attn_impl='xla' — not crash inside a trace.
+    All three entry points carry the same refusal."""
     q, k_pool, v_pool, tables, pos, _, _ = _setup(seed=9, b=1)
+    qc, k_new, v_new, *_ = _chunk_setup(seed=9, b=1, w=2)
     monkeypatch.setattr(pap, "pltpu", None)
     with pytest.raises(NotImplementedError, match="attn_impl='xla'"):
         pap.paged_attention_decode(q, k_pool, v_pool, tables, pos)
+    with pytest.raises(NotImplementedError, match="attn_impl='xla'"):
+        pap.paged_attention_prefill(qc[0], k_new[0], v_new[0], k_pool,
+                                    v_pool, tables[0], pos[0])
+    with pytest.raises(NotImplementedError, match="attn_impl='xla'"):
+        pap.paged_attention_verify(qc, k_new, v_new, k_pool, v_pool,
+                                   tables, pos)
