@@ -6,7 +6,7 @@ PY ?= python
 .PHONY: all native test test-fast test-tp test-obs test-sampling \
 	test-pallas bench \
 	bench-cp bench-serve bench-overload bench-prefix bench-fleet \
-	bench-disagg \
+	bench-disagg bench-kv-tier \
 	bench-spec bench-paged bench-tp bench-prefill bench-obs bench-sampling \
 	clean stamp
 
@@ -122,6 +122,18 @@ bench-fleet:
 bench-disagg:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_bench.py --smoke \
 		--only-disagg --trace /tmp/disagg_trace.json
+
+# Tiered-KV benchmark: host-RAM spill tier vs discard-on-evict on a
+# prefix working set ~4x the device KV pool (greedy streams asserted
+# bit-identical before timing; exits nonzero unless tier-on TTFT p50
+# <= 0.5x the baseline at equal device HBM), the batched heap eviction
+# vs the legacy O(nodes)-per-page rescan (nodes-examined counters,
+# same victims), and the fleet prefix pull (local-miss/remote-hit with
+# rehydrate_hits > 0 on the pulled replica) — see benchmarks/RESULTS.md
+# and docs/serving.md "Tiered KV and fleet-global prefix pooling".
+bench-kv-tier:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/kv_tier_bench.py \
+		--json benchmarks/kv_tier_bench_summary.json
 
 # Speculative-decoding benchmark: radix drafting on repeat traffic
 # (greedy outputs asserted bit-identical before timing; exits nonzero

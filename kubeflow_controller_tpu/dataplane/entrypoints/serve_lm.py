@@ -156,6 +156,7 @@ def serve(
     prefix_cache: bool = False,
     block_size: int = 16,
     kv_pool_mb: Optional[float] = None,
+    host_kv_mb: float = 0.0,
     kv_quant: str = "",
     paged: bool = True,
     speculative: bool = False,
@@ -211,6 +212,12 @@ def serve(
         raise ValueError(
             "--disagg migrates KV pages between engines and requires "
             "the paged block pool (drop --no-paged)")
+    if host_kv_mb < 0:
+        raise ValueError(f"--host-kv-mb must be >= 0 (got {host_kv_mb})")
+    if host_kv_mb > 0 and not prefix_cache:
+        raise ValueError(
+            "--host-kv-mb spills radix-cache pages to host RAM and "
+            "requires --prefix-cache (0 disables the tier)")
     if (top_k > 0 or top_p < 1.0) and turns > 1 and not prefix_cache:
         raise ValueError(
             "top-k/top-p serve through the engine; the contiguous "
@@ -273,7 +280,8 @@ def serve(
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 seed=seed, max_queue=max_queue,
                 prefill_mode=pm, prefix_cache=pc, block_size=block_size,
-                kv_hbm_budget_mb=kv_pool_mb, kv_quant=kv_quant,
+                kv_hbm_budget_mb=kv_pool_mb, host_kv_mb=host_kv_mb,
+                kv_quant=kv_quant,
                 paged=paged, spec_decode=speculative, draft_k=draft_k,
                 proposer=proposer, tp=tp, mesh=mesh,
                 tp_compute=tp_compute, attn_impl=attn_impl,
@@ -320,7 +328,12 @@ def serve(
                     max_new_tokens=max_new_tokens, eos_id=eos_id,
                     deadline_s=deadline_s, params=req_params,
                 ))
-            max_steps = 2 * (b * n * max_new_tokens + 2 * b * n + 4)
+            # Chunked (bucketed) prefill admits one block-sized chunk
+            # per step on a cache miss, so the worst case is every
+            # request re-prefilling its whole prompt chunkwise.
+            chunks = -(-s // block_size)
+            max_steps = 2 * (b * n * (max_new_tokens + chunks)
+                             + 2 * b * n + 4)
             for _ in range(max_steps):
                 if stop is not None and stop.is_set():
                     logger.info(
@@ -343,7 +356,11 @@ def serve(
             serving = engines["decode-0"].stats.summary(wall_s=dt)
             fleet = router.fleet_summary()
             for k in ("migrations", "pages_migrated", "migration_bytes",
-                      "migrated_zero_copy_tokens"):
+                      "migrated_zero_copy_tokens",
+                      "spilled_pages", "spill_bytes", "rehydrate_hits",
+                      "rehydrate_tokens", "host_pages_resident",
+                      "prefix_pulls", "prefix_pull_pages",
+                      "prefix_pull_bytes"):
                 serving[k] = fleet[k]
         else:
             engine = _mk_engine(
@@ -358,7 +375,12 @@ def serve(
                     ))
                 except Rejected as e:
                     logger.warning("request %d rejected: %s", i, e.reason)
-            max_steps = b * n * max_new_tokens + 2 * b * n + 4
+            # Same chunked-prefill worst case as the fleet path above:
+            # a small pool can force every prompt to re-prefill
+            # chunkwise each wave (discard-on-evict with no host tier).
+            effective_mode = "bucketed" if prefix_cache else prefill_mode
+            chunks = -(-s // block_size) if effective_mode != "exact" else 1
+            max_steps = b * n * (max_new_tokens + chunks) + 2 * b * n + 4
             announced = False
             for _ in range(max_steps):
                 if stop is not None and stop.is_set():
@@ -406,7 +428,7 @@ def serve(
             max_queue=max_queue,
             prefill_mode="bucketed", prefix_cache=True,
             block_size=block_size, kv_hbm_budget_mb=kv_pool_mb,
-            kv_quant=kv_quant, paged=paged,
+            host_kv_mb=host_kv_mb, kv_quant=kv_quant, paged=paged,
             spec_decode=speculative, draft_k=draft_k, proposer=proposer,
             tp=tp, mesh=mesh, tp_compute=tp_compute, attn_impl=attn_impl,
             tracer=tracer,
@@ -620,6 +642,15 @@ def main(argv=None) -> int:
                         "one full context per slot, doubled when the "
                         "prefix cache is on); with --kv-quant int8 the "
                         "same budget holds ~2x the pages")
+    p.add_argument("--host-kv-mb", type=float, default=0.0,
+                   help="pinned-host-RAM budget in MiB for the tiered "
+                        "KV spill store beneath the radix cache "
+                        "(requires --prefix-cache): evicted prefix "
+                        "pages spill to host instead of being "
+                        "discarded and rehydrate on the next hit, "
+                        "bit-identically; 0 disables the tier — "
+                        "byte-identical to discard-on-evict "
+                        "(docs/serving.md)")
     p.add_argument("--kv-quant", default="none", choices=["none", "int8"],
                    help="KV pool precision: int8 stores pages as int8 + "
                         "per-(row, head) fp32 scales dequantized in the "
@@ -711,6 +742,11 @@ def main(argv=None) -> int:
     if (args.n > 1 or args.grammar) and args.turns > 1:
         p.error("--n / --grammar are single-turn engine features "
                 "(use --turns 1)")
+    if args.host_kv_mb < 0:
+        p.error(f"--host-kv-mb must be >= 0 (got {args.host_kv_mb})")
+    if args.host_kv_mb > 0 and not args.prefix_cache:
+        p.error("--host-kv-mb spills radix-cache pages to host RAM and "
+                "requires --prefix-cache (0 disables the tier)")
     ctx = initialize_from_env()
     # Two-strike SIGTERM/SIGINT drain (util/signals.py, signals.go:26-40
     # parity): first signal sets the stop event — the engine drains and
@@ -747,6 +783,7 @@ def main(argv=None) -> int:
         prefix_cache=args.prefix_cache,
         block_size=args.block_size,
         kv_pool_mb=args.kv_pool_mb if args.kv_pool_mb > 0 else None,
+        host_kv_mb=args.host_kv_mb,
         kv_quant="" if args.kv_quant == "none" else args.kv_quant,
         paged=args.paged,
         speculative=args.speculative,

@@ -44,12 +44,25 @@ Ownership model (the part the property tests pin):
 Publishing a chain whose node already exists (two slots computed the
 same block concurrently) keeps the loser's duplicate page owned by its
 slot until retirement — tables never retarget mid-flight.
+
+**Host tier** (Mooncake's KVCache-centric disaggregation, Qin et al.
+2024): with a :class:`HostKVTier` attached, eviction *spills* the
+victim's page bytes to a byte-budgeted host-RAM LRU instead of
+discarding them — the trie node stays, keyed as before, marked SPILLED
+(``block == -1``, ``host_handle`` set). A later admission that walks
+into spilled nodes rehydrates them: the raw page bytes (int8 payload +
+scales included, never requantized) are installed back into the pool,
+so the re-prefill a discard would have forced becomes one host→device
+copy. Spilled nodes hold no pool page and take no request pins; a node
+is always in exactly one tier (the spill moves bytes, the rehydrate
+moves them back — no aliasing across tiers).
 """
 
 from __future__ import annotations
 
+import heapq
 import os
-from collections import Counter
+from collections import Counter, OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -197,12 +210,100 @@ class BlockPool:
             self._owners.pop(bid, None)
 
 
+class HostKVTier:
+    """Byte-budgeted host-RAM LRU of spilled KV pages.
+
+    Pure host state: each entry is one pool page's raw bytes as numpy
+    arrays ``(k, v, k_scale, v_scale)`` shaped ``[L, 1, block_size,
+    KVH(, D)]`` (scales ``None`` for fp pools), exactly what
+    ``models/generate.py:gather_pool_pages`` returns for a single page
+    and what ``install_pool_pages`` reinstalls — the spill/rehydrate
+    hop moves bytes verbatim, never requantizes, which is what keeps
+    streams bit-identical across the round trip.
+
+    ``put`` evicts least-recently-used entries until the new page fits
+    (and returns ``None`` if a single page exceeds the whole budget —
+    the caller falls back to discard-on-evict for that page). Handles
+    are never reused; a handle whose entry was LRU-dropped simply stops
+    answering ``has()``, and the trie prunes such dead spilled nodes
+    lazily on the next walk through them.
+    """
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes < 0:
+            raise ValueError(
+                f"budget_bytes must be >= 0 (got {budget_bytes})")
+        self.budget_bytes = int(budget_bytes)
+        self._pages: "OrderedDict[int, tuple]" = OrderedDict()
+        self._nbytes: Dict[int, int] = {}
+        self._next_handle = 0
+        self.resident_bytes = 0
+        #: entries dropped by LRU budget pressure (their trie nodes go
+        #: stale and are pruned on the next tiered walk).
+        self.evicted_pages = 0
+
+    @property
+    def resident_pages(self) -> int:
+        return len(self._pages)
+
+    @staticmethod
+    def payload_nbytes(payload: tuple) -> int:
+        return sum(int(a.nbytes) for a in payload if a is not None)
+
+    def put(self, payload: tuple) -> Optional[int]:
+        """Admit one page's bytes, LRU-evicting until it fits. Returns
+        the handle, or ``None`` when the page alone exceeds the budget
+        (including budget 0 — a disabled tier admits nothing)."""
+        nbytes = self.payload_nbytes(payload)
+        if nbytes > self.budget_bytes:
+            return None
+        while self.resident_bytes + nbytes > self.budget_bytes:
+            h, _ = self._pages.popitem(last=False)
+            self.resident_bytes -= self._nbytes.pop(h)
+            self.evicted_pages += 1
+        h = self._next_handle
+        self._next_handle += 1
+        self._pages[h] = payload
+        self._nbytes[h] = nbytes
+        self.resident_bytes += nbytes
+        return h
+
+    def has(self, handle: Optional[int]) -> bool:
+        return handle is not None and handle in self._pages
+
+    def touch(self, handle: int) -> None:
+        self._pages.move_to_end(handle)
+
+    def get(self, handle: int) -> tuple:
+        """Peek (and LRU-touch) a resident entry — the fleet export
+        path, which copies bytes out without moving the page."""
+        payload = self._pages[handle]
+        self._pages.move_to_end(handle)
+        return payload
+
+    def pop(self, handle: Optional[int]) -> Optional[tuple]:
+        """Remove and return an entry (None if dead) — the rehydrate
+        path. Move semantics: after a pop the bytes live in exactly one
+        place, so no page is ever aliased across tiers."""
+        if handle is None or handle not in self._pages:
+            return None
+        payload = self._pages.pop(handle)
+        self.resident_bytes -= self._nbytes.pop(handle)
+        return payload
+
+    def discard(self, handle: Optional[int]) -> None:
+        self.pop(handle)
+
+
 @dataclass
 class RadixNode:
     """One trie edge = one full block of ``block_size`` token ids.
 
     ``refs`` counts live-request pins (the trie's own hold on the pool
-    page is tracked in the BlockPool refcount, not here)."""
+    page is tracked in the BlockPool refcount, not here). A SPILLED
+    node (``block == -1``, ``host_handle`` set) keeps its key but holds
+    no pool page and can take no pins — its bytes live in the
+    :class:`HostKVTier` until a rehydrate or a tier-side LRU drop."""
 
     key: Tuple[int, ...]
     block: int
@@ -210,6 +311,7 @@ class RadixNode:
     children: Dict[Tuple[int, ...], "RadixNode"] = field(default_factory=dict)
     refs: int = 0
     last_use: int = 0
+    host_handle: Optional[int] = None
 
 
 class RadixCache:
@@ -218,17 +320,45 @@ class RadixCache:
     Every node below the root owns exactly one pool page holding the KV
     of its ``block_size`` tokens *in the context of its ancestors* —
     matching is therefore exact-prefix by construction. Eviction is LRU
-    over unpinned leaves; interior nodes become evictable once their
-    subtree is gone, so a cold chain drains from the tail.
+    over unpinned *effective* leaves (a node whose every child is
+    spilled counts — the spilled subtree keeps its keys and host bytes);
+    interior nodes become evictable once their resident subtree is
+    gone, so a cold chain drains from the tail.
+
+    Eviction candidates live in a lazy-deletion min-heap keyed by
+    ``last_use``: nodes are pushed when they *become* candidates
+    (creation, last pin released, last resident child evicted/spilled)
+    and validated at pop time, so freeing k pages costs O(k log n)
+    instead of the old full-tree rescan per page
+    (:meth:`_evict_one_scan`, kept as the benchmark baseline). Victim
+    ORDER is identical to the scan: ``_touch`` makes ``last_use``
+    unique, stale heap entries re-push with their current stamp before
+    being considered, and entries that are only *temporarily* invalid
+    (an external table still pins the page) re-enter the heap rather
+    than being dropped.
+
+    With a :class:`HostKVTier` attached, ``evict_chain`` hands each
+    victim wave to a spill callback before freeing the pages; victims
+    the callback keeps become SPILLED nodes instead of disappearing.
     """
 
-    def __init__(self, pool: BlockPool, block_size: int):
+    def __init__(self, pool: BlockPool, block_size: int,
+                 tier: Optional[HostKVTier] = None):
         if block_size <= 0:
             raise ValueError(f"block_size must be > 0 (got {block_size})")
         self.pool = pool
         self.block_size = block_size
+        self.tier = tier
         self.root = RadixNode(key=(), block=-1, parent=None)
         self._tick = 0
+        # Lazy-deletion eviction heap: (last_use at push, seq, node).
+        # seq breaks (impossible, but cheap) last_use ties without ever
+        # comparing RadixNode objects.
+        self._heap: List[Tuple[int, int, RadixNode]] = []
+        self._heap_seq = 0
+        #: heap entries examined (new path) or tree nodes visited per
+        #: rescan (legacy path) — the benchmark's before/after counter.
+        self.evict_nodes_scanned = 0
 
     # -- internals -------------------------------------------------------
 
@@ -236,42 +366,199 @@ class RadixCache:
         self._tick += 1
         node.last_use = self._tick
 
-    def _evictable(self) -> List[RadixNode]:
-        """Unpinned leaves, the only safely removable nodes: an interior
-        node's page encodes context its descendants were computed in.
-        Beyond the node's own pin count, the pool refcount must show no
-        holder other than the trie itself — attention now reads pages in
-        place through slot tables, so a page referenced by ANY live
-        table (request pin, external registration, in-flight publish)
-        must never return to the free list while that table can still
-        be dispatched."""
-        out = []
+    def _push_evictable(self, node: RadixNode) -> None:
+        """Register an eviction candidate. Called on every transition
+        that can MAKE a node evictable: creation (refs 0, refcount 1),
+        the release that drops its last pin, and the eviction/spill of
+        its last resident child. Duplicates are harmless — pop-time
+        validation drops them."""
+        if node is self.root:
+            return
+        self._heap_seq += 1
+        heapq.heappush(self._heap, (node.last_use, self._heap_seq, node))
+
+    def _blocked_by_children(self, node: RadixNode) -> bool:
+        """A resident child blocks eviction (its KV was computed in this
+        node's context and is still serving); a spilled child does not —
+        the spilled bytes stay valid under a parent that spills too, and
+        are discarded with the subtree if the parent is dropped."""
+        return any(c.block >= 0 for c in node.children.values())
+
+    def _pop_evictable(self) -> Optional[RadixNode]:
+        """Pop the least-recently-used valid eviction candidate.
+
+        Pop-time validation, in LRU order: tombstoned (evicted) and
+        spilled entries drop; entries whose node was touched since the
+        push re-push with the current stamp (so the true global minimum
+        is always considered first); pinned nodes and nodes with a
+        resident child drop — their re-push happens on the release /
+        child-eviction transition; nodes whose only disqualifier is an
+        external pool ref (a live table still reads the page) re-push
+        at the end — that transition is invisible to the trie, so the
+        entry must survive it."""
+        deferred: List[Tuple[int, int, RadixNode]] = []
+        found: Optional[RadixNode] = None
+        while self._heap:
+            pushed, seq, node = heapq.heappop(self._heap)
+            self.evict_nodes_scanned += 1
+            if node.parent is None or node.block < 0:
+                continue                      # evicted or spilled since
+            if pushed != node.last_use:
+                self._push_evictable(node)    # stale stamp: re-rank
+                continue
+            if node.refs > 0 or self._blocked_by_children(node):
+                continue                      # re-pushed on transition
+            if self.pool.refcount(node.block) > 1:
+                deferred.append((pushed, seq, node))
+                continue
+            found = node
+            break
+        for entry in deferred:
+            heapq.heappush(self._heap, entry)
+        return found
+
+    def _evict_one_scan(self) -> Optional[int]:
+        """Legacy full-rescan eviction (the pre-heap implementation):
+        rebuild the whole evictable-leaf list, take the LRU one. Kept as
+        the O(nodes)-per-page baseline `benchmarks/kv_tier_bench.py`
+        measures the heap against; picks the same victims."""
+        victims = []
         stack = list(self.root.children.values())
         while stack:
             n = stack.pop()
-            if (not n.children and n.refs == 0
+            self.evict_nodes_scanned += 1
+            if (n.block >= 0 and not self._blocked_by_children(n)
+                    and n.refs == 0
                     and self.pool.refcount(n.block) <= 1):
-                out.append(n)
+                victims.append(n)
             stack.extend(n.children.values())
-        return out
-
-    def evict_one(self) -> Optional[int]:
-        """Drop the least-recently-used unpinned leaf, returning its
-        freed page id (None when nothing is evictable)."""
-        victims = self._evictable()
         if not victims:
             return None
         victim = min(victims, key=lambda n: n.last_use)
-        del victim.parent.children[victim.key]
+        self._drop_victim(victim)
         bid = victim.block
-        self.pool.unref(bid)        # the trie's own hold -> free list
+        self.pool.unref(bid)
         return bid
+
+    def _drop_victim(self, node: RadixNode) -> None:
+        """Unlink an eviction victim, discarding its (all-spilled)
+        subtree's host bytes, and re-rank the parent."""
+        parent = node.parent
+        del parent.children[node.key]
+        node.parent = None                    # tombstone for heap entries
+        self._discard_handles(node)
+        if parent is not self.root and not self._blocked_by_children(parent):
+            self._push_evictable(parent)
+
+    def _discard_handles(self, node: RadixNode) -> None:
+        """Drop every host-tier entry in ``node``'s subtree (the node
+        itself included) — the spilled descendants of a dropped node
+        lost their context and can never be rehydrated."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if self.tier is not None and n.host_handle is not None:
+                self.tier.discard(n.host_handle)
+            n.host_handle = None
+            stack.extend(n.children.values())
+
+    def evict_chain(self, k: int, spill=None) -> List[int]:
+        """Free up to ``k`` pool pages from the LRU end of the trie,
+        returning the freed page ids (shorter when the trie runs out of
+        victims). Victim order is exactly k successive single-victim
+        evictions: after a leaf goes, its parent (touched earlier on
+        every walk, so always LRU-older) is immediately eligible within
+        the same call.
+
+        ``spill(nodes) -> List[bool]`` is called once per victim wave
+        BEFORE any page is freed (the engine batches one device→host
+        gather per wave and stashes each page in the host tier, setting
+        ``host_handle``); victims it keeps become SPILLED nodes — key
+        retained, page freed — the rest are dropped outright. The pages
+        are still resident during the callback, so the gather always
+        reads live bytes. ``spill=None`` (or all-False returns) is
+        plain discard-on-evict, byte-identical to the pre-tier engine.
+        """
+        freed: List[int] = []
+        while len(freed) < k:
+            wave: List[RadixNode] = []
+            while len(freed) + len(wave) < k:
+                node = self._pop_evictable()
+                if node is None:
+                    break
+                wave.append(node)
+                # An interior node whose last resident child just
+                # entered the wave becomes eligible NOW (both spill and
+                # drop unblock it) — push so the same wave can take it
+                # in true LRU order. Temporarily mark the child spilled
+                # so _blocked_by_children agrees; the real disposition
+                # is settled after the callback.
+                node._wave_block = node.block  # restored before gather
+                node.block = -1
+                parent = node.parent
+                if (parent is not self.root
+                        and not self._blocked_by_children(parent)):
+                    self._push_evictable(parent)
+            for node in wave:                 # restore before gather
+                node.block = node._wave_block
+                del node._wave_block
+            if not wave:
+                break
+            keep = spill(wave) if spill is not None else [False] * len(wave)
+            for node, kept in zip(wave, keep):
+                bid = node.block
+                if kept:
+                    # SPILLED: key + host_handle (set by the callback)
+                    # survive; only the pool page is reclaimed.
+                    node.block = -1
+                else:
+                    # Dropped: node.block keeps the stale id (callers
+                    # read it for accounting); parent=None tombstones
+                    # the node for any remaining heap entries.
+                    self._drop_victim(node)
+                self.pool.unref(bid)          # trie's hold -> free list
+                freed.append(bid)
+        return freed
+
+    def evict_one(self, spill=None) -> Optional[int]:
+        """Drop (or spill) the least-recently-used unpinned effective
+        leaf, returning its freed page id (None when nothing is
+        evictable)."""
+        freed = self.evict_chain(1, spill=spill)
+        return freed[0] if freed else None
 
     # -- queries ---------------------------------------------------------
 
     def match(self, tokens: Sequence[int]) -> List[RadixNode]:
-        """Longest chain of fully-cached blocks prefixing ``tokens``.
-        Returns the node path root-exclusive (possibly empty)."""
+        """Longest chain of fully-cached RESIDENT blocks prefixing
+        ``tokens`` — stops at the first spilled node. Returns the node
+        path root-exclusive (possibly empty). Callers that can pay the
+        host→device copy walk :meth:`match_tiered` instead; everything
+        that needs pinnable pages NOW (the migration probe, the radix
+        draft proposer) stays on this one."""
+        bs = self.block_size
+        path: List[RadixNode] = []
+        node = self.root
+        toks = [int(t) for t in tokens]
+        for i in range(0, len(toks) - bs + 1, bs):
+            key = tuple(toks[i:i + bs])
+            child = node.children.get(key)
+            if child is None or child.block < 0:
+                break
+            self._touch(child)
+            path.append(child)
+            node = child
+        return path
+
+    def match_tiered(self, tokens: Sequence[int]) -> List[RadixNode]:
+        """Longest cached chain prefixing ``tokens`` across BOTH tiers:
+        resident nodes first, then any run of spilled nodes whose host
+        bytes are still live. A spilled node whose tier entry was
+        LRU-dropped is pruned here (with its subtree — descendants lost
+        their context) and ends the walk. The invariant that no
+        resident node sits below a spilled one means the path is always
+        ``resident* spilled*``, which is what lets admission pin the
+        resident half first and rehydrate the tail."""
         bs = self.block_size
         path: List[RadixNode] = []
         node = self.root
@@ -281,10 +568,32 @@ class RadixCache:
             child = node.children.get(key)
             if child is None:
                 break
+            if child.block < 0:
+                if self.tier is None or not self.tier.has(child.host_handle):
+                    self.prune_subtree(child)
+                    break
+                self.tier.touch(child.host_handle)
             self._touch(child)
             path.append(child)
             node = child
         return path
+
+    def prune_subtree(self, node: RadixNode) -> None:
+        """Unlink a dead spilled node (tier entry LRU-dropped) and its
+        subtree. Every node below a spilled one is spilled itself and
+        pin-free, so this touches no pool state beyond discarding the
+        subtree's surviving host entries."""
+        stack = [node]
+        while stack:
+            m = stack.pop()
+            if m.block >= 0 or m.refs:
+                raise RuntimeError(
+                    "prune_subtree: resident or pinned node below a "
+                    "spilled one")
+            stack.extend(m.children.values())
+        del node.parent.children[node.key]
+        node.parent = None
+        self._discard_handles(node)
 
     def insert(
         self, tokens: Sequence[int],
@@ -316,15 +625,26 @@ class RadixCache:
         for i in range(len(known_path) * bs, len(toks) - bs + 1, bs):
             key = tuple(toks[i:i + bs])
             child = node.children.get(key)
-            if child is None:
+            if child is None or child.block < 0:
                 bid = self.pool.alloc()
                 while bid is None:
                     if self.evict_one() is None:
                         return path, new          # pool fully pinned
                     bid = self.pool.alloc()
-                child = RadixNode(key=key, block=bid, parent=node)
-                node.children[key] = child
+                if child is None:
+                    child = RadixNode(key=key, block=bid, parent=node)
+                    node.children[key] = child
+                else:
+                    # Spilled node on the ingest path: the caller is
+                    # about to scatter this exact block's KV anyway, so
+                    # re-residenting from the caller's bytes is cheaper
+                    # than a rehydrate — drop the host copy.
+                    child.block = bid
+                    if self.tier is not None:
+                        self.tier.discard(child.host_handle)
+                    child.host_handle = None
                 new.append((child, i))
+                self._push_evictable(child)
             self._touch(child)
             path.append(child)
             node = child
@@ -364,22 +684,46 @@ class RadixCache:
         for i in range(len(known_path) * bs, len(toks) - bs + 1, bs):
             key = tuple(toks[i:i + bs])
             child = node.children.get(key)
-            if child is None:
+            if child is None or child.block < 0:
                 bid = owned.get(i)
                 if bid is None:
                     return path, adopted
-                child = RadixNode(key=key, block=bid, parent=node)
-                node.children[key] = child
+                if child is None:
+                    child = RadixNode(key=key, block=bid, parent=node)
+                    node.children[key] = child
+                else:
+                    # Spilled node, and the publisher holds a page with
+                    # this block's bytes (same tokens, same ancestors,
+                    # same compiled fn => same bytes): re-adopt the
+                    # device copy, retire the host one.
+                    child.block = bid
+                    if self.tier is not None:
+                        self.tier.discard(child.host_handle)
+                    child.host_handle = None
                 adopted.append(i)
+                self._push_evictable(child)
             self._touch(child)
             path.append(child)
             node = child
         return path, adopted
 
+    def rehydrated(self, node: RadixNode, bid: int) -> None:
+        """Mark a spilled node resident again on ``bid`` (the engine
+        just installed its host bytes into the pool page and owns the
+        page at refcount 1 — that ref becomes the trie's hold)."""
+        assert node.block < 0 and node.parent is not None
+        node.block = bid
+        node.host_handle = None
+        self._touch(node)
+        self._push_evictable(node)
+
     def acquire(self, path: Sequence[RadixNode]) -> None:
         """Pin a chain on behalf of a live request (refcount +1 per node,
-        page and trie node both)."""
+        page and trie node both). Resident nodes only — a spilled node
+        holds no page to pin; rehydrate it first."""
         for n in path:
+            if n.block < 0:
+                raise RuntimeError("acquire of spilled radix node")
             n.refs += 1
             self.pool.ref(n.block)
 
@@ -391,6 +735,10 @@ class RadixCache:
                 raise RuntimeError("release of unpinned radix node")
             n.refs -= 1
             self.pool.unref(n.block)
+            if n.refs == 0:
+                # Last pin gone: the node may be evictable again (the
+                # heap entry that found it pinned was dropped).
+                self._push_evictable(n)
 
     def n_nodes(self) -> int:
         count = 0
@@ -419,42 +767,72 @@ class PrefixStore:
     """
 
     def __init__(self, cfg, block_size: int, n_blocks: int,
-                 pool: Optional[BlockPool] = None):
+                 pool: Optional[BlockPool] = None,
+                 tier: Optional[HostKVTier] = None):
         self.cfg = cfg
         self.block_size = block_size
         self.pool = pool if pool is not None else BlockPool(n_blocks)
-        self.trie = RadixCache(self.pool, block_size)
+        self.tier = tier
+        self.trie = RadixCache(self.pool, block_size, tier=tier)
 
     @property
     def n_blocks(self) -> int:
         return self.pool.n_blocks
 
     def match_for_admission(
-        self, tokens: Sequence[int],
+        self, tokens: Sequence[int], rehydrate=None,
     ) -> Tuple[List[RadixNode], int]:
         """(pinned path, matched token count) for a prompt about to be
         admitted. The path arrives ALREADY acquired — the caller owns a
-        release, whatever retirement path the request takes."""
-        path = self.trie.match(tokens)
+        release, whatever retirement path the request takes.
+
+        With a host tier and a ``rehydrate(spilled_nodes) -> restored``
+        callback, the walk continues through spilled nodes: the
+        resident head is pinned FIRST (the callback's own allocations
+        may trigger eviction, which must not reclaim the prefix the
+        request is about to read), then the callback installs host
+        bytes back into pool pages, pinning each node as it lands, and
+        returns how many it restored — the usable path is the resident
+        head plus that restored run. Without a tier or callback the
+        resident-only behavior is unchanged."""
+        if self.tier is None or rehydrate is None:
+            path = self.trie.match(tokens)
+            while path and len(path) * self.block_size >= len(tokens):
+                path.pop()                # leave >= 1 token to prefill
+            self.trie.acquire(path)
+            return path, len(path) * self.block_size
+        path = self.trie.match_tiered(tokens)
         while path and len(path) * self.block_size >= len(tokens):
             path.pop()                    # leave >= 1 token to prefill
-        self.trie.acquire(path)
-        return path, len(path) * self.block_size
+        split = next(
+            (j for j, n in enumerate(path) if n.block < 0), len(path))
+        resident, spilled = path[:split], path[split:]
+        self.trie.acquire(resident)
+        if spilled:
+            restored = rehydrate(spilled)
+            resident = resident + spilled[:restored]
+        return resident, len(resident) * self.block_size
 
     def release(self, path: Sequence[RadixNode]) -> None:
         self.trie.release(path)
 
     def clear(self) -> None:
         """Drop every cached prefix: the trie's structural hold on each
-        node's page is returned to the (possibly shared) pool and a
-        fresh trie is built. Only safe when no request pins are live —
-        the engine calls this from ``reset()`` after retiring every
-        slot."""
+        resident node's page is returned to the (possibly shared) pool,
+        spilled nodes' host entries are discarded, and a fresh trie is
+        built over a fresh tier. Only safe when no request pins are
+        live — the engine calls this from ``reset()`` after retiring
+        every slot."""
         stack = list(self.trie.root.children.values())
         while stack:
             n = stack.pop()
             stack.extend(n.children.values())
             if n.refs:
                 raise RuntimeError("clear() with live request pins")
-            self.pool.unref(n.block)
-        self.trie = RadixCache(self.pool, self.block_size)
+            if n.block >= 0:
+                self.pool.unref(n.block)
+            elif self.tier is not None:
+                self.tier.discard(n.host_handle)
+        if self.tier is not None:
+            self.tier = HostKVTier(self.tier.budget_bytes)
+        self.trie = RadixCache(self.pool, self.block_size, tier=self.tier)

@@ -188,6 +188,19 @@ class ServingStats:
     pages_migrated: int = 0
     migration_bytes: int = 0
     migrated_zero_copy_tokens: int = 0
+    # Tiered KV (docs/serving.md "Tiered KV and fleet-global prefix
+    # pooling"): ``spilled_pages`` counts pool pages handed to the
+    # pinned-host tier on eviction (``spill_bytes`` their payload
+    # bytes), ``rehydrate_hits`` admissions that restored at least one
+    # spilled page instead of re-prefilling (``rehydrate_tokens`` the
+    # prompt tokens those pages covered), and ``host_pages_resident``
+    # the tier's current occupancy — a gauge resynced every step, not a
+    # counter.
+    spilled_pages: int = 0
+    spill_bytes: int = 0
+    rehydrate_hits: int = 0
+    rehydrate_tokens: int = 0
+    host_pages_resident: int = 0
 
     def record(self, completion) -> None:
         self.finished += 1
@@ -265,6 +278,11 @@ class ServingStats:
             "migration_bytes": float(self.migration_bytes),
             "migrated_zero_copy_tokens": float(
                 self.migrated_zero_copy_tokens),
+            "spilled_pages": float(self.spilled_pages),
+            "spill_bytes": float(self.spill_bytes),
+            "rehydrate_hits": float(self.rehydrate_hits),
+            "rehydrate_tokens": float(self.rehydrate_tokens),
+            "host_pages_resident": float(self.host_pages_resident),
             "prefill_compiles": float(self.prefill_compiles),
             "prefill_chunks": float(self.prefill_chunks),
             "admit_cache_size": float(self.admit_cache_size),

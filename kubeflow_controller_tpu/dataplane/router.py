@@ -142,6 +142,7 @@ class FleetRouter:
         eject_after: int = 2,
         readmit_after: int = 2,
         ttft_window: int = 16,
+        prefix_pull: Optional[bool] = None,
         tracer=None,
     ):
         self._clock = clock
@@ -163,6 +164,14 @@ class FleetRouter:
         self.eject_after = eject_after
         self.readmit_after = readmit_after
         self.ttft_window = ttft_window
+        # Fleet-global prefix pooling (docs/serving.md "Tiered KV"):
+        # when a request routes to a replica that does NOT own its
+        # prefix, pull the owner's cached pages into the receiver's
+        # host tier before submit — the admission then rehydrates them
+        # locally instead of re-prefilling. None = auto: on iff the
+        # receiving replica runs a host tier (needs affinity's owner
+        # map either way).
+        self.prefix_pull = prefix_pull
 
         self._replicas: "OrderedDict[str, ReplicaHandle]" = OrderedDict()
         # prefix bytes -> owning replica name, LRU-bounded. Entries may
@@ -190,6 +199,11 @@ class FleetRouter:
         self.affinity_hits = 0
         # Completed prefill->decode handoffs (two-stage fleets).
         self.migrations = 0
+        # Fleet prefix pulls (tiered KV): cross-replica prefix copies
+        # landed in a receiver's host tier, and their page/byte volume.
+        self.prefix_pulls = 0
+        self.prefix_pull_pages = 0
+        self.prefix_pull_bytes = 0
         # Prefix + speculative-decoding + migration accounting folded in
         # from killed/replaced engines so fleet rates and counters
         # survive chaos AND rolling restarts (every engine passes
@@ -202,6 +216,10 @@ class FleetRouter:
         self._retired_migration_bytes = 0
         self._retired_migrated_zero_copy = 0
         self._retired_samples_dropped = 0
+        self._retired_spilled_pages = 0
+        self._retired_spill_bytes = 0
+        self._retired_rehydrate_hits = 0
+        self._retired_rehydrate_tokens = 0
 
     # -- fleet membership --------------------------------------------------
 
@@ -395,12 +413,55 @@ class FleetRouter:
                                  reason=e.reason)
                 tried.add(h.name)
                 continue
+            # Pull BEFORE _record_owner rewrites the map: the pull
+            # needs the previous owner. submit() only queued the
+            # request, so pulled pages land in h's host tier ahead of
+            # its admission — which rehydrates them locally.
+            self._maybe_pull_prefix(h, req)
             self._assigned[rid] = h.name
             self._record_owner(req, h.name)
             if tr is not None:
                 tr.add_span("dispatch", t0, self._clock(),
                             track="router", rid=str(rid),
                             replica=h.name, attempt=attempt)
+            return
+
+    def _maybe_pull_prefix(self, h: ReplicaHandle, req: Request) -> None:
+        """Fleet-global prefix pooling: if another replica owns this
+        request's prefix and ``h`` holds less of it, copy the owner's
+        cached chain into ``h``'s HOST tier (no device work here — the
+        admission rehydrates on hit). Turns N per-replica caches into
+        one pooled cache: a local miss becomes a remote hit anywhere
+        the fleet holds the prefix. Best-effort: any owner staleness or
+        a tier-less receiver just skips the pull."""
+        enabled = self.prefix_pull
+        if enabled is None:
+            enabled = getattr(h.engine, "_host_tier", None) is not None
+        if not enabled or not self.affinity:
+            return
+        if getattr(h.engine, "_host_tier", None) is None:
+            return
+        for key in reversed(self._prefix_keys(req.prompt)):
+            owner = self._owners.get(key)
+            if owner is None or owner == h.name:
+                continue
+            src = self._replicas.get(owner)
+            if src is None:
+                continue
+            local = h.engine.probe_prefix_len(req.prompt)
+            payload = src.engine.export_prefix(req.prompt)
+            if payload is None or payload.n_tokens <= local:
+                return
+            pages = h.engine.admit_prefix_to_tier(payload)
+            if pages:
+                self.prefix_pulls += 1
+                self.prefix_pull_pages += pages
+                self.prefix_pull_bytes += payload.nbytes
+                if self._tracer is not None:
+                    self._tracer.add_event(
+                        "prefix_pull", self._clock(), track="router",
+                        rid=str(req.rid), src=owner, dst=h.name,
+                        pages=pages, bytes=payload.nbytes)
             return
 
     def _park_or_shed(self, rid: int, attempt: int) -> None:
@@ -632,6 +693,10 @@ class FleetRouter:
         self._retired_migrated_zero_copy += (
             engine.stats.migrated_zero_copy_tokens)
         self._retired_samples_dropped += engine.stats.samples_dropped
+        self._retired_spilled_pages += engine.stats.spilled_pages
+        self._retired_spill_bytes += engine.stats.spill_bytes
+        self._retired_rehydrate_hits += engine.stats.rehydrate_hits
+        self._retired_rehydrate_tokens += engine.stats.rehydrate_tokens
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -694,6 +759,32 @@ class FleetRouter:
                 self._retired_migrated_zero_copy + sum(
                     h.engine.stats.migrated_zero_copy_tokens
                     for h in self._replicas.values())),
+            # Tiered KV + fleet-global prefix pooling (live + retired
+            # engine counters, plus the router-side pull volume).
+            "spilled_pages": float(
+                self._retired_spilled_pages + sum(
+                    h.engine.stats.spilled_pages
+                    for h in self._replicas.values())),
+            "spill_bytes": float(
+                self._retired_spill_bytes + sum(
+                    h.engine.stats.spill_bytes
+                    for h in self._replicas.values())),
+            "rehydrate_hits": float(
+                self._retired_rehydrate_hits + sum(
+                    h.engine.stats.rehydrate_hits
+                    for h in self._replicas.values())),
+            "rehydrate_tokens": float(
+                self._retired_rehydrate_tokens + sum(
+                    h.engine.stats.rehydrate_tokens
+                    for h in self._replicas.values())),
+            "host_pages_resident": float(sum(
+                getattr(h.engine, "_host_tier").resident_pages
+                if getattr(h.engine, "_host_tier", None) is not None
+                else 0
+                for h in self._replicas.values())),
+            "prefix_pulls": float(self.prefix_pulls),
+            "prefix_pull_pages": float(self.prefix_pull_pages),
+            "prefix_pull_bytes": float(self.prefix_pull_bytes),
             # Observability counters ride in the fleet JSONL so a
             # postmortem knows whether the trace it is reading is
             # complete (spans_dropped > 0 means the ring wrapped).

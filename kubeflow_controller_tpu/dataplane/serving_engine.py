@@ -424,6 +424,27 @@ class MigrationPayload:
     nbytes: int = 0                   # payload bytes (pages + scales)
 
 
+@dataclass
+class PrefixPayload:
+    """The fleet prefix-pull wire format (docs/serving.md "Tiered KV
+    and fleet-global prefix pooling"): one cached prefix chain, copied
+    out of the owning replica's device pool and/or host tier in raw
+    pool dtype (+ int8 scales) — never requantized, so installing it in
+    another replica's host tier and rehydrating later is bit-identical
+    to a local hit. ``chunks[i]`` is the i-th trie edge's token-chunk
+    key; ``pages_*[:, i]`` its page. All arrays are host numpy."""
+
+    chunks: List[Tuple[int, ...]]     # trie edge keys, root outward
+    pages_k: np.ndarray               # [L, m, bs, KVH, D] pool dtype
+    pages_v: np.ndarray
+    scales_k: Optional[np.ndarray]    # [L, m, bs, KVH] f32 (int8 only)
+    scales_v: Optional[np.ndarray]
+    block_size: int
+    kv_quant: str
+    n_tokens: int                     # chain coverage in tokens
+    nbytes: int                       # payload bytes (pages + scales)
+
+
 class ServingEngine:
     """Continuous-batching decode over a fixed slot pool.
 
@@ -465,6 +486,7 @@ class ServingEngine:
         mesh=None,
         tp_compute: str = "gathered",
         attn_impl: str = "xla",
+        host_kv_mb: float = 0.0,
         tracer: Optional[Tracer] = None,
     ):
         self.cfg = cfg
@@ -624,11 +646,32 @@ class ServingEngine:
                 kv_pool_blocks = n_slots * self._max_blocks
         self._kv_pool_blocks = int(kv_pool_blocks)
         self.pool = kv_blocks.BlockPool(self._kv_pool_blocks)
+        # Host KV tier (docs/serving.md "Tiered KV and fleet-global
+        # prefix pooling"): a byte-budgeted pinned-host LRU beneath the
+        # radix cache. 0 disables it entirely — no tier object exists,
+        # so eviction discards exactly as before (byte-identical path).
+        if host_kv_mb < 0:
+            raise ValueError(
+                f"host_kv_mb must be >= 0 (got {host_kv_mb})")
+        if host_kv_mb > 0 and not prefix_cache:
+            raise ValueError(
+                "host_kv_mb > 0 requires prefix_cache=True (the host "
+                "tier spills radix-cache pages; without the trie there "
+                "is nothing to spill)")
+        self.host_kv_mb = float(host_kv_mb)
+        self._host_tier: Optional[kv_blocks.HostKVTier] = None
+        if host_kv_mb > 0:
+            self._host_tier = kv_blocks.HostKVTier(
+                int(host_kv_mb * (1 << 20)))
+        # Request id attributed to in-flight spills (set around the
+        # admission that triggered the eviction pressure; None for
+        # evictions with no requesting rid).
+        self._spill_rid: Optional[str] = None
         self._prefix_store: Optional[kv_blocks.PrefixStore] = None
         if prefix_cache:
             self._prefix_store = kv_blocks.PrefixStore(
                 cfg, self.block_size, self._kv_pool_blocks,
-                pool=self.pool)
+                pool=self.pool, tier=self._host_tier)
         # Speculative decoding (docs/serving.md "Speculative decoding"):
         # draft K tokens host-side (model-free proposers), verify all
         # K+1 positions in ONE fused forward, commit the longest
@@ -997,10 +1040,17 @@ class ServingEngine:
         # RadixProposer instances hold a reference to the store object,
         # so replacing it would silently detach them.
         self.pool = kv_blocks.BlockPool(self._kv_pool_blocks)
+        if self._host_tier is not None:
+            # Fresh tier: spilled pages belong to the pool state being
+            # dropped, so they drop with it.
+            self._host_tier = kv_blocks.HostKVTier(
+                self._host_tier.budget_bytes)
+        self._spill_rid = None
         if self._prefix_store is not None:
             self._prefix_store.pool = self.pool
+            self._prefix_store.tier = self._host_tier
             self._prefix_store.trie = kv_blocks.RadixCache(
-                self.pool, self.block_size)
+                self.pool, self.block_size, tier=self._host_tier)
         self._tables = np.full(
             (self.n_slots, self._max_blocks), self._kv_pool_blocks,
             np.int32)
@@ -1397,10 +1447,161 @@ class ServingEngine:
         bid = self.pool.alloc()
         while bid is None:
             if (self._prefix_store is None
-                    or self._prefix_store.trie.evict_one() is None):
+                    or self._prefix_store.trie.evict_one(
+                        spill=self._spill_cb()) is None):
                 return None
             bid = self.pool.alloc()
         return bid
+
+    def _spill_cb(self):
+        """The eviction spill callback, or None with the tier off (the
+        tier-off path is then bit-for-bit the pre-tier discard)."""
+        return self._spill_nodes if self._host_tier is not None else None
+
+    def _spill_nodes(self, nodes: List) -> List[bool]:
+        """Stage the victim nodes' pool pages into the host tier.
+
+        One synchronous ``gather_pool_pages`` for the whole wave (the
+        device bytes are on the host before the caller frees the pool
+        pages), then one tier entry per page — raw pool dtype + scales,
+        never requantized, so a later rehydrate is bit-invisible.
+        Returns the per-node keep decisions; ``False`` (tier refused:
+        single page over budget, i.e. budget ~0) falls back to discard.
+        """
+        tier = self._host_tier
+        assert tier is not None
+        t0 = self._clock()
+        pk, pv, sk, sv = gen.gather_pool_pages(
+            self.cache, [n.block for n in nodes])
+        keep: List[bool] = []
+        pages = 0
+        nbytes = 0
+        for j, node in enumerate(nodes):
+            payload = (
+                pk[:, j:j + 1].copy(), pv[:, j:j + 1].copy(),
+                None if sk is None else sk[:, j:j + 1].copy(),
+                None if sv is None else sv[:, j:j + 1].copy(),
+            )
+            h = tier.put(payload)
+            if h is None:
+                keep.append(False)
+                continue
+            node.host_handle = h
+            keep.append(True)
+            pages += 1
+            nbytes += kv_blocks.HostKVTier.payload_nbytes(payload)
+        self.stats.spilled_pages += pages
+        self.stats.spill_bytes += nbytes
+        reg = registry()
+        reg.counter("kv_spilled_pages", "dataplane").inc(pages)
+        reg.counter("kv_spill_bytes", "dataplane").inc(nbytes)
+        if self._tracer is not None and pages:
+            span = {"pages": pages, "bytes": nbytes}
+            if self._spill_rid is not None:
+                span["rid"] = self._spill_rid
+            self._tracer.add_span(
+                "kv_spill", t0, self._clock(), **span)
+        return keep
+
+    def _reserve_blocks(self, n: int) -> Optional[List[int]]:
+        """Reserve ``n`` pool pages, evicting (and spilling, tier on)
+        cold prefix chains in BATCH — one ``evict_chain`` call per
+        deficit instead of one full-tree rescan per page. Returns the
+        owned page ids, or None (every page unwound) if the pool cannot
+        cover the request even after eviction."""
+        owned: List[int] = []
+        while len(owned) < n:
+            bid = self.pool.alloc()
+            if bid is not None:
+                owned.append(bid)
+                continue
+            if (self._prefix_store is None
+                    or not self._prefix_store.trie.evict_chain(
+                        n - len(owned), spill=self._spill_cb())):
+                for b in owned:
+                    self.pool.unref(b)
+                return None
+        return owned
+
+    def _rehydrate_nodes(self, nodes: List, rid) -> int:
+        """Install spilled nodes' host pages back into the pool —
+        the ``match_for_admission`` rehydrate callback.
+
+        Payloads are popped off the tier FIRST (so eviction pressure
+        from our own page allocations below cannot LRU-drop them
+        mid-restore), then pool pages are allocated (spilling other
+        victims as needed), then ONE batched ``install_pool_pages``
+        writes the raw bytes back — never requantized, so greedy and
+        seeded streams are bit-identical to never having spilled.
+        Each restored node is re-marked resident and pinned for the
+        admitting request. Returns how many leading nodes of ``nodes``
+        were restored (a prefix; the remainder was pruned or re-spilled
+        and the caller prefills those tokens)."""
+        tier = self._host_tier
+        if tier is None or not nodes:
+            return 0
+        trie = self._prefix_store.trie
+        t0 = self._clock()
+        payloads: List[tuple] = []
+        usable: List = []
+        for node in nodes:
+            payload = tier.pop(node.host_handle)
+            if payload is None:
+                # Handle died since the match walk (shouldn't happen —
+                # nothing touches the tier between walk and pop — but a
+                # dead handle must never rehydrate garbage).
+                trie.prune_subtree(node)
+                break
+            payloads.append(payload)
+            usable.append(node)
+        # Allocate the whole restore span in BATCH: one evict_chain
+        # call per deficit (one spill wave + gather), not one
+        # single-victim wave per page.
+        bids: List[int] = []
+        while len(bids) < len(usable):
+            bid = self.pool.alloc()
+            if bid is not None:
+                bids.append(bid)
+                continue
+            if not trie.evict_chain(len(usable) - len(bids),
+                                    spill=self._spill_cb()):
+                # Pool exhausted mid-restore: stash the un-restored
+                # tail back in the tier under fresh handles and keep
+                # what fit.
+                j = len(bids)
+                for node2, payload2 in zip(usable[j:], payloads[j:]):
+                    h = tier.put(payload2)
+                    if h is None:
+                        trie.prune_subtree(node2)
+                        break
+                    node2.host_handle = h
+                usable = usable[:j]
+                payloads = payloads[:j]
+                break
+        if not bids:
+            return 0
+        pk = np.concatenate([p[0] for p in payloads], axis=1)
+        pv = np.concatenate([p[1] for p in payloads], axis=1)
+        sk = (None if payloads[0][2] is None
+              else np.concatenate([p[2] for p in payloads], axis=1))
+        sv = (None if payloads[0][3] is None
+              else np.concatenate([p[3] for p in payloads], axis=1))
+        self.cache = gen.install_pool_pages(
+            self.cache, pk, pv, sk, sv, bids, mesh=self._mesh)
+        for node, bid in zip(usable, bids):
+            trie.rehydrated(node, bid)
+        trie.acquire(usable)
+        tokens = len(bids) * self.block_size
+        self.stats.rehydrate_hits += 1
+        self.stats.rehydrate_tokens += tokens
+        reg = registry()
+        reg.counter("kv_rehydrate_hits", "dataplane").inc()
+        reg.counter("kv_rehydrate_tokens", "dataplane").inc(tokens)
+        if self._tracer is not None:
+            self._tracer.add_span(
+                "kv_rehydrate", t0, self._clock(),
+                rid=str(rid), pages=len(bids), tokens=tokens)
+        return len(usable)
 
     def _free_owned(self, slot: _Slot) -> None:
         """Return the slot's still-owned pages to the pool (pages a
@@ -1606,28 +1807,36 @@ class ServingEngine:
             matched = 0
             if (self.prefill_mode != "exact"
                     and self._prefix_store is not None):
-                path, matched = (
-                    self._prefix_store.match_for_admission(req.prompt))
+                rehydrate = None
+                if self._host_tier is not None:
+                    rid_ = req.rid
+                    rehydrate = (
+                        lambda nodes: self._rehydrate_nodes(nodes, rid_))
+                self._spill_rid = str(req.rid)
+                rt0 = self.stats.rehydrate_tokens
+                path, matched = self._prefix_store.match_for_admission(
+                    req.prompt, rehydrate=rehydrate)
+                self._spill_rid = None
                 self.stats.prefix_lookup_tokens += req.prompt.size
                 self.stats.prefix_hit_tokens += matched
-                self.stats.prefix_zero_copy_tokens += matched
+                # Rehydrated pages moved host->device bytes, so only the
+                # resident share of the hit is zero-copy.
+                self.stats.prefix_zero_copy_tokens += matched - (
+                    self.stats.rehydrate_tokens - rt0)
             needed = self._blocks_needed(
                 req.prompt.size,
                 0 if req.prefill_only else req.max_new_tokens)
-            owned: List[int] = []
-            while len(path) + len(owned) < needed:
-                bid = self._alloc_block()
-                if bid is None:
-                    # Reservation unmet: unwind and requeue at the HEAD
-                    # (FIFO order is a fairness contract) — retirements
-                    # will refill the free list.
-                    for b in owned:
-                        self.pool.unref(b)
-                    if path:
-                        self._prefix_store.release(path)
-                    self.queue.appendleft(q)
-                    return
-                owned.append(bid)
+            self._spill_rid = str(req.rid)
+            owned = self._reserve_blocks(needed - len(path))
+            self._spill_rid = None
+            if owned is None:
+                # Reservation unmet: unwind and requeue at the HEAD
+                # (FIFO order is a fairness contract) — retirements
+                # will refill the free list.
+                if path:
+                    self._prefix_store.release(path)
+                self.queue.appendleft(q)
+                return
             row = self._tables[slot]
             row[:] = self._kv_pool_blocks
             row[:len(path)] = [n.block for n in path]
@@ -2107,14 +2316,11 @@ class ServingEngine:
                                          payload.max_new_tokens)
             if needed > self._kv_pool_blocks:
                 raise Rejected(payload.rid, "pool_too_small")
-            owned: List[int] = []
-            while len(path) + len(owned) < needed:
-                bid = self._alloc_block()
-                if bid is None:
-                    for b in owned:
-                        self.pool.unref(b)
-                    raise Rejected(payload.rid, "no_pages")
-                owned.append(bid)
+            self._spill_rid = str(payload.rid)
+            owned = self._reserve_blocks(needed - len(path))
+            self._spill_rid = None
+            if owned is None:
+                raise Rejected(payload.rid, "no_pages")
         except BaseException:
             self.release_probe(path)
             raise
@@ -2208,6 +2414,121 @@ class ServingEngine:
                 "migrate_install", t0, now, rid=str(payload.rid),
                 slot=slot_idx, pages=len(dst_ids),
                 zero_copy_tokens=int(payload.skip_tokens))
+
+    # -- fleet-global prefix pooling (tiered KV) -------------------------
+
+    def probe_prefix_len(self, prompt) -> int:
+        """Tokens of ``prompt`` this engine holds in EITHER tier
+        (device trie + host spill). The router's pull path compares
+        this against a remote owner's holding to decide whether a
+        cross-replica prefix pull is worth the bytes. Read-only apart
+        from LRU touches."""
+        if self._prefix_store is None:
+            return 0
+        toks = np.asarray(prompt, np.int32).reshape(-1)
+        trie = self._prefix_store.trie
+        path = (trie.match_tiered(toks) if self._host_tier is not None
+                else trie.match(toks))
+        return len(path) * self.block_size
+
+    def export_prefix(self, prompt) -> Optional["PrefixPayload"]:
+        """Exporter-side half of a fleet prefix pull: copy the longest
+        cached chain matching ``prompt`` — resident pages via one
+        ``gather_pool_pages``, spilled pages straight out of the host
+        tier — into a :class:`PrefixPayload`. Raw pool dtype + scales
+        throughout (never requantized), so the receiving replica's
+        later rehydrate is bit-identical to a local hit. Nothing is
+        pinned or freed here: the payload is a snapshot copy."""
+        if self._prefix_store is None:
+            return None
+        toks = np.asarray(prompt, np.int32).reshape(-1)
+        trie = self._prefix_store.trie
+        path = (trie.match_tiered(toks) if self._host_tier is not None
+                else trie.match(toks))
+        if not path:
+            return None
+        resident = [n for n in path if n.block >= 0]
+        spilled = [n for n in path if n.block < 0]
+        parts_k: List[np.ndarray] = []
+        parts_v: List[np.ndarray] = []
+        parts_sk: List[np.ndarray] = []
+        parts_sv: List[np.ndarray] = []
+        if resident:
+            rk, rv, rsk, rsv = gen.gather_pool_pages(
+                self.cache, [n.block for n in resident])
+            parts_k.append(rk)
+            parts_v.append(rv)
+            if rsk is not None:
+                parts_sk.append(rsk)
+                parts_sv.append(rsv)
+        for n in spilled:
+            hk, hv, hsk, hsv = self._host_tier.get(n.host_handle)
+            parts_k.append(hk)
+            parts_v.append(hv)
+            if hsk is not None:
+                parts_sk.append(hsk)
+                parts_sv.append(hsv)
+        pk = np.concatenate(parts_k, axis=1)
+        pv = np.concatenate(parts_v, axis=1)
+        sk = np.concatenate(parts_sk, axis=1) if parts_sk else None
+        sv = np.concatenate(parts_sv, axis=1) if parts_sv else None
+        nbytes = int(pk.nbytes + pv.nbytes
+                     + (0 if sk is None else sk.nbytes + sv.nbytes))
+        return PrefixPayload(
+            chunks=[n.key for n in path],
+            pages_k=pk, pages_v=pv, scales_k=sk, scales_v=sv,
+            block_size=self.block_size, kv_quant=self.kv_quant,
+            n_tokens=len(path) * self.block_size, nbytes=nbytes,
+        )
+
+    def admit_prefix_to_tier(self, payload: "PrefixPayload") -> int:
+        """Receiver-side half of a fleet prefix pull: land the pulled
+        pages in THIS replica's HOST tier as SPILLED trie nodes — no
+        device work at pull time; the next admission that hits the
+        chain rehydrates it through the normal spill/restore path, so
+        a pull costs host RAM until the prefix is actually used.
+        Chunks this trie already holds (resident, or spilled with a
+        live handle) are skipped. Returns pages admitted."""
+        if self._prefix_store is None or self._host_tier is None:
+            return 0
+        if payload.block_size != self.block_size:
+            raise ValueError(
+                f"prefix pull: block_size {payload.block_size} != "
+                f"engine {self.block_size}")
+        if payload.kv_quant != self.kv_quant:
+            raise ValueError(
+                f"prefix pull: kv_quant {payload.kv_quant!r} != "
+                f"engine {self.kv_quant!r}")
+        tier = self._host_tier
+        trie = self._prefix_store.trie
+        node = trie.root
+        admitted = 0
+        for j, key in enumerate(payload.chunks):
+            child = node.children.get(key)
+            if child is not None and (
+                    child.block >= 0 or tier.has(child.host_handle)):
+                node = child      # already held here — pointer, no copy
+                continue
+            page = (
+                payload.pages_k[:, j:j + 1].copy(),
+                payload.pages_v[:, j:j + 1].copy(),
+                None if payload.scales_k is None
+                else payload.scales_k[:, j:j + 1].copy(),
+                None if payload.scales_v is None
+                else payload.scales_v[:, j:j + 1].copy(),
+            )
+            h = tier.put(page)
+            if h is None:
+                break             # tier too small for even one page
+            if child is None:
+                child = kv_blocks.RadixNode(
+                    key=key, block=-1, parent=node, host_handle=h)
+                node.children[key] = child
+            else:
+                child.host_handle = h    # revive a dead spilled handle
+            admitted += 1
+            node = child
+        return admitted
 
     @property
     def n_active(self) -> int:
@@ -2790,6 +3111,20 @@ class ServingEngine:
             self.stats.migration_bytes)
         reg.gauge("migrated_zero_copy_tokens", "serving").set(
             self.stats.migrated_zero_copy_tokens)
+        # Tiered-KV gauges: occupancy is read off the tier each quantum
+        # (spill/rehydrate counters are cumulative in stats already).
+        self.stats.host_pages_resident = (
+            self._host_tier.resident_pages
+            if self._host_tier is not None else 0)
+        reg.gauge("spilled_pages", "serving").set(
+            self.stats.spilled_pages)
+        reg.gauge("spill_bytes", "serving").set(self.stats.spill_bytes)
+        reg.gauge("rehydrate_hits", "serving").set(
+            self.stats.rehydrate_hits)
+        reg.gauge("rehydrate_tokens", "serving").set(
+            self.stats.rehydrate_tokens)
+        reg.gauge("host_pages_resident", "serving").set(
+            self.stats.host_pages_resident)
         # Analytic per-step traffic (satellite of the compute-parallel
         # PR): published under dataplane.* so tp_bench and fleet
         # dashboards read measured-model traffic next to tokens/sec.

@@ -2555,6 +2555,21 @@ def gather_pool_pages(
             None if vs is None else np.asarray(vs)[:, :n])
 
 
+def pool_page_host_bytes(cache: PagedKVCache) -> int:
+    """Host bytes one pool page occupies when staged off-device
+    (``gather_pool_pages`` payload: K + V pages in storage dtype, plus
+    fp32 scales for quantized pools). The sizing primitive for the
+    tiered-KV host store: ``HostKVTier`` budgets in these units, and a
+    ``--host-kv-mb`` budget admits ``budget // pool_page_host_bytes``
+    spilled pages."""
+    L = cache.k.shape[0]
+    per = int(np.prod(cache.k.shape[2:])) * cache.k.dtype.itemsize
+    n = 2 * L * per                              # K + V
+    if cache.k_scale is not None:
+        n += 2 * L * int(np.prod(cache.k_scale.shape[2:])) * 4
+    return n
+
+
 def _install_pages_impl(pool_k, pool_v, k_scale, v_scale,
                         pg_k, pg_v, pg_ks, pg_vs, dst, tp_shards=1):
     # Raw byte install: the payload is already in the pool's storage

@@ -30,7 +30,7 @@ import numpy as np
 import pytest
 
 from kubeflow_controller_tpu.dataplane.kv_blocks import (
-    BlockPool, PrefixStore, RadixCache,
+    BlockPool, HostKVTier, PrefixStore, RadixCache,
 )
 from kubeflow_controller_tpu.dataplane.serving_engine import (
     Request, ServingEngine,
@@ -182,6 +182,129 @@ def test_trie_random_ops_preserve_refcount_invariant():
         assert pool.used_blocks == n_live
     for path in held:
         trie.release(path)
+
+
+def _fake_payload(node):
+    """Stand-in for gather_pool_pages output: one tiny page keyed by the
+    node's block id so a rehydrated payload is distinguishable."""
+    page = np.full((1, 1, 2, 1), node.block % 127, np.int8)
+    return (page, page.copy(), None, None)
+
+
+def _fake_spill(tier):
+    def spill(wave):
+        keep = []
+        for n in wave:
+            h = tier.put(_fake_payload(n))
+            if h is None:
+                keep.append(False)
+                continue
+            n.host_handle = h
+            keep.append(True)
+        return keep
+    return spill
+
+
+def _sweep_tiers(pool, trie, tier):
+    """Tiered invariant sweep: resident nodes obey the refcount rule and
+    alias no pages; spilled nodes are pin-free, hold no pool page, and
+    shadow no resident descendant; every tier entry is referenced by
+    exactly one spilled node (no cross-tier aliasing, no tier leaks)."""
+    seen_pages = set()
+    n_resident = 0
+    live_handles = []
+    stack = list(trie.root.children.values())
+    while stack:
+        n = stack.pop()
+        if n.block >= 0:
+            assert n.host_handle is None, "node in both tiers"
+            assert n.block not in seen_pages, "page aliased across nodes"
+            seen_pages.add(n.block)
+            assert pool.refcount(n.block) == 1 + n.refs
+            n_resident += 1
+        else:
+            assert n.refs == 0, "spilled node carries a pin"
+            assert n.host_handle is not None
+            if tier.has(n.host_handle):
+                live_handles.append(n.host_handle)
+            for c in n.children.values():
+                assert c.block < 0, "resident node below a spilled one"
+        stack.extend(n.children.values())
+    assert pool.used_blocks == n_resident
+    assert len(live_handles) == len(set(live_handles)), (
+        "tier handle aliased across nodes")
+    assert tier.resident_pages == len(live_handles), "tier entry leaked"
+
+
+def test_trie_random_ops_across_tiers_preserve_invariants():
+    """The 300-op refcount soup, extended across tiers: random inserts,
+    pins, releases, spilling evictions (single and batch), tiered
+    matches, and rehydrates against a tier whose budget holds only ~6
+    pages — so tier-side LRU drops (dead handles) happen too. After
+    every op the tiered invariant sweep must hold, and at the end BOTH
+    tiers must drain to zero pages."""
+    rng = np.random.default_rng(0)
+    pool = BlockPool(12)
+    tier = HostKVTier(6 * 4)            # _fake_payload is 4 B -> 6 pages
+    trie = RadixCache(pool, block_size=2, tier=tier)
+    spill = _fake_spill(tier)
+    held = []
+
+    def alloc():
+        bid = pool.alloc()
+        while bid is None:
+            if trie.evict_one(spill=spill) is None:
+                return None
+            bid = pool.alloc()
+        return bid
+
+    for _ in range(300):
+        op = rng.integers(0, 6)
+        if op == 0:
+            toks = rng.integers(0, 4, size=rng.integers(2, 9))
+            path, _ = trie.insert(_toks(toks))
+            if path and rng.integers(0, 2):
+                trie.acquire(path)
+                held.append(path)
+        elif op == 1 and held:
+            trie.release(held.pop(rng.integers(0, len(held))))
+        elif op == 2:
+            trie.evict_one(spill=spill)
+        elif op == 3:
+            trie.evict_chain(int(rng.integers(1, 5)), spill=spill)
+        elif op == 4:
+            toks = rng.integers(0, 4, size=rng.integers(2, 9))
+            trie.match_tiered(_toks(toks))
+        else:
+            # Rehydrate whatever a random tiered match surfaces.
+            toks = rng.integers(0, 4, size=rng.integers(2, 9))
+            path = trie.match_tiered(_toks(toks))
+            for n in [m for m in path if m.block < 0]:
+                payload = tier.pop(n.host_handle)
+                if payload is None:
+                    trie.prune_subtree(n)
+                    break
+                bid = alloc()
+                if bid is None:
+                    h = tier.put(payload)
+                    if h is None:
+                        trie.prune_subtree(n)
+                    else:
+                        n.host_handle = h
+                    break
+                trie.rehydrated(n, bid)
+        _sweep_tiers(pool, trie, tier)
+    # Drain: release every pin, evict everything (spilling), then prune
+    # the all-spilled trie — both tiers must reach zero pages.
+    for path in held:
+        trie.release(path)
+    while trie.evict_chain(pool.used_blocks or 1, spill=spill):
+        pass
+    for child in list(trie.root.children.values()):
+        trie.prune_subtree(child)
+    assert pool.used_blocks == 0, "device tier leaked pages"
+    assert tier.resident_pages == 0, "host tier leaked pages"
+    assert tier.resident_bytes == 0
 
 
 def test_pool_owner_guard_raises_on_non_owner_release():

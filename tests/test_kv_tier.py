@@ -1,0 +1,391 @@
+"""Tiered KV: host-RAM spill for the radix cache + fleet-global prefix
+pooling (docs/serving.md "Tiered KV and fleet-global prefix pooling").
+
+The contract under test, in three layers:
+
+1. **HostKVTier unit properties**: byte-budgeted LRU with move
+   semantics — a popped payload leaves the tier (no aliasing), puts
+   evict cold entries to fit, a single page over budget is refused.
+
+2. **Bit-identity across spill -> rehydrate**: greedy, sampled/seeded,
+   and int8 streams from a tier-on engine are BIT-IDENTICAL to the
+   tier-off engine on a workload whose prefix working set exceeds the
+   device pool (so spills and rehydrates provably happened). This holds
+   by construction — pages spill and rehydrate as raw storage bytes,
+   never requantized — and these tests are the tripwire.
+   ``host_kv_mb=0`` builds no tier object at all: that engine runs the
+   pre-tier discard path byte-for-byte.
+
+3. **Lifecycle / fleet**: cancel + deadline + drain retire paths leave
+   no pin on either tier and both tiers drain to zero; a fleet request
+   routed to a replica that misses locally pulls the owner's prefix
+   into its HOST tier and rehydrates it on admission
+   (``rehydrate_hits > 0`` without re-prefilling).
+"""
+
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_controller_tpu.dataplane.kv_blocks import HostKVTier
+from kubeflow_controller_tpu.dataplane.router import FleetRouter
+from kubeflow_controller_tpu.dataplane.sampling import SamplingParams
+from kubeflow_controller_tpu.dataplane.serving_engine import (
+    Request, ServingEngine,
+)
+from kubeflow_controller_tpu.models import generate as gen
+from kubeflow_controller_tpu.models import transformer as tfm
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tfm.tiny_config()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return gen.inference_params(cfg, tfm.init_params(cfg, jax.random.key(0)))
+
+
+# -- HostKVTier unit properties -------------------------------------------
+
+
+def _page(fill, nbytes=8):
+    arr = np.full((1, 1, nbytes // 2, 1), fill, np.int8)
+    return (arr, arr.copy(), None, None)
+
+
+def test_host_tier_lru_budget_and_move_semantics():
+    tier = HostKVTier(3 * 8)                  # 3 pages of 8 B
+    h1 = tier.put(_page(1))
+    h2 = tier.put(_page(2))
+    h3 = tier.put(_page(3))
+    assert tier.resident_pages == 3
+    tier.touch(h1)                            # h2 is now coldest
+    h4 = tier.put(_page(4))                   # evicts h2
+    assert tier.has(h1) and tier.has(h3) and tier.has(h4)
+    assert not tier.has(h2)
+    assert tier.evicted_pages == 1
+    # pop moves the payload OUT: the handle dies with the entry.
+    payload = tier.pop(h3)
+    assert payload is not None and payload[0][0, 0, 0, 0] == 3
+    assert not tier.has(h3)
+    assert tier.pop(h3) is None
+    assert tier.resident_pages == 2
+    # get peeks without removing (fleet export path).
+    assert tier.get(h1)[0][0, 0, 0, 0] == 1
+    assert tier.has(h1)
+    tier.discard(h1)
+    tier.discard(h4)
+    assert tier.resident_pages == 0 and tier.resident_bytes == 0
+
+
+def test_host_tier_refuses_oversized_page_and_zero_budget():
+    tier = HostKVTier(8)
+    assert tier.put(_page(1, nbytes=32)) is None    # single page > budget
+    assert HostKVTier(0).put(_page(1)) is None      # budget 0: always no
+    assert tier.has(None) is False                  # None-handle safe
+
+
+# -- bit-identity across spill -> rehydrate -------------------------------
+
+
+def _cycling_requests(cfg, families=4, waves=3, seed=7, params_fn=None):
+    """Prefix working set >> device pool: ``families`` 16-token shared
+    prefixes revisited across ``waves`` — between visits a family's
+    chain must be evicted (pool holds ~2 slots of 6 pages + scraps), so
+    tier-on runs provably spill AND rehydrate."""
+    rng = np.random.default_rng(3)
+    fams = [rng.integers(0, cfg.vocab_size, 16) for _ in range(families)]
+    r2 = np.random.default_rng(seed)
+    out, rid = [], 0
+    for _ in range(waves):
+        for f in fams:
+            tail = r2.integers(0, cfg.vocab_size, 1 + rid % 4)
+            out.append(Request(
+                rid=rid,
+                prompt=np.concatenate([f, tail]).astype(np.int32),
+                max_new_tokens=4,
+                params=params_fn(rid) if params_fn else None,
+            ))
+            rid += 1
+    return out
+
+
+_TIER_KW = dict(n_slots=2, max_seq=32, prefill_mode="bucketed",
+                block_size=4, prefix_cache=True, kv_pool_blocks=12)
+
+
+def _run(cfg, params, reqs, **kw):
+    eng = ServingEngine(cfg, params, **kw)
+    comps = eng.run(list(reqs))
+    return {(c.rid, c.gen): list(c.tokens) for c in comps}, eng
+
+
+def _assert_tier_exercised(eng):
+    assert eng.stats.spilled_pages > 0, "workload never spilled"
+    assert eng.stats.rehydrate_hits > 0, "workload never rehydrated"
+    assert eng.stats.rehydrate_tokens > 0
+    assert eng.stats.spill_bytes > 0
+
+
+@pytest.fixture(scope="module")
+def greedy_baseline(cfg, params):
+    """The tier-off greedy run on the canonical cycling workload —
+    shared by every test that compares against it."""
+    return _run(cfg, params, _cycling_requests(cfg), **_TIER_KW)
+
+
+def test_greedy_bit_identical_tier_on_vs_off(cfg, params, greedy_baseline):
+    off, _ = greedy_baseline
+    on, eng = _run(cfg, params, _cycling_requests(cfg),
+                   host_kv_mb=64.0, **_TIER_KW)
+    assert on == off
+    _assert_tier_exercised(eng)
+    # Rehydrated tokens moved bytes: they must NOT be counted zero-copy.
+    assert (eng.stats.prefix_zero_copy_tokens
+            <= eng.stats.prefix_hit_tokens - eng.stats.rehydrate_tokens)
+
+
+@pytest.mark.slow
+def test_sampled_seeded_bit_identical_tier_on_vs_off(cfg, params):
+    """Sampled variant of the greedy tripwire above (kept out of tier-1
+    by the slow marker — the rehydrate path is mode-blind, so the
+    greedy test is the representative)."""
+    sp = lambda rid: SamplingParams(temperature=0.9, top_k=8, top_p=0.9,
+                                    seed=100 + rid)
+    reqs = _cycling_requests(cfg, params_fn=sp)
+    off, _ = _run(cfg, params, reqs, **_TIER_KW)
+    on, eng = _run(cfg, params, _cycling_requests(cfg, params_fn=sp),
+                   host_kv_mb=64.0, **_TIER_KW)
+    assert on == off
+    _assert_tier_exercised(eng)
+
+
+@pytest.mark.slow
+def test_int8_bit_identical_tier_on_vs_off(cfg, params):
+    """int8 pages spill and rehydrate as raw int8 + scales — never
+    requantized — so quantized streams survive the round trip bitwise.
+    (Kept out of tier-1 by the slow marker; the greedy fp test is the
+    representative tripwire.)"""
+    reqs = _cycling_requests(cfg)
+    off, _ = _run(cfg, params, reqs, kv_quant="int8", **_TIER_KW)
+    on, eng = _run(cfg, params, _cycling_requests(cfg),
+                   kv_quant="int8", host_kv_mb=64.0, **_TIER_KW)
+    assert on == off
+    _assert_tier_exercised(eng)
+
+
+def test_host_kv_mb_zero_is_byte_identical_to_no_tier(
+        cfg, params, greedy_baseline):
+    """0 disables the tier entirely: no HostKVTier object, spill=None on
+    every eviction, zero tier stats — today's discard-on-evict engine."""
+    base, eng0 = greedy_baseline
+    zero, engz = _run(cfg, params, _cycling_requests(cfg),
+                      host_kv_mb=0.0, **_TIER_KW)
+    assert zero == base
+    assert engz._host_tier is None
+    assert engz.stats.spilled_pages == 0
+    assert engz.stats.rehydrate_hits == 0
+    assert engz.stats.host_pages_resident == 0
+    # Identical pool trajectories, not merely identical streams.
+    assert engz.stats.pool_blocks_in_use == eng0.stats.pool_blocks_in_use
+
+
+def test_host_kv_mb_requires_prefix_cache(cfg, params):
+    with pytest.raises(ValueError, match="prefix_cache"):
+        ServingEngine(cfg, params, prefill_mode="bucketed", block_size=4,
+                      host_kv_mb=16.0)
+    with pytest.raises(ValueError, match=">= 0"):
+        ServingEngine(cfg, params, prefill_mode="bucketed", block_size=4,
+                      prefix_cache=True, host_kv_mb=-1.0)
+
+
+# -- lifecycle: both tiers drain through every retire path ----------------
+
+
+def _assert_both_tiers_clean(eng):
+    """Post-churn sweep: resident nodes carry only the trie hold,
+    spilled nodes are pin-free and never hold a pool page, and every
+    host-tier entry belongs to exactly one spilled node."""
+    store = eng._prefix_store
+    tier = eng._host_tier
+    live_handles = []
+    n_resident = 0
+    stack = list(store.trie.root.children.values())
+    while stack:
+        n = stack.pop()
+        if n.block >= 0:
+            n_resident += 1
+            assert n.host_handle is None, "node in both tiers"
+            assert n.refs == 0, "request pin leaked past retirement"
+            assert store.pool.refcount(n.block) == 1
+        else:
+            assert n.refs == 0, "spilled node carries a pin"
+            if tier.has(n.host_handle):
+                live_handles.append(n.host_handle)
+        stack.extend(n.children.values())
+    assert store.pool.used_blocks == n_resident
+    assert len(live_handles) == len(set(live_handles))
+    assert tier.resident_pages == len(live_handles), "host tier leaked"
+
+
+def test_cancel_deadline_drain_drain_both_tiers(cfg, params):
+    """The engine-level refcount soup across tiers: cycling prefix
+    pressure (spills + rehydrates) with cancels, a deadline expiry, and
+    a forced drain. No retire path may leak a pin on either tier, and a
+    full eviction sweep afterwards drains BOTH tiers to zero."""
+    clock_t = [0.0]
+    eng = ServingEngine(cfg, params, clock=lambda: clock_t[0],
+                        host_kv_mb=64.0, **_TIER_KW)
+    reqs = _cycling_requests(cfg)
+    reqs[5].deadline_s = 0.5
+    for r in reqs:
+        eng.submit(r)
+    comps = []
+    # Churn until the tier has been exercised in BOTH directions, so
+    # the cancels/deadline/drain below retire requests that actually
+    # hold rehydrated pins (cap: the full run takes far fewer steps).
+    for _ in range(400):
+        clock_t[0] += 0.01
+        comps.extend(eng.step())
+        if eng.stats.rehydrate_hits > 0 and len(comps) >= 6:
+            break
+    eng.cancel(7)                        # in flight or already done
+    eng.cancel(11)                       # likely still queued
+    for _ in range(3):
+        clock_t[0] += 0.01
+        comps.extend(eng.step())
+    clock_t[0] += 2.0                    # rid 5's deadline passes
+    comps.extend(eng.step())
+    comps.extend(eng.drain(grace_s=0.0))
+    assert {c.rid for c in comps} == {r.rid for r in reqs}
+    _assert_tier_exercised(eng)
+    _assert_both_tiers_clean(eng)
+    # Kill the cache: evict everything (spilling), then clear — the
+    # tier rebuild must leave zero pages on both tiers.
+    trie = eng._prefix_store.trie
+    while trie.evict_chain(8, spill=eng._spill_cb()):
+        pass
+    assert eng.pool.used_blocks == 0, "device tier leaked pages"
+    eng._prefix_store.clear()
+    assert eng._prefix_store.tier.resident_pages == 0, "host tier leaked"
+
+
+@pytest.mark.slow
+def test_reset_rebuilds_empty_tier(cfg, params):
+    """reset() rewires both tiers and still serves bit-identically
+    (kept out of tier-1 by the slow marker — three full workload runs)."""
+    eng = ServingEngine(cfg, params, host_kv_mb=64.0, **_TIER_KW)
+    eng.run(_cycling_requests(cfg, waves=2))
+    assert eng._host_tier.resident_pages > 0
+    eng.reset()
+    assert eng._host_tier.resident_pages == 0
+    assert eng._prefix_store.tier is eng._host_tier
+    assert eng._prefix_store.trie.tier is eng._host_tier
+    # The reset engine still serves bit-identically.
+    on = {(c.rid, c.gen): list(c.tokens)
+          for c in eng.run(_cycling_requests(cfg))}
+    off, _ = _run(cfg, params, _cycling_requests(cfg), **_TIER_KW)
+    assert on == off
+
+
+# -- fleet-global prefix pooling ------------------------------------------
+
+
+def test_fleet_pull_turns_local_miss_into_remote_hit(cfg, params):
+    """Replica a owns the shared prefix; a burst overflows a (bounded
+    queue) so the router fails over to b, pulls a's cached chain into
+    b's HOST tier before submit, and b's admission rehydrates it —
+    ``rehydrate_hits > 0`` on a replica that never prefilled the
+    prefix, with the pull volume accounted."""
+    clock_t = [0.0]
+    clock = lambda: clock_t[0]
+
+    def mk():
+        return ServingEngine(cfg, params, clock=clock, max_queue=1,
+                             host_kv_mb=64.0, n_slots=2, max_seq=32,
+                             prefill_mode="bucketed", block_size=4,
+                             prefix_cache=True, kv_pool_blocks=16)
+
+    router = FleetRouter(clock=clock, block_size=4)
+    eng_a, eng_b = mk(), mk()
+    router.add_replica("a", eng_a)
+    router.add_replica("b", eng_b)
+    shared = np.random.default_rng(3).integers(
+        0, cfg.vocab_size, 16).astype(np.int32)
+
+    def req(i):
+        return Request(
+            rid=i, prompt=np.concatenate([shared, [5 + i]]).astype(np.int32),
+            max_new_tokens=4 if i == 0 else 6)
+
+    router.submit(req(0))                # warm the owner
+    for _ in range(200):
+        clock_t[0] += 0.01
+        router.step()
+        if not router.pending:
+            break
+    for i in range(1, 8):                # burst: overflow fails over to b
+        router.submit(req(i))
+    for _ in range(600):
+        clock_t[0] += 0.01
+        router.step()
+        if not router.pending:
+            break
+    assert not router.pending
+    fs = router.fleet_summary()
+    assert fs["completed"] == 8.0
+    assert fs["prefix_pulls"] >= 1
+    assert fs["prefix_pull_pages"] >= 1
+    assert fs["prefix_pull_bytes"] > 0
+    # The pulled replica rehydrated instead of re-prefilling.
+    assert eng_b.stats.rehydrate_hits >= 1
+    assert eng_b.stats.prefix_hit_tokens > 0
+    assert fs["rehydrate_hits"] >= 1     # folded into the fleet JSONL
+    # Zero-copy accounting stays honest fleet-wide: rehydrated tokens
+    # moved bytes and are excluded per engine.
+    for e in (eng_a, eng_b):
+        assert (e.stats.prefix_zero_copy_tokens
+                <= e.stats.prefix_hit_tokens)
+
+
+# -- bench harness contract (tier-1 gate for make bench-kv-tier) ----------
+
+
+def test_kv_tier_bench_contract(cfg, params):
+    """Smoke-contract for benchmarks/kv_tier_bench.py: the harness
+    helpers must keep their shape (bit-identity asserted BEFORE timing,
+    eviction-scan counters exposed, fleet leg pulls + rehydrates) so the
+    checked-in summary stays reproducible. Runs the bench's own
+    helpers on a tiny config — the full gated sweep is `make
+    bench-kv-tier` / the slow-marked smoke below."""
+    sys.path.insert(
+        0, str(Path(__file__).resolve().parent.parent / "benchmarks"))
+    import kv_tier_bench
+
+    reqs = kv_tier_bench.working_set_requests(cfg, families=3, waves=2)
+    assert len({r.rid for r in reqs}) == len(reqs)
+    res = kv_tier_bench.run_engine(cfg, params, reqs, host_kv_mb=64.0,
+                                   repeats=1, kv_pool_blocks=12,
+                                   warmup=False)
+    base = kv_tier_bench.run_engine(cfg, params, reqs, host_kv_mb=0.0,
+                                    repeats=1, kv_pool_blocks=12,
+                                    warmup=False)
+    # The bench's own bit-identity precondition.
+    assert res["streams"] == base["streams"]
+    assert res["stats"]["spilled_pages"] > 0
+    assert res["stats"]["rehydrate_hits"] > 0
+    assert base["stats"]["spilled_pages"] == 0
+    # Eviction-scan accounting for the O(nodes)-rescan perf fix.
+    scan = kv_tier_bench.evict_scan_counts(n_chains=24, chain_len=4,
+                                           n_evict=32)
+    assert scan["heap_nodes_scanned"] > 0
+    assert scan["legacy_nodes_scanned"] > scan["heap_nodes_scanned"]
+    fleet = kv_tier_bench.run_fleet_leg(cfg, params, n_requests=4)
+    assert fleet["prefix_pulls"] >= 1
+    assert fleet["rehydrate_hits"] >= 1
+    assert fleet["completed"] == 4.0
