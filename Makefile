@@ -3,9 +3,10 @@
 
 PY ?= python
 
-.PHONY: all native test test-fast test-tp test-obs test-sampling \
-	test-pallas bench \
-	bench-cp bench-serve bench-overload bench-prefix bench-fleet \
+.PHONY: all native test test-fast test-native test-tp test-obs \
+	test-sampling test-pallas bench \
+	bench-cp bench-cp-sweep bench-serve bench-overload bench-prefix \
+	bench-fleet \
 	bench-disagg bench-kv-tier \
 	bench-spec bench-paged bench-tp bench-prefill bench-obs bench-sampling \
 	clean stamp
@@ -25,6 +26,12 @@ test: native
 
 test-fast:
 	$(PY) -m pytest tests/ -q -x -m "not slow"
+
+# Native-core guard: build the C++ lib if missing, then run the
+# native/Python parity battery (workqueue backoff/delay semantics,
+# expectations, object index + no-op-sync fingerprint protocol).
+test-native: native
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_native.py -q
 
 # Observability guard: the obs package (tracer, metrics registry,
 # reservoir) plus the instrumented-plane tests — span conservation,
@@ -67,6 +74,17 @@ bench:
 # reports mean_sync_us and deepcopies_per_sync — see benchmarks/RESULTS.md.
 bench-cp:
 	$(PY) benchmarks/controlplane_bench.py --jobs 1000
+
+# Control-plane scale sweep: 1k -> 10k -> 100k mixed TPUJob + LMService
+# populations, each with a steady-resync leg (zero status writes, all
+# fingerprint hits) and an annotation-churn leg. Refuses to run without
+# the C++ object index (--require-native): the recorded numbers measure
+# the native fingerprint path. Artifact: benchmarks/results/cp_sweep.json
+# — see benchmarks/RESULTS.md.
+bench-cp-sweep:
+	$(PY) benchmarks/controlplane_bench.py \
+		--sweep 1000,10000,100000 --lmsvc-frac 0.05 \
+		--require-native --out benchmarks/results/cp_sweep.json
 
 # Continuous-batching vs static serving on the tiny config (CPU, mixed
 # prompt/output lengths + early EOS); one JSON summary line — see

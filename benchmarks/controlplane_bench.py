@@ -10,16 +10,27 @@ a pool with capacity for all of them, tick the cluster, and report
   BASELINE.md north-star metric #2,
 - wall-clock reconcile throughput (syncs/sec) and per-sync latency from
   the controller's own traces,
-- async watch-pipeline counters (events_coalesced, max delta-queue depth)
-  and the no-op short-circuit's syncs_skipped_noop, plus a steady-state
-  resync phase that must perform ZERO status writes (docs/watch_pipeline.md).
+- async watch-pipeline counters (events_coalesced, max delta-queue depth,
+  per-shard lock wait) and the no-op short-circuit's syncs_skipped_noop,
+  plus a steady-state resync phase that must perform ZERO status writes
+  (docs/watch_pipeline.md) and a churn phase that annotation-mutates a
+  fraction of the population to defeat the fingerprints on purpose.
 
 Deterministic: simulated time, seeded names; wall numbers vary with host.
-``--workers N`` switches to threaded mode (N reconcile workers + a
-wall-clock ticker) so threaded scaling is measurable; 0 (default) is the
-deterministic single-thread drive.
+``--workers N`` switches to threaded mode (N reconcile workers bound to N
+queue shards + a wall-clock ticker) so threaded scaling is measurable; 0
+(default) is the deterministic single-thread drive.
+
+Sweep mode (``--sweep 1000,10000,100000``) runs one round per population
+size — each a mixed TPUJob + LMService control plane (``--lmsvc-frac``) —
+and writes every round's per-phase numbers to one JSON artifact
+(``--out``). ``make bench-cp-sweep`` drives this; it requires the native
+object index (``--require-native``) so the numbers measure the C++
+fingerprint path, not the Python fallback.
 
 Usage: python benchmarks/controlplane_bench.py [--jobs 100 --slices-each 1]
+       python benchmarks/controlplane_bench.py --sweep 1000,10000,100000 \
+           --lmsvc-frac 0.05 --out benchmarks/results/cp_sweep.json
 """
 
 from __future__ import annotations
@@ -35,10 +46,11 @@ sys.path.insert(
 )
 
 from kubeflow_controller_tpu.api.core import (
-    Container, ObjectMeta, PodSpec, PodTemplateSpec, deepcopy_count,
+    Container, ObjectMeta, PodSpec, PodTemplateSpec, deepcopy_count, thaw,
 )
 from kubeflow_controller_tpu.api.types import (
-    JobPhase, ReplicaSpec, ReplicaType, TPUJob, TPUJobSpec, TPUSliceSpec,
+    JobPhase, LMService, LMServiceSpec, ReplicaSpec, ReplicaType, TPUJob,
+    TPUJobSpec, TPUSliceSpec,
 )
 from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
 from kubeflow_controller_tpu.runtime import LocalRuntime
@@ -58,6 +70,13 @@ def make_job(i: int, num_slices: int) -> TPUJob:
     )
 
 
+def make_lmservice(i: int) -> LMService:
+    return LMService(
+        metadata=ObjectMeta(name=f"serve-{i:04d}", namespace="default"),
+        spec=LMServiceSpec(model="tiny", replicas=1),
+    )
+
+
 def pctile(xs, p):
     """Nearest-rank percentile: smallest x with >= p% of samples <= x."""
     xs = sorted(xs)
@@ -65,37 +84,29 @@ def pctile(xs, p):
     return xs[min(len(xs), rank) - 1]
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--jobs", type=int, default=100)
-    ap.add_argument("--slices-each", type=int, default=1)
-    ap.add_argument("--max-sim-steps", type=int, default=2000)
-    ap.add_argument("--workers", type=int, default=0,
-                    help="reconcile worker threads (0 = deterministic "
-                         "single-thread drive)")
-    ap.add_argument("--default-gc", action="store_true",
-                    help="skip the serve daemons' GC tuning (for measuring "
-                         "the untuned curve)")
-    args = ap.parse_args()
-
-    if not args.default_gc:
-        # Mirror the serve daemons (cli.py): boot heap frozen, rare
-        # collections — the GC-scan cost was the dominant super-linear
-        # term at 5000 jobs (see util/gc_tuning.py).
-        from kubeflow_controller_tpu.util.gc_tuning import (
-            tune_for_control_plane,
-        )
-
-        tune_for_control_plane()
-
-    rt = LocalRuntime(PodRunPolicy(start_delay=1, run_duration=10 ** 9))
+def run_round(args, n_jobs: int) -> dict:
+    """One full bench round at a given population size: populate ->
+    steady resync -> churn. Returns the per-phase JSON record."""
+    n_lmsvc = int(n_jobs * args.lmsvc_frac)
+    rt = LocalRuntime(
+        PodRunPolicy(start_delay=1, run_duration=10 ** 9),
+        workers=args.workers or None,
+        queue_shards=max(1, args.workers),
+    )
     rt.cluster.slice_pool.add_pool(
-        "v5p-8", args.jobs * args.slices_each)
+        "v5p-8", n_jobs * args.slices_each)
+    native = rt.cluster.native_index is not None
+    if args.require_native and not native:
+        raise SystemExit(
+            "controlplane_bench: --require-native but libtpujob_native.so "
+            "did not load — run `make native` first (csrc/Makefile)")
 
     dc0 = deepcopy_count()
     t_wall = time.perf_counter()
-    for i in range(args.jobs):
+    for i in range(n_jobs):
         rt.submit(make_job(i, args.slices_each))
+    for i in range(n_lmsvc):
+        rt.submit_lmservice(make_lmservice(i))
 
     # Track jobs already seen RUNNING so each poll re-reads only the
     # stragglers: the naive form re-fetched (and deep-copied) all N jobs
@@ -107,7 +118,7 @@ def main() -> None:
     # thaws into an owned copy, which would bill one harness deepcopy per
     # straggler per poll to the control plane under measurement.
     def all_running():
-        for i in range(args.jobs):
+        for i in range(n_jobs):
             if i in running:
                 continue
             j = rt.cluster.jobs.try_get("default", f"scale-{i:04d}")
@@ -117,7 +128,7 @@ def main() -> None:
         return True
 
     if args.workers:
-        rt.start_threads(workers=args.workers)
+        rt.start_threads()
         deadline = time.time() + max(120.0, args.max_sim_steps * 0.1)
         ok = False
         while time.time() < deadline:
@@ -144,36 +155,81 @@ def main() -> None:
                 pass
 
     quiesce()
-
-    # Steady-state resync: re-deliver every cached object as MODIFIED and
-    # reconcile all N jobs again. With the no-op short-circuit the whole
-    # wave must cost fingerprint compares only — zero job status writes.
-    rv_before = rt.cluster.jobs.revision
-    skipped_before = rt.controller.syncs_skipped_noop
-    t_resync = time.perf_counter()
-    for inf in (rt.job_informer, rt.pod_informer, rt.service_informer):
+    # One post-settle resync so every object (jobs AND lmservices) runs a
+    # steady sync and records its fingerprint before measurement starts.
+    informers = (rt.job_informer, rt.pod_informer, rt.service_informer,
+                 rt.lmservice_informer)
+    for inf in informers:
         inf.resync()
     quiesce()
+
+    # Steady-state resync: re-deliver every cached object as MODIFIED and
+    # reconcile the whole population again. With the no-op short-circuit
+    # the entire wave must cost fingerprint probes only — zero writes.
+    rv_before = rt.cluster.jobs.revision + rt.cluster.lmservices.revision
+    skipped_before = rt.controller.syncs_skipped_noop
+    hits0, misses0 = rt.controller.fp_stats()
+    t_resync = time.perf_counter()
+    for inf in informers:
+        inf.resync()
+    quiesce()
+    resync_wall = time.perf_counter() - t_resync
+    resync_status_writes = (
+        rt.cluster.jobs.revision + rt.cluster.lmservices.revision - rv_before)
+    resync_skipped = rt.controller.syncs_skipped_noop - skipped_before
+    hits1, misses1 = rt.controller.fp_stats()
+
+    # Churn: annotation-mutate a fraction of the jobs. Metadata-only, so
+    # generation is untouched, but resourceVersion moves — the fingerprint
+    # MUST miss for exactly the churned keys, the sync must prove itself a
+    # no-op the long way (zero status writes), and the next steady resync
+    # must skip everything again off the re-recorded fingerprints.
+    n_churn = max(1, int(n_jobs * args.churn_frac)) if n_jobs else 0
+    rv_before = rt.cluster.jobs.revision + rt.cluster.lmservices.revision
+    t_churn = time.perf_counter()
+    for i in range(n_churn):
+        j = thaw(rt.cluster.jobs.try_get("default", f"scale-{i:04d}"))
+        j.metadata.annotations["bench/churn"] = str(time.monotonic_ns())
+        rt.cluster.jobs.update(j)
+    churn_writes = (
+        rt.cluster.jobs.revision + rt.cluster.lmservices.revision - rv_before)
+    quiesce()
+    churn_wall = time.perf_counter() - t_churn
+    hits2, misses2 = rt.controller.fp_stats()
+    churn_status_writes = (
+        rt.cluster.jobs.revision + rt.cluster.lmservices.revision
+        - rv_before - churn_writes)
+
+    # Post-churn steady resync: everything skips again.
+    skipped_before = rt.controller.syncs_skipped_noop
+    t_resync2 = time.perf_counter()
+    for inf in informers:
+        inf.resync()
+    quiesce()
+    resync2_wall = time.perf_counter() - t_resync2
+    resync2_skipped = rt.controller.syncs_skipped_noop - skipped_before
+
+    store_metrics = rt.controller.publish_store_metrics()
     if args.workers:
         rt.stop()
-    resync_wall = time.perf_counter() - t_resync
-    resync_status_writes = rt.cluster.jobs.revision - rv_before
-    resync_skipped = rt.controller.syncs_skipped_noop - skipped_before
 
     lat = []
     if ok:   # all_running_time defaults to 0.0 until a gang actually runs
-        for i in range(args.jobs):
+        for i in range(n_jobs):
             j = rt.cluster.jobs.try_get("default", f"scale-{i:04d}")
             lat.append(j.status.all_running_time - j.status.submit_time)
     else:
         lat = [float("nan")]
     n_syncs = rt.controller.sync_count
     sync_wall = rt.controller.sync_wall_s
-    stores = (rt.cluster.jobs, rt.cluster.pods, rt.cluster.services)
-    print(json.dumps({
-        "jobs": args.jobs,
+    stores = (rt.cluster.jobs, rt.cluster.pods, rt.cluster.services,
+              rt.cluster.lmservices)
+    return {
+        "jobs": n_jobs,
+        "lmservices": n_lmsvc,
         "slices_each": args.slices_each,
         "workers": args.workers,
+        "native_index": native,
         "all_running": ok,
         "pods": len(rt.cluster.pods.list("default")),
         "submit_to_running_sim_s": {
@@ -200,19 +256,101 @@ def main() -> None:
         "deepcopies_total": dcopies,
         "deepcopies_per_sync": round(dcopies / n_syncs, 2)
         if n_syncs else None,
-        # async watch pipeline (summed/maxed over the three stores)
+        # async watch pipeline (summed/maxed over the four stores)
         "events_coalesced": sum(s.events_coalesced for s in stores),
         "watch_queue_depth_max": max(
             s.max_watch_queue_depth for s in stores),
         "watch_queue_overflows": sum(
             s.watch_queue_overflows for s in stores),
-        # no-op short-circuit: total skips, and the steady-state resync
-        # wave's cost — status writes MUST be 0 when nothing changed
+        "watch_lock_wait_s": round(
+            sum(s.watch_lock_wait_s for s in stores), 4),
+        # no-op short-circuit: total skips, then the measured phases
         "syncs_skipped_noop": rt.controller.syncs_skipped_noop,
+        "steady_resync": {
+            "wall_s": round(resync_wall, 3),
+            "status_writes": resync_status_writes,
+            "syncs_skipped": resync_skipped,
+            "fp_hits": hits1 - hits0,
+            "fp_misses": misses1 - misses0,
+        },
+        "churn": {
+            "mutated": n_churn,
+            "wall_s": round(churn_wall, 3),
+            "fp_misses": misses2 - misses1,
+            "status_writes": churn_status_writes,
+        },
+        "post_churn_resync": {
+            "wall_s": round(resync2_wall, 3),
+            "syncs_skipped": resync2_skipped,
+        },
+        # legacy flat fields (RESULTS.md history compares against these)
         "resync_status_writes": resync_status_writes,
         "resync_syncs_skipped": resync_skipped,
         "resync_wall_s": round(resync_wall, 2),
-    }))
+        "store_metrics": store_metrics,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--jobs", type=int, default=100)
+    ap.add_argument("--sweep", type=str, default="",
+                    help="comma-separated population sizes; runs one round "
+                         "per size and emits a JSON artifact (see --out)")
+    ap.add_argument("--lmsvc-frac", type=float, default=0.0,
+                    help="LMServices submitted per job (0.05 = 5%% of the "
+                         "population is serve objects)")
+    ap.add_argument("--churn-frac", type=float, default=0.01,
+                    help="fraction of jobs annotation-mutated in the churn "
+                         "phase")
+    ap.add_argument("--slices-each", type=int, default=1)
+    ap.add_argument("--max-sim-steps", type=int, default=2000)
+    ap.add_argument("--workers", type=int, default=0,
+                    help="reconcile worker threads (0 = deterministic "
+                         "single-thread drive); also sizes the workqueue "
+                         "shard count")
+    ap.add_argument("--out", type=str, default="",
+                    help="write the JSON artifact here as well as stdout")
+    ap.add_argument("--require-native", action="store_true",
+                    help="refuse to run when libtpujob_native.so is absent "
+                         "(sweep numbers must measure the C++ index)")
+    ap.add_argument("--default-gc", action="store_true",
+                    help="skip the serve daemons' GC tuning (for measuring "
+                         "the untuned curve)")
+    args = ap.parse_args()
+
+    if not args.default_gc:
+        # Mirror the serve daemons (cli.py): boot heap frozen, rare
+        # collections — the GC-scan cost was the dominant super-linear
+        # term at 5000 jobs (see util/gc_tuning.py).
+        from kubeflow_controller_tpu.util.gc_tuning import (
+            tune_for_control_plane,
+        )
+
+        tune_for_control_plane()
+
+    sizes = ([int(s) for s in args.sweep.split(",") if s.strip()]
+             if args.sweep else [args.jobs])
+    rounds = []
+    for n in sizes:
+        rec = run_round(args, n)
+        rounds.append(rec)
+        print(json.dumps(rec))
+        sys.stdout.flush()
+
+    if args.out:
+        artifact = {
+            "bench": "controlplane_sweep",
+            "sizes": sizes,
+            "lmsvc_frac": args.lmsvc_frac,
+            "churn_frac": args.churn_frac,
+            "workers": args.workers,
+            "rounds": rounds,
+        }
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
 
 
 if __name__ == "__main__":

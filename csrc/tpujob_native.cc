@@ -11,16 +11,21 @@
 //
 // Build: see csrc/Makefile (g++ -shared -fPIC, C++17, pthreads only).
 
+#include <algorithm>
 #include <chrono>
 #include <cmath>
 #include <condition_variable>
 #include <cstring>
 #include <deque>
+#include <map>
+#include <memory>
 #include <mutex>
 #include <queue>
+#include <set>
 #include <string>
 #include <unordered_map>
 #include <unordered_set>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -318,6 +323,215 @@ class Expectations {
   std::unordered_map<std::string, Rec> store_;
 };
 
+// -- object index ------------------------------------------------------------
+//
+// Write-through mirror of the Python ObjectStore's sync-relevant state:
+// per-kind key -> (uid, resourceVersion, generation, labels-of-interest)
+// records, the label index (store.py::_index_add/_index_remove), and the
+// controller's no-op-sync fingerprint (Controller._sync_fingerprint) held as
+// a canonical string per job key. Python keeps authoritative storage and the
+// deterministic flush() contract; this index exists so a steady resync probe
+// touches zero Python object traversals.
+//
+// Fingerprint protocol is two-phase to stay correct under threaded workers:
+// FpProbe computes the canonical fingerprint from current index state and
+// compares it with the last committed one. On a hit nothing changes; on a
+// miss the candidate parks in a pending slot keyed by job key. FpCommit
+// promotes pending -> committed verbatim — it never recomputes, so a write
+// racing between probe and commit cannot smuggle an unobserved state into
+// the committed fingerprint (the workqueue guarantees one worker per key, so
+// the pending slot has a single writer).
+
+class ObjectIndex {
+ public:
+  void Upsert(const std::string& kind, const std::string& key,
+              const std::string& uid, long long rv, long long gen,
+              const std::vector<std::pair<std::string, std::string>>& labels) {
+    Kind& k = KindFor(kind);
+    std::lock_guard<std::mutex> g(k.mu);
+    auto it = k.objs.find(key);
+    if (it != k.objs.end()) {
+      for (const auto& lv : it->second.labels) {
+        IndexRemoveLocked(k, lv.first, lv.second, key);
+      }
+      it->second.uid = uid;
+      it->second.rv = rv;
+      it->second.gen = gen;
+      it->second.labels = labels;
+    } else {
+      k.objs.emplace(key, Rec{uid, rv, gen, labels});
+    }
+    for (const auto& lv : labels) {
+      k.index[lv.first][lv.second].insert(key);
+    }
+  }
+
+  void Remove(const std::string& kind, const std::string& key) {
+    Kind& k = KindFor(kind);
+    std::lock_guard<std::mutex> g(k.mu);
+    auto it = k.objs.find(key);
+    if (it == k.objs.end()) return;
+    for (const auto& lv : it->second.labels) {
+      IndexRemoveLocked(k, lv.first, lv.second, key);
+    }
+    k.objs.erase(it);
+  }
+
+  int Count(const std::string& kind) {
+    Kind& k = KindFor(kind);
+    std::lock_guard<std::mutex> g(k.mu);
+    return static_cast<int>(k.objs.size());
+  }
+
+  int BucketCount(const std::string& kind, const std::string& label_key) {
+    Kind& k = KindFor(kind);
+    std::lock_guard<std::mutex> g(k.mu);
+    auto it = k.index.find(label_key);
+    return it == k.index.end() ? 0 : static_cast<int>(it->second.size());
+  }
+
+  // Newline-joined sorted member keys of one label bucket (parity tests).
+  std::string BucketKeys(const std::string& kind, const std::string& label_key,
+                         const std::string& value) {
+    Kind& k = KindFor(kind);
+    std::lock_guard<std::mutex> g(k.mu);
+    std::string out;
+    auto it = k.index.find(label_key);
+    if (it == k.index.end()) return out;
+    auto vit = it->second.find(value);
+    if (vit == it->second.end()) return out;
+    for (const auto& key : vit->second) {
+      if (!out.empty()) out += '\n';
+      out += key;
+    }
+    return out;
+  }
+
+  // Canonical fingerprint: job identity + the (uid, rv) pairs of every
+  // bucket member in `namespace`, sorted by uid — string-equal iff the
+  // Python tuple fingerprint is tuple-equal (uids are unique; both sides
+  // sort the same ASCII uids). kind_b may be empty (no second bucket, e.g.
+  // LMService has no owned Services in its fingerprint).
+  int FpProbe(const std::string& job_key, const std::string& ident,
+              const std::string& ns, const std::string& kind_a,
+              const std::string& lk_a, const std::string& lv_a,
+              const std::string& kind_b, const std::string& lk_b,
+              const std::string& lv_b, const std::string& health) {
+    std::string fp = ident;
+    fp += '\x01';
+    fp += BucketFp(kind_a, lk_a, lv_a, ns);
+    fp += '\x01';
+    if (!kind_b.empty()) fp += BucketFp(kind_b, lk_b, lv_b, ns);
+    fp += '\x01';
+    fp += health;
+    std::lock_guard<std::mutex> g(fp_mu_);
+    auto it = fp_.find(job_key);
+    if (it != fp_.end() && it->second == fp) {
+      ++fp_hits_;
+      fp_pending_.erase(job_key);
+      return 1;
+    }
+    ++fp_misses_;
+    fp_pending_[job_key] = std::move(fp);
+    return 0;
+  }
+
+  void FpCommit(const std::string& job_key) {
+    std::lock_guard<std::mutex> g(fp_mu_);
+    auto it = fp_pending_.find(job_key);
+    if (it == fp_pending_.end()) return;
+    fp_[job_key] = std::move(it->second);
+    fp_pending_.erase(it);
+  }
+
+  void FpForget(const std::string& job_key) {
+    std::lock_guard<std::mutex> g(fp_mu_);
+    fp_.erase(job_key);
+    fp_pending_.erase(job_key);
+  }
+
+  void FpCounts(long long* hits, long long* misses) {
+    std::lock_guard<std::mutex> g(fp_mu_);
+    *hits = fp_hits_;
+    *misses = fp_misses_;
+  }
+
+ private:
+  struct Rec {
+    std::string uid;
+    long long rv = 0;
+    long long gen = 0;
+    std::vector<std::pair<std::string, std::string>> labels;
+  };
+  struct Kind {
+    std::mutex mu;
+    std::unordered_map<std::string, Rec> objs;
+    std::unordered_map<
+        std::string, std::unordered_map<std::string, std::set<std::string>>>
+        index;
+  };
+
+  Kind& KindFor(const std::string& kind) {
+    std::lock_guard<std::mutex> g(kinds_mu_);
+    auto it = kinds_.find(kind);
+    if (it == kinds_.end()) {
+      it = kinds_.emplace(kind, std::unique_ptr<Kind>(new Kind)).first;
+    }
+    return *it->second;
+  }
+
+  static void IndexRemoveLocked(Kind& k, const std::string& lk,
+                                const std::string& lv,
+                                const std::string& key) {
+    auto it = k.index.find(lk);
+    if (it == k.index.end()) return;
+    auto vit = it->second.find(lv);
+    if (vit == it->second.end()) return;
+    vit->second.erase(key);
+    if (vit->second.empty()) it->second.erase(vit);
+  }
+
+  std::string BucketFp(const std::string& kind, const std::string& lk,
+                       const std::string& lv, const std::string& ns) {
+    Kind& k = KindFor(kind);
+    std::string prefix = ns + "/";
+    std::vector<std::pair<std::string, long long>> members;
+    {
+      std::lock_guard<std::mutex> g(k.mu);
+      auto it = k.index.find(lk);
+      if (it != k.index.end()) {
+        auto vit = it->second.find(lv);
+        if (vit != it->second.end()) {
+          for (const auto& key : vit->second) {
+            if (key.compare(0, prefix.size(), prefix) != 0) continue;
+            auto oit = k.objs.find(key);
+            if (oit != k.objs.end()) {
+              members.emplace_back(oit->second.uid, oit->second.rv);
+            }
+          }
+        }
+      }
+    }
+    std::sort(members.begin(), members.end());
+    std::string out;
+    for (const auto& m : members) {
+      out += m.first;
+      out += '\x02';
+      out += std::to_string(m.second);
+      out += '\x03';
+    }
+    return out;
+  }
+
+  std::mutex kinds_mu_;
+  std::unordered_map<std::string, std::unique_ptr<Kind>> kinds_;
+  std::mutex fp_mu_;
+  std::unordered_map<std::string, std::string> fp_;
+  std::unordered_map<std::string, std::string> fp_pending_;
+  long long fp_hits_ = 0;
+  long long fp_misses_ = 0;
+};
+
 }  // namespace
 
 // -- C ABI -------------------------------------------------------------------
@@ -397,6 +611,66 @@ void exp_delete(void* h, const char* key) {
 }
 int exp_pending(void* h, const char* key, int* adds, int* dels) {
   return static_cast<Expectations*>(h)->Pending(key, adds, dels);
+}
+
+void* oix_new() { return new ObjectIndex(); }
+void oix_free(void* h) { delete static_cast<ObjectIndex*>(h); }
+// labels: "k\x1fv" pairs joined by "\x1e"; empty string == no labels.
+void oix_upsert(void* h, const char* kind, const char* key, const char* uid,
+                long long rv, long long gen, const char* labels) {
+  std::vector<std::pair<std::string, std::string>> lv;
+  const char* p = labels;
+  while (p && *p) {
+    const char* end = std::strchr(p, '\x1e');
+    size_t n = end ? static_cast<size_t>(end - p) : std::strlen(p);
+    const char* sep =
+        static_cast<const char*>(std::memchr(p, '\x1f', n));
+    if (sep) {
+      lv.emplace_back(std::string(p, sep),
+                      std::string(sep + 1, p + n - (sep + 1)));
+    }
+    p = end ? end + 1 : nullptr;
+  }
+  static_cast<ObjectIndex*>(h)->Upsert(kind, key, uid, rv, gen, lv);
+}
+void oix_remove(void* h, const char* kind, const char* key) {
+  static_cast<ObjectIndex*>(h)->Remove(kind, key);
+}
+int oix_count(void* h, const char* kind) {
+  return static_cast<ObjectIndex*>(h)->Count(kind);
+}
+int oix_bucket_count(void* h, const char* kind, const char* label_key) {
+  return static_cast<ObjectIndex*>(h)->BucketCount(kind, label_key);
+}
+// Returns length written (excluding NUL); -2 if buf too small (nothing
+// written). Keys come back newline-joined, sorted.
+int oix_bucket_keys(void* h, const char* kind, const char* label_key,
+                    const char* value, char* buf, int buflen) {
+  std::string out =
+      static_cast<ObjectIndex*>(h)->BucketKeys(kind, label_key, value);
+  if (static_cast<int>(out.size()) + 1 > buflen) return -2;
+  std::memcpy(buf, out.data(), out.size());
+  buf[out.size()] = '\0';
+  return static_cast<int>(out.size());
+}
+// 1 == fingerprint hit (steady, skip the sync); 0 == miss (candidate parked
+// for oix_fp_commit). kind_b may be "" to fingerprint a single bucket.
+int oix_fp_probe(void* h, const char* job_key, const char* ident,
+                 const char* ns, const char* kind_a, const char* lk_a,
+                 const char* lv_a, const char* kind_b, const char* lk_b,
+                 const char* lv_b, const char* health) {
+  return static_cast<ObjectIndex*>(h)->FpProbe(job_key, ident, ns, kind_a,
+                                               lk_a, lv_a, kind_b, lk_b,
+                                               lv_b, health);
+}
+void oix_fp_commit(void* h, const char* job_key) {
+  static_cast<ObjectIndex*>(h)->FpCommit(job_key);
+}
+void oix_fp_forget(void* h, const char* job_key) {
+  static_cast<ObjectIndex*>(h)->FpForget(job_key);
+}
+void oix_fp_counts(void* h, long long* hits, long long* misses) {
+  static_cast<ObjectIndex*>(h)->FpCounts(hits, misses);
 }
 
 }  // extern "C"

@@ -102,26 +102,48 @@ class _PodRuntime:
 class FakeCluster:
     """Facade over the stores + slice pool + simulated scheduler/kubelet."""
 
-    def __init__(self, default_policy: Optional[PodRunPolicy] = None):
+    def __init__(
+        self,
+        default_policy: Optional[PodRunPolicy] = None,
+        use_native_index: Optional[bool] = None,
+        watch_shards: int = 8,
+    ):
         # All stores stamp creation timestamps on the cluster's simulated
         # clock so control-plane latency metrics are internally consistent.
-        # Pods/services are indexed by owning-job label so per-job selector
-        # lists stay O(own pods) at any cluster size.
-        from kubeflow_controller_tpu.tpu.naming import LABEL_JOB
+        # Pods/services are indexed by owning-job label (and pods also by
+        # owning-LMService label) so per-owner selector lists stay O(own
+        # pods) at any cluster size.
+        from kubeflow_controller_tpu.tpu.naming import LABEL_JOB, LABEL_LMSERVICE
+
+        # One shared native object index mirrors every store's sync-relevant
+        # state into the C++ core (csrc/tpujob_native.cc). None when the
+        # library is unavailable or use_native_index=False — everything then
+        # runs the behavior-identical pure-Python paths.
+        self.native_index = None
+        if use_native_index is None or use_native_index:
+            from kubeflow_controller_tpu.native.objindex import (
+                make_object_index,
+            )
+
+            self.native_index = make_object_index()
+            if use_native_index and self.native_index is None:
+                raise RuntimeError("native object index requested but "
+                                   "libtpujob_native.so is unavailable")
 
         # Frozen (copy-on-write) mode: reads, lists, and watch events are
         # shared immutable snapshots — the whole in-process control plane
         # runs zero-copy on the read path (docs/object_ownership.md).
-        self.pods = ObjectStore(
-            "Pod", now_fn=lambda: self.now, index_labels=(LABEL_JOB,),
-            copy_on_read=False)
-        self.services = ObjectStore(
-            "Service", now_fn=lambda: self.now, index_labels=(LABEL_JOB,),
-            copy_on_read=False)
-        self.jobs = ObjectStore(
-            "TPUJob", now_fn=lambda: self.now, copy_on_read=False)
-        self.lmservices = ObjectStore(
-            "LMService", now_fn=lambda: self.now, copy_on_read=False)
+        def _store(kind: str, index_labels: tuple = ()) -> ObjectStore:
+            return ObjectStore(
+                kind, now_fn=lambda: self.now, index_labels=index_labels,
+                copy_on_read=False, watch_shards=watch_shards,
+                mirror=self.native_index,
+            )
+
+        self.pods = _store("Pod", (LABEL_JOB, LABEL_LMSERVICE))
+        self.services = _store("Service", (LABEL_JOB,))
+        self.jobs = _store("TPUJob")
+        self.lmservices = _store("LMService")
         # Scheduler/kubelet work queues: every tick touches only pods that
         # can actually change state — unbound Pending pods (scheduler) and
         # live pods (kubelet) — instead of scanning the whole store.

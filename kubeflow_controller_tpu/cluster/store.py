@@ -47,28 +47,100 @@ class Conflict(ValueError):
 Listener = Callable[[WatchEvent], None]
 
 
-class _Subscription:
-    """One watch listener's delta queue + dispatch state.
+def fnv1a_32(key: str) -> int:
+    """Deterministic 32-bit FNV-1a — shard routing must be stable across
+    processes and runs (Python's ``hash`` is seed-randomized)."""
+    h = 2166136261
+    for b in key.encode():
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
 
-    The client-go ``processorListener`` analog: writers append deltas under
-    the store lock (cheap — one dict probe and a deque append), and whichever
-    thread wins the ``dispatching`` flag delivers them with NO store lock
-    held. ``tail`` maps key -> the newest still-coalescible pending entry so
-    a burst of MODIFIEDs for one key collapses to the latest snapshot
-    (DeltaFIFO semantics) instead of queueing N handler invocations.
-    """
 
-    __slots__ = ("listener", "lock", "cond", "pending", "tail", "dispatching")
+class _SubShard:
+    """One key-range shard of a subscriber's delta queue: its own lock,
+    deque, and coalescing tail-map, so concurrent writers to different key
+    ranges never contend on one lock on the enqueue/drain path."""
 
-    def __init__(self, listener: Listener):
-        self.listener = listener
+    __slots__ = (
+        "lock", "cond", "pending", "tail", "dispatching",
+        "wait_s", "coalesced", "overflows",
+    )
+
+    def __init__(self):
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
-        # entries are mutable [event, key] pairs so coalescing can swap the
-        # event in place without disturbing queue order
+        # entries are mutable [event, key, needs_copy] triples so coalescing
+        # can swap the event in place without disturbing queue order
         self.pending: deque = deque()
         self.tail: Dict[str, list] = {}
         self.dispatching = False
+        self.wait_s = 0.0       # enqueue-side contended-lock wait
+        self.coalesced = 0
+        self.overflows = 0
+
+
+class _Subscription:
+    """One watch listener's sharded delta queues + dispatch state.
+
+    The client-go ``processorListener`` analog: writers append deltas under
+    the store lock (cheap — one dict probe and a deque append), and whichever
+    thread wins a shard's ``dispatching`` flag delivers that shard's entries
+    with NO store lock held. Each shard's ``tail`` maps key -> the newest
+    still-coalescible pending entry so a burst of MODIFIEDs for one key
+    collapses to the latest snapshot (DeltaFIFO semantics) instead of
+    queueing N handler invocations. A key always routes to the same shard,
+    so per-key ordering — the contract docs/watch_pipeline.md pins — is
+    preserved; cross-key delivery order is only defined per shard.
+    """
+
+    __slots__ = ("listener", "shards", "nshards", "replaying")
+
+    def __init__(self, listener: Listener, nshards: int = 1):
+        self.listener = listener
+        self.nshards = max(1, nshards)
+        self.shards = [_SubShard() for _ in range(self.nshards)]
+        # While True (subscribe-replay in flight), dispatch is parked so the
+        # replayer can prepend the snapshot ahead of any racing live events.
+        self.replaying = False
+
+    def shard_for(self, key: str) -> _SubShard:
+        if self.nshards == 1:
+            return self.shards[0]
+        return self.shards[fnv1a_32(key) % self.nshards]
+
+    def shard_index(self, key: str) -> int:
+        if self.nshards == 1:
+            return 0
+        return fnv1a_32(key) % self.nshards
+
+    # Legacy single-queue accessors (only meaningful when nshards == 1 —
+    # the bare-ObjectStore default): bounded consumers shed an overflowed
+    # buffer through these (tests/test_races.py overflow-recovery suite).
+
+    @property
+    def lock(self) -> threading.Lock:
+        assert self.nshards == 1
+        return self.shards[0].lock
+
+    @property
+    def pending(self) -> deque:
+        assert self.nshards == 1
+        return self.shards[0].pending
+
+    @property
+    def tail(self) -> Dict[str, list]:
+        assert self.nshards == 1
+        return self.shards[0].tail
+
+    @property
+    def dispatching(self) -> bool:
+        assert self.nshards == 1
+        return self.shards[0].dispatching
+
+    @dispatching.setter
+    def dispatching(self, v: bool) -> None:
+        assert self.nshards == 1
+        self.shards[0].dispatching = v
 
 
 class ObjectStore:
@@ -94,6 +166,8 @@ class ObjectStore:
         index_labels: tuple = (),
         copy_on_read: bool = True,
         watch_queue_soft_max: int = 1024,
+        watch_shards: int = 1,
+        mirror: Any = None,
     ):
         self.kind = kind
         self._now_fn = now_fn
@@ -102,16 +176,26 @@ class ObjectStore:
         self._objects: Dict[str, Any] = {}
         self._rv = 0
         self._last_delete_rv = 0
+        # Write-through native mirror (native.objindex.NativeObjectIndex or
+        # None): keeps (uid, rv, generation, indexed labels) per key inside
+        # the C++ core so the controller's fingerprint probe never walks
+        # Python objects. Updated under the store lock on every mutation —
+        # the Python store stays authoritative.
+        self._mirror = mirror
+        self._watch_shards = max(1, watch_shards)
         self._subs: List[_Subscription] = []
         self._sub_by_listener: Dict[Listener, _Subscription] = {}
         # Delta-queue instrumentation (benchmarks/controlplane_bench.py).
         # The bound is soft: coalescing keeps steady-state depth at O(hot
         # keys), and a writer cannot block under the store lock without
-        # inviting deadlock, so overflow is counted, not enforced.
+        # inviting deadlock, so overflow is counted, not enforced. Live
+        # counters are per shard; these accumulate what unsubscribed
+        # listeners retired so the store-level properties stay monotonic.
         self._watch_queue_soft_max = watch_queue_soft_max
-        self._events_coalesced = 0
         self._max_queue_depth = 0
-        self._queue_overflows = 0
+        self._retired_coalesced = 0
+        self._retired_overflows = 0
+        self._retired_wait_s = 0.0
         # Label indexes (client-go Indexer analog): selector lists on an
         # indexed key touch only matching objects instead of scanning the
         # namespace — the difference between O(jobs) and O(jobs^2) total
@@ -137,30 +221,72 @@ class ObjectStore:
                     if not bucket:
                         del self._index[lk][v]
 
+    # -- native write-through mirror -----------------------------------------
+
+    def _mirror_upsert(self, key: str, obj: Any) -> None:
+        m = self._mirror
+        if m is None:
+            return
+        meta = obj.metadata
+        sel = None
+        labels = meta.labels
+        if labels:
+            for lk in self._index_labels:
+                v = labels.get(lk)
+                if v is not None:
+                    if sel is None:
+                        sel = {}
+                    sel[lk] = v
+        m.upsert(self.kind, key, meta.uid, meta.resource_version,
+                 meta.generation, sel)
+
+    def _mirror_remove(self, key: str) -> None:
+        if self._mirror is not None:
+            self._mirror.remove(self.kind, key)
+
     # -- watch ---------------------------------------------------------------
 
     def subscribe(self, listener: Listener, replay: bool = True) -> None:
         """Register a watch listener. With ``replay``, synthesizes ADDED events
         for existing objects first (how a fresh informer list+watch behaves).
 
-        Replay + registration are atomic under the store lock (enqueues also
-        happen under it), so a subscriber can never observe a newer event
-        before the stale replay copy — each subscriber's queue is totally
-        ordered by resource version. Delivery itself happens OFF the lock:
-        the writing thread (or whichever thread currently owns the
-        subscriber's dispatch flag) drains the queue after the store lock is
-        released, so a slow handler never serializes other writers. A
-        listener may call back into this or any other store."""
-        sub = _Subscription(listener)
+        Only the snapshot is taken under the store lock — replay enqueueing
+        happens OFF the write lock, so registering an informer against a
+        large store never stalls writers, and frozen-mode replay is
+        zero-copy (legacy mode defers its per-event deepcopy to delivery
+        time; stored objects are internally immutable, so the deferred copy
+        sees exactly the snapshotted state). Ordering stays safe: the
+        subscription registers with ``replaying=True`` (dispatch parked), so
+        live events land in the shard queues but cannot be delivered; the
+        replayer then PREPENDS the snapshot entries — every racing live
+        event carries a newer resource version than the snapshot, so each
+        subscriber still observes per-key rv-monotonic order. Replay entries
+        never become coalesce targets (a racing DELETED may already sit
+        behind them; folding a post-delete MODIFIED into a pre-delete entry
+        would reorder across the tombstone). Delivery itself happens OFF the
+        lock: whichever thread owns a shard's dispatch flag drains it after
+        the store lock is released, so a slow handler never serializes other
+        writers. A listener may call back into this or any other store."""
+        sub = _Subscription(listener, self._watch_shards)
         with self._lock:
-            if replay:
-                for key, obj in self._objects.items():
-                    self._enqueue(sub, key, WatchEvent(
-                        EventType.ADDED, self.kind,
-                        obj.deepcopy() if self._copy_on_read else obj,
-                    ))
+            snapshot = list(self._objects.items()) if replay else None
+            sub.replaying = replay
             self._subs.append(sub)
             self._sub_by_listener[listener] = sub
+        if replay:
+            per_shard: List[list] = [[] for _ in range(sub.nshards)]
+            needs_copy = self._copy_on_read
+            for key, obj in snapshot:
+                per_shard[sub.shard_index(key)].append(
+                    [WatchEvent(EventType.ADDED, self.kind, obj), key,
+                     needs_copy]
+                )
+            for shard, items in zip(sub.shards, per_shard):
+                if not items:
+                    continue
+                with shard.lock:
+                    shard.pending.extendleft(reversed(items))
+            sub.replaying = False
         self._drain(sub)
 
     def unsubscribe(self, listener: Listener) -> None:
@@ -168,20 +294,34 @@ class ObjectStore:
             sub = self._sub_by_listener.pop(listener, None)
             if sub is not None:
                 self._subs.remove(sub)
+        if sub is not None:
+            with self._lock:
+                for shard in sub.shards:
+                    self._retired_coalesced += shard.coalesced
+                    self._retired_overflows += shard.overflows
+                    self._retired_wait_s += shard.wait_s
 
     # -- delta queues + dispatcher -------------------------------------------
 
     def _emit(self, ev: WatchEvent) -> None:
         # Caller holds self._lock: enqueue order == resource-version order.
         # No listener runs here — the write path only appends deltas; the
-        # caller invokes _dispatch() after releasing the lock.
+        # caller invokes _dispatch(key) after releasing the lock.
         key = f"{ev.obj.metadata.namespace}/{ev.obj.metadata.name}"
         for sub in self._subs:
             self._enqueue(sub, key, ev)
 
     def _enqueue(self, sub: _Subscription, key: str, ev: WatchEvent) -> None:
-        with sub.lock:
-            entry = sub.tail.get(key)
+        shard = sub.shard_for(key)
+        lk = shard.lock
+        if not lk.acquire(False):
+            # Contended: another writer/drainer holds this shard. Time the
+            # wait — the lock-wait gauge the sharding exists to drive down.
+            t0 = time.perf_counter()
+            lk.acquire()
+            shard.wait_s += time.perf_counter() - t0
+        try:
+            entry = shard.tail.get(key)
             if entry is not None and ev.type == EventType.MODIFIED:
                 # Coalesce: consecutive MODIFIEDs for one key collapse to the
                 # latest snapshot; a pending ADDED absorbs the MODIFIED and
@@ -190,56 +330,75 @@ class ObjectStore:
                 prior = entry[0]
                 entry[0] = WatchEvent(prior.type, ev.kind, ev.obj,
                                       prior.old_obj)
-                self._events_coalesced += 1
+                shard.coalesced += 1
                 return
-            entry = [ev, key]
-            sub.pending.append(entry)
-            depth = len(sub.pending)
+            entry = [ev, key, False]
+            shard.pending.append(entry)
+            depth = len(shard.pending)
             if ev.type == EventType.DELETED:
                 # Nothing coalesces across a tombstone: a re-create after
                 # delete must arrive as its own ADDED.
-                sub.tail.pop(key, None)
+                shard.tail.pop(key, None)
             else:
-                sub.tail[key] = entry
+                shard.tail[key] = entry
+        finally:
+            lk.release()
         if depth > self._max_queue_depth:
             self._max_queue_depth = depth
         if depth > self._watch_queue_soft_max:
-            self._queue_overflows += 1
+            shard.overflows += 1
 
-    def _dispatch(self) -> None:
-        """Drain every subscriber's queue, called with NO store lock held."""
+    def _dispatch(self, key: Optional[str] = None) -> None:
+        """Drain subscribers' queues, called with NO store lock held. A
+        write path passes its key so only the one affected shard per
+        subscriber is visited (the no-sharding fast path is identical:
+        every key maps to shard 0)."""
         with self._lock:
             subs = list(self._subs)
         for sub in subs:
-            self._drain(sub)
+            if key is not None:
+                self._drain_shard(sub, sub.shard_for(key))
+            else:
+                self._drain(sub)
+
+    def _drain(self, sub: _Subscription) -> None:
+        for shard in sub.shards:
+            self._drain_shard(sub, shard)
 
     @staticmethod
-    def _drain(sub: _Subscription) -> None:
-        with sub.lock:
-            if sub.dispatching:
-                return  # the active dispatcher will deliver our entries too
-            sub.dispatching = True
+    def _drain_shard(sub: _Subscription, shard: _SubShard) -> None:
+        with shard.lock:
+            if shard.dispatching or sub.replaying:
+                # the active dispatcher delivers our entries too; during
+                # replay the subscriber's queues are parked until the
+                # snapshot has been prepended
+                return
+            shard.dispatching = True
         while True:
-            with sub.lock:
-                if not sub.pending:
-                    sub.dispatching = False
-                    sub.cond.notify_all()
+            with shard.lock:
+                if not shard.pending:
+                    shard.dispatching = False
+                    shard.cond.notify_all()
                     return
-                entry = sub.pending.popleft()
-                ev, key = entry
-                if sub.tail.get(key) is entry:
-                    del sub.tail[key]
+                entry = shard.pending.popleft()
+                ev, key, needs_copy = entry
+                if shard.tail.get(key) is entry:
+                    del shard.tail[key]
+            if needs_copy:
+                # deferred legacy-mode replay copy (see subscribe())
+                ev = WatchEvent(ev.type, ev.kind, ev.obj.deepcopy(),
+                                ev.old_obj)
             try:
                 sub.listener(ev)
             except BaseException:
-                with sub.lock:
-                    sub.dispatching = False
-                    sub.cond.notify_all()
+                with shard.lock:
+                    shard.dispatching = False
+                    shard.cond.notify_all()
                 raise
 
     def flush(self, timeout: float = 10.0) -> bool:
         """Quiesce the watch pipeline: block until every subscriber's delta
-        queue is empty and no dispatcher is mid-delivery. The determinism
+        queues are empty and no dispatcher is mid-delivery. The determinism
         hook FakeCluster.tick / Controller.drain rely on — after flush(),
         every completed write has been observed by every subscriber. Returns
         False only if a foreign dispatcher failed to finish within
@@ -248,34 +407,72 @@ class ObjectStore:
         with self._lock:
             subs = list(self._subs)
         for sub in subs:
-            while True:
-                self._drain(sub)
-                with sub.lock:
-                    if not sub.pending and not sub.dispatching:
-                        break
-                    if sub.dispatching:
+            for shard in sub.shards:
+                while True:
+                    self._drain_shard(sub, shard)
+                    with shard.lock:
+                        if (not shard.pending and not shard.dispatching
+                                and not sub.replaying):
+                            break
                         if time.monotonic() >= deadline:
                             return False
-                        sub.cond.wait(0.05)
+                        shard.cond.wait(0.05)
         return True
+
+    def _sum_shard_counter(self, attr: str, retired):
+        total = retired
+        with self._lock:
+            subs = list(self._subs)
+        for sub in subs:
+            for shard in sub.shards:
+                total += getattr(shard, attr)
+        return total
 
     @property
     def events_coalesced(self) -> int:
         """MODIFIED events absorbed into a newer pending snapshot."""
-        with self._lock:
-            return self._events_coalesced
+        return self._sum_shard_counter("coalesced", self._retired_coalesced)
 
     @property
     def max_watch_queue_depth(self) -> int:
-        """High-water mark of any subscriber's pending delta queue."""
+        """High-water mark of any subscriber shard's pending delta queue."""
         with self._lock:
             return self._max_queue_depth
 
     @property
     def watch_queue_overflows(self) -> int:
         """Enqueues observed past the soft bound (diagnostic)."""
+        return self._sum_shard_counter("overflows", self._retired_overflows)
+
+    @property
+    def watch_lock_wait_s(self) -> float:
+        """Cumulative time writers spent blocked on contended subscriber
+        shard locks — the serialization the per-shard split removes."""
+        return self._sum_shard_counter("wait_s", self._retired_wait_s)
+
+    def index_bucket_count(self) -> int:
+        """Total label-index buckets (values with >=1 member) across keys."""
         with self._lock:
-            return self._queue_overflows
+            return sum(len(v) for v in self._index.values())
+
+    def publish_metrics(self) -> Dict[str, float]:
+        """Push this store's gauges into the PR 10 metrics registry under
+        the ``control.store`` subsystem and return them as a dict (the
+        controlplane bench emits that dict in its JSON artifact)."""
+        from kubeflow_controller_tpu.obs.telemetry import registry
+
+        k = self.kind.lower()
+        vals = {
+            f"objects_{k}": float(len(self)),
+            f"index_buckets_{k}": float(self.index_bucket_count()),
+            f"watch_queue_depth_max_{k}": float(self.max_watch_queue_depth),
+            f"watch_lock_wait_s_{k}": self.watch_lock_wait_s,
+            f"events_coalesced_{k}": float(self.events_coalesced),
+        }
+        reg = registry()
+        for name, v in vals.items():
+            reg.gauge(name, "control.store").set(v)
+        return vals
 
     # -- CRUD ----------------------------------------------------------------
 
@@ -316,6 +513,7 @@ class ObjectStore:
                 stored.freeze()
             self._objects[key] = stored
             self._index_add(key, stored)
+            self._mirror_upsert(key, stored)
             if self._copy_on_read:
                 self._emit(
                     WatchEvent(EventType.ADDED, self.kind, stored.deepcopy())
@@ -324,7 +522,7 @@ class ObjectStore:
             else:
                 self._emit(WatchEvent(EventType.ADDED, self.kind, stored))
                 ret = stored
-        self._dispatch()
+        self._dispatch(key)
         return ret
 
     def get(self, namespace: str, name: str) -> Any:
@@ -370,6 +568,7 @@ class ObjectStore:
                 self._index_remove(key, old)
                 self._objects[key] = stored
                 self._index_add(key, stored)
+                self._mirror_upsert(key, stored)
                 self._emit(WatchEvent(
                     EventType.MODIFIED, self.kind, stored, old,
                 ))
@@ -383,12 +582,13 @@ class ObjectStore:
                 self._index_remove(key, old)
                 self._objects[key] = stored
                 self._index_add(key, stored)
+                self._mirror_upsert(key, stored)
                 self._emit(WatchEvent(
                     EventType.MODIFIED, self.kind,
                     stored.deepcopy(), old.deepcopy(),
                 ))
                 ret = stored.deepcopy()
-        self._dispatch()
+        self._dispatch(key)
         return ret
 
     @staticmethod
@@ -439,18 +639,20 @@ class ObjectStore:
             if not self._copy_on_read:
                 stored.freeze()  # spec already sealed: O(1) for that branch
                 self._objects[key] = stored
+                self._mirror_upsert(key, stored)
                 self._emit(WatchEvent(
                     EventType.MODIFIED, self.kind, stored, old,
                 ))
                 ret = stored
             else:
                 self._objects[key] = stored
+                self._mirror_upsert(key, stored)
                 self._emit(WatchEvent(
                     EventType.MODIFIED, self.kind,
                     stored.deepcopy(), old.deepcopy(),
                 ))
                 ret = stored.deepcopy()
-        self._dispatch()
+        self._dispatch(key)
         return ret
 
     def mutate(self, namespace: str, name: str, fn: Callable[[Any], None]) -> Any:
@@ -473,6 +675,7 @@ class ObjectStore:
             if obj is None:
                 raise NotFound(f"{self.kind} {key}")
             self._index_remove(key, obj)
+            self._mirror_remove(key)
             self._rv += 1
             self._last_delete_rv = self._rv
             # The tombstone carries the DELETION's revision (k8s watch
@@ -483,7 +686,7 @@ class ObjectStore:
             if not self._copy_on_read:
                 tomb.freeze()
             self._emit(WatchEvent(EventType.DELETED, self.kind, tomb))
-        self._dispatch()
+        self._dispatch(key)
         return obj
 
     # -- listing -------------------------------------------------------------

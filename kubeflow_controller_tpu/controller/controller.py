@@ -69,6 +69,11 @@ _RUNTIME_ID_ALPHABET = string.ascii_lowercase + string.digits
 # same-named job.
 LMSVC_KEY_PREFIX = "lmsvc:"
 
+# Sentinel fp value for the native path: the candidate fingerprint is parked
+# inside the C++ index (oix_fp_probe), not materialized in Python; recording
+# a steady pass promotes it verbatim via oix_fp_commit.
+_NATIVE_FP = ("__native_pending__",)
+
 
 def generate_runtime_id(rng: Optional[random.Random] = None) -> str:
     """5-char random suffix, the shape of k8s SimpleNameGenerator as the
@@ -80,6 +85,13 @@ def generate_runtime_id(rng: Optional[random.Random] = None) -> str:
 @dataclass
 class ControllerOptions:
     workers: int = 2                      # reference runs 2 (main.go:54)
+    # Key-range shards for the workqueue: >1 splits the queue into
+    # independently-locked sub-queues (FNV-routed, so a key's dedup/backoff
+    # state stays on one shard) and run(workers=N) binds each worker to its
+    # shard group — steady-state resync then scales with workers instead of
+    # serializing on one queue lock. 1 == the single-queue behavior every
+    # existing test pins.
+    queue_shards: int = 1
     resync_period: float = 30.0           # reference: 30s informers
     now_fn: Callable[[], float] = time.time
     rng: Optional[random.Random] = None
@@ -136,8 +148,26 @@ class Controller:
             make_expectations, make_queue,
         )
 
-        self.queue = make_queue()
+        if self.opts.queue_shards > 1:
+            from kubeflow_controller_tpu.controller.workqueue import (
+                ShardedRateLimitingQueue,
+            )
+
+            self.queue = ShardedRateLimitingQueue(
+                self.opts.queue_shards, make_queue)
+        else:
+            self.queue = make_queue()
         self.expectations = make_expectations()
+        # Native object index (cluster/store.py write-through mirror): when
+        # the client exposes one, the no-op-sync fingerprint probe runs
+        # entirely inside the C++ core — no Python pod/service traversals
+        # on a steady resync. None routes through _sync_fingerprint.
+        self._nix = getattr(client, "native_index", None)
+        # Pre-encoded constant probe arguments (the per-sync fp probe is
+        # the steady-resync hot path; encoding these 6 strings per call
+        # was measurable at 10k+ objects).
+        self._b_job_label = naming.LABEL_JOB.encode()
+        self._b_lmsvc_label = naming.LABEL_LMSERVICE.encode()
         # Ring buffer of the last 1000 traces. deque(maxlen=) trims on
         # append under the GIL — safe with concurrent workers, unlike the
         # old unlocked append + del[:-1000] pair.
@@ -145,6 +175,7 @@ class Controller:
         self.sync_count = 0                 # total syncs, never truncated
         self.sync_wall_s = 0.0              # wall seconds inside sync()
         self.syncs_skipped_noop = 0         # fingerprint fast-path exits
+        self.fp_misses = 0                  # fingerprint probes that missed
         self._count_lock = threading.Lock()
         # key -> fingerprint of the last fully-steady sync; a matching
         # fingerprint lets sync() exit before claim/plan/status work.
@@ -181,8 +212,7 @@ class Controller:
         if ev.type == EventType.DELETED:
             # Deletion path the reference stubbed (controller.go:505-508).
             self.expectations.delete_expectations(key)
-            with self._count_lock:
-                self._last_sync_fp.pop(key, None)
+            self._forget_fp(key)
         self._note_enqueue(key)
         self.queue.add(key)
 
@@ -191,8 +221,17 @@ class Controller:
                f"{ev.obj.metadata.namespace}/{ev.obj.metadata.name}")
         if ev.type == EventType.DELETED:
             self.expectations.delete_expectations(key)
+            self._forget_fp(key)
         self._note_enqueue(key)
         self.queue.add(key)
+
+    def _forget_fp(self, key: str) -> None:
+        """Invalidate the steady-sync fingerprint (both paths) on DELETED —
+        a recreated same-name object must never inherit a stale skip."""
+        with self._count_lock:
+            self._last_sync_fp.pop(key, None)
+        if self._nix is not None:
+            self._nix.fp_forget(key)
 
     @staticmethod
     def _owner_key(namespace: str, ref) -> Optional[str]:
@@ -210,6 +249,20 @@ class Controller:
         """Pod/Service watch events: resolve the owning job, settle
         expectations, enqueue (reference addPod/updatePod/… controller.go:430-590)."""
         obj = ev.obj
+        if (
+            ev.type == EventType.MODIFIED
+            and ev.old_obj is not None
+            and ev.old_obj.metadata.resource_version
+            == obj.metadata.resource_version
+        ):
+            # Periodic-resync redelivery (old == new; real store writes
+            # always bump rv). The k8s job-controller idiom: updatePod
+            # returns early on equal ResourceVersions — the PRIMARY
+            # informer's resync re-enqueues every owner, so re-adding the
+            # key once per child object here only multiplies queue traffic
+            # by the fan-out (2 pods + 1 service per job at 10k jobs is
+            # 30k redundant adds per resync wave).
+            return
         keys = set()
         key = self._owner_key(obj.metadata.namespace,
                               obj.metadata.controller_ref())
@@ -239,11 +292,15 @@ class Controller:
             self.lmservices.start()
 
     def run(self, workers: Optional[int] = None) -> None:
-        """Spawn worker threads (reference Run, controller.go:158-182)."""
+        """Spawn worker threads (reference Run, controller.go:158-182).
+        With a sharded workqueue each worker binds to its key-range shard
+        group, so workers block on independent locks instead of contending
+        on one queue head."""
         n = workers if workers is not None else self.opts.workers
         for i in range(n):
             t = threading.Thread(
-                target=self._worker_loop, name=f"tpujob-worker-{i}", daemon=True
+                target=self._worker_loop, args=(i, n),
+                name=f"tpujob-worker-{i}", daemon=True
             )
             t.start()
             self._threads.append(t)
@@ -259,9 +316,13 @@ class Controller:
         if self.lmservices is not None:
             self.lmservices.stop()
 
-    def _worker_loop(self) -> None:
+    def _worker_loop(self, index: int = 0, nworkers: int = 1) -> None:
+        source = self.queue
+        worker_source = getattr(source, "worker_source", None)
+        if worker_source is not None:
+            source = worker_source(index, nworkers)
         while not self._stop.is_set():
-            item = self.queue.get()
+            item = source.get()
             if item is None:
                 return
             self._process(item)
@@ -371,12 +432,23 @@ class Controller:
             satisfied and not deleting
             and job.status.observed_generation == job.metadata.generation
         ):
-            fp = self._sync_fingerprint(namespace, name, job)
-            with self._count_lock:
-                if fp == self._last_sync_fp.get(key):
-                    self.syncs_skipped_noop += 1
+            if self._nix is not None:
+                if self._native_fp_probe(key, namespace, name, job):
+                    with self._count_lock:
+                        self.syncs_skipped_noop += 1
                     trace.outcome = "noop-skip"
                     return
+                fp = _NATIVE_FP
+                with self._count_lock:
+                    self.fp_misses += 1
+            else:
+                fp = self._sync_fingerprint(namespace, name, job)
+                with self._count_lock:
+                    if fp == self._last_sync_fp.get(key):
+                        self.syncs_skipped_noop += 1
+                        trace.outcome = "noop-skip"
+                        return
+                    self.fp_misses += 1
 
         try:
             validate_job(job)
@@ -477,8 +549,42 @@ class Controller:
             fp is not None and not executed and not wrote
             and not requeued and plan.is_noop()
         ):
-            with self._count_lock:
-                self._last_sync_fp[key] = fp
+            if fp is _NATIVE_FP:
+                self._nix.fp_commit(key)
+            else:
+                with self._count_lock:
+                    self._last_sync_fp[key] = fp
+
+    def fp_stats(self) -> Tuple[int, int]:
+        """(hits, misses) of the no-op-sync fingerprint probe, whichever
+        path served it. Native counters are authoritative when the C++
+        index is wired (the Python `syncs_skipped_noop`/`fp_misses` pair
+        matches them; the native pair also counts probes issued by other
+        controllers sharing the index)."""
+        if self._nix is not None:
+            return self._nix.fp_counts()
+        with self._count_lock:
+            return (self.syncs_skipped_noop, self.fp_misses)
+
+    def publish_store_metrics(self) -> Dict[str, float]:
+        """Push fingerprint + store gauges into the `control.store`
+        registry subsystem (ISSUE satellite: objects per kind, index
+        buckets, fingerprint hits/misses, watch-queue depth high-water,
+        per-shard lock wait) and return the values."""
+        hits, misses = self.fp_stats()
+        vals: Dict[str, float] = {
+            "fingerprint_hits": float(hits),
+            "fingerprint_misses": float(misses),
+        }
+        for inf in (self.jobs, self.pods, self.services, self.lmservices):
+            store = getattr(inf, "_store", None) if inf is not None else None
+            publish = getattr(store, "publish_metrics", None)
+            if publish is not None:
+                vals.update(publish())
+        reg = registry()
+        reg.gauge("fingerprint_hits", "control.store").set(float(hits))
+        reg.gauge("fingerprint_misses", "control.store").set(float(misses))
+        return vals
 
     @staticmethod
     def _wants_health(job: TPUJob) -> bool:
@@ -487,6 +593,31 @@ class Controller:
         return bool(
             job.spec.runtime_id and not job.is_done()
             and not job.spec.suspend and job.worker_spec() is not None
+        )
+
+    def _native_fp_probe(
+        self, key: str, namespace: str, name: str, job: TPUJob
+    ) -> bool:
+        """Fingerprint probe through the native object index: same
+        observable world as _sync_fingerprint (job identity, owned pod and
+        service rvs by label bucket, slice health), but the pod/service
+        traversal happens inside the C++ core against the write-through
+        mirror — zero Python object walks. Returns True on a steady hit;
+        on a miss the candidate parks native-side for fp_commit."""
+        health = "-"
+        if self._wants_health(job):
+            health = repr(sorted(
+                (s.name, s.healthy)
+                for s in self.client.job_slices(
+                    job.metadata.uid, job.metadata.name)
+            ))
+        meta = job.metadata
+        ident = f"{meta.uid}|{meta.resource_version}|{meta.generation}"
+        return self._nix.fp_probe(
+            key, ident, namespace,
+            b"Pod", self._b_job_label, name,
+            b"Service", self._b_job_label, name,
+            health,
         )
 
     def _sync_fingerprint(self, namespace: str, name: str, job: TPUJob) -> Tuple:
@@ -734,8 +865,7 @@ class Controller:
         """Job object is gone: delete owned resources, release slices.
         (The reference leaks everything here — deletion handlers are stubs.)"""
         self.expectations.delete_expectations(f"{namespace}/{name}")
-        with self._count_lock:
-            self._last_sync_fp.pop(f"{namespace}/{name}", None)
+        self._forget_fp(f"{namespace}/{name}")
         uids = set()
         for pod in self.client.list_pods(namespace, {naming.LABEL_JOB: name}):
             ref = pod.metadata.controller_ref()
@@ -777,6 +907,49 @@ class Controller:
             trace.outcome = "deleted-cleanup"
             return
         deleting = svc.metadata.deletion_timestamp is not None
+
+        # No-op short-circuit, same contract as the job path: once status
+        # has observed the spec generation and neither the service rv nor
+        # any owned replica-pod rv moved since the last fully-steady sync,
+        # the claim/scale/status pass below is provably a no-op. (LMService
+        # fingerprints have no service bucket and no slice-health term —
+        # replica pods are the whole observable world.)
+        fp = None
+        if (
+            satisfied and not deleting
+            and svc.status.observed_generation == svc.metadata.generation
+        ):
+            meta = svc.metadata
+            if self._nix is not None:
+                ident = (f"{meta.uid}|{meta.resource_version}|"
+                         f"{meta.generation}")
+                if self._nix.fp_probe(
+                    key, ident, namespace,
+                    b"Pod", self._b_lmsvc_label, name,
+                    b"", b"", b"", b"-",
+                ):
+                    with self._count_lock:
+                        self.syncs_skipped_noop += 1
+                    trace.outcome = "noop-skip"
+                    return
+                fp = _NATIVE_FP
+                with self._count_lock:
+                    self.fp_misses += 1
+            else:
+                fp = (
+                    meta.uid, meta.resource_version, meta.generation,
+                    tuple(sorted(
+                        (p.metadata.uid, p.metadata.resource_version)
+                        for p in self.client.list_pods(
+                            namespace, {naming.LABEL_LMSERVICE: name})
+                    )),
+                )
+                with self._count_lock:
+                    if fp == self._last_sync_fp.get(key):
+                        self.syncs_skipped_noop += 1
+                        trace.outcome = "noop-skip"
+                        return
+                    self.fp_misses += 1
 
         try:
             validate_lmservice(svc)
@@ -859,9 +1032,20 @@ class Controller:
             if n in desired and p.status.phase == PodPhase.RUNNING
             and p.metadata.deletion_timestamp is None
         )
-        self._update_lmservice_status(namespace, name, ready)
+        wrote = self._update_lmservice_status(namespace, name, ready)
         if trace.outcome == "":
             trace.outcome = "executed" if executed else "steady"
+
+        # Record only after a provably steady pass (see the job path): the
+        # runtime-id stamp above counts as neither executed nor wrote, but
+        # its MODIFIED event re-enqueues the key with a new rv, so a
+        # prematurely recorded fingerprint self-corrects on the next sync.
+        if fp is not None and not executed and not wrote:
+            if fp is _NATIVE_FP:
+                self._nix.fp_commit(key)
+            else:
+                with self._count_lock:
+                    self._last_sync_fp[key] = fp
 
     def _lmservice_pod(self, svc: LMService, index: int) -> Pod:
         """One fully-specified serving-replica pod. No scheduling_group:
@@ -942,6 +1126,7 @@ class Controller:
     ) -> None:
         """LMService object is gone: delete its replica pods."""
         self.expectations.delete_expectations(key)
+        self._forget_fp(key)
         for pod in self.client.list_pods(
             namespace, {naming.LABEL_LMSERVICE: name}
         ):

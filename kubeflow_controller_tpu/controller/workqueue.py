@@ -215,3 +215,133 @@ class RateLimitingQueue:
                 self._queue or self._delayed_due
                 or self._processing or self._redo
             )
+
+
+def fnv1a_32(item: Hashable) -> int:
+    """Stable 32-bit FNV-1a of an item's string form — shard routing must
+    not depend on Python's seed-randomized hash()."""
+    h = 2166136261
+    for b in str(item).encode():
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+class _ShardGroupSource:
+    """A worker's view of its shard group: blocking get() over one or more
+    shards. With exactly one shard (the workers == shards sweet spot) it
+    blocks directly on that shard's condition variable; with several it
+    round-robins non-blocking gets with a short park between sweeps."""
+
+    def __init__(self, parent: "ShardedRateLimitingQueue", shards: List,
+                 poll: float = 0.005):
+        self._parent = parent
+        self._shards = shards
+        self._poll = poll
+
+    def get(self, timeout: Optional[float] = None):
+        if len(self._shards) == 1:
+            return self._shards[0].get(timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            for q in self._shards:
+                item = q.get(timeout=0)
+                if item is not None:
+                    return item
+            if self._parent.is_shutdown():
+                return None
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            # Cheap park between sweeps: a delayed item on any shard in the
+            # group surfaces within one poll interval.
+            item = self._shards[0].get(timeout=self._poll)
+            if item is not None:
+                return item
+
+
+class ShardedRateLimitingQueue:
+    """Key-range-sharded rate-limiting queue: N independent
+    RateLimitingQueues (native C++ ones when available) with FNV-routed
+    membership, presenting the single-queue interface.
+
+    A key always maps to the same shard, so every per-key contract —
+    dedup-while-queued, redo-after-done, per-item backoff state,
+    earliest-deadline delay collapsing — holds exactly as in the unsharded
+    queue; only cross-key FIFO order is relaxed to per-shard FIFO.
+    ``Controller.run(workers=N)`` binds each worker to a shard group via
+    ``worker_source`` so workers block on disjoint locks; the deterministic
+    ``drain()`` path uses the top-level ``get(timeout=0)`` sweep.
+    """
+
+    def __init__(self, shards: int, make_queue=None, **kwargs):
+        if make_queue is None:
+            def make_queue(**kw):
+                return RateLimitingQueue(**kw)
+        self.n_shards = max(1, int(shards))
+        self.shards = [make_queue(**kwargs) for _ in range(self.n_shards)]
+        self._next = 0  # rotating sweep start so no shard starves in drain
+        self._down = False
+
+    def _shard(self, item: Hashable):
+        return self.shards[fnv1a_32(item) % self.n_shards]
+
+    def add(self, item: Hashable) -> None:
+        self._shard(item).add(item)
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        self._shard(item).add_after(item, delay)
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        self._shard(item).add_rate_limited(item)
+
+    def forget(self, item: Hashable) -> None:
+        self._shard(item).forget(item)
+
+    def num_requeues(self, item: Hashable) -> int:
+        return self._shard(item).num_requeues(item)
+
+    def get(self, timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            start = self._next
+            self._next = (start + 1) % self.n_shards
+            for i in range(self.n_shards):
+                q = self.shards[(start + i) % self.n_shards]
+                item = q.get(timeout=0)
+                if item is not None:
+                    return item
+            if self._down:
+                return None
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            # Blocking path (rare: workers use worker_source instead):
+            # park briefly on shard 0 and re-sweep.
+            item = self.shards[0].get(timeout=0.005)
+            if item is not None:
+                return item
+
+    def worker_source(self, index: int, nworkers: int) -> _ShardGroupSource:
+        """Shard group for worker ``index`` of ``nworkers``: shard j goes to
+        worker j % nworkers. Extra workers past the shard count compete
+        over all shards (correct — the queues are multi-consumer safe)."""
+        mine = [self.shards[j] for j in range(self.n_shards)
+                if j % nworkers == index]
+        if not mine:
+            mine = list(self.shards)
+        return _ShardGroupSource(self, mine)
+
+    def done(self, item: Hashable) -> None:
+        self._shard(item).done(item)
+
+    def is_shutdown(self) -> bool:
+        return self._down
+
+    def shutdown(self) -> None:
+        self._down = True
+        for q in self.shards:
+            q.shutdown()
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self.shards)
+
+    def empty_and_idle(self) -> bool:
+        return all(q.empty_and_idle() for q in self.shards)

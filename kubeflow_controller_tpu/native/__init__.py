@@ -67,6 +67,34 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         c_void_p, c_char_p,
         ctypes.POINTER(c_int), ctypes.POINTER(c_int),
     ]
+
+    c_longlong = ctypes.c_longlong
+    lib.oix_new.restype = c_void_p
+    lib.oix_new.argtypes = []
+    lib.oix_free.argtypes = [c_void_p]
+    lib.oix_upsert.argtypes = [
+        c_void_p, c_char_p, c_char_p, c_char_p, c_longlong, c_longlong,
+        c_char_p,
+    ]
+    lib.oix_remove.argtypes = [c_void_p, c_char_p, c_char_p]
+    lib.oix_count.restype = c_int
+    lib.oix_count.argtypes = [c_void_p, c_char_p]
+    lib.oix_bucket_count.restype = c_int
+    lib.oix_bucket_count.argtypes = [c_void_p, c_char_p, c_char_p]
+    lib.oix_bucket_keys.restype = c_int
+    lib.oix_bucket_keys.argtypes = [
+        c_void_p, c_char_p, c_char_p, c_char_p, c_char_p, c_int,
+    ]
+    lib.oix_fp_probe.restype = c_int
+    lib.oix_fp_probe.argtypes = [
+        c_void_p, c_char_p, c_char_p, c_char_p, c_char_p, c_char_p,
+        c_char_p, c_char_p, c_char_p, c_char_p, c_char_p,
+    ]
+    lib.oix_fp_commit.argtypes = [c_void_p, c_char_p]
+    lib.oix_fp_forget.argtypes = [c_void_p, c_char_p]
+    lib.oix_fp_counts.argtypes = [
+        c_void_p, ctypes.POINTER(c_longlong), ctypes.POINTER(c_longlong),
+    ]
     return lib
 
 
@@ -92,7 +120,9 @@ def load() -> Optional[ctypes.CDLL]:
                 return None
         try:
             _lib = _configure(ctypes.CDLL(path))
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError == stale prebuilt .so missing newer symbols;
+            # treat it like an absent library rather than crashing imports.
             _lib = None
         return _lib
 

@@ -108,8 +108,21 @@ class LocalRuntime:
         default_policy: Optional[PodRunPolicy] = None,
         resync_period: float = 0.0,
         tracer=None,
+        workers: Optional[int] = None,
+        queue_shards: int = 1,
+        use_native_index: Optional[bool] = None,
+        watch_shards: int = 8,
     ):
-        self.cluster = FakeCluster(default_policy=default_policy)
+        # ``use_native_index``: None = auto (C++ object index when the lib
+        # loads), False = force the pure-Python fingerprint/label paths,
+        # True = require the lib. ``queue_shards``/``watch_shards`` size
+        # the key-range sharding of the workqueue and the per-subscriber
+        # watch delta queues.
+        self.cluster = FakeCluster(
+            default_policy=default_policy,
+            use_native_index=use_native_index,
+            watch_shards=watch_shards,
+        )
         self.client = FakeClusterClient(self.cluster)
         # Everything (stores, controller, scheduler) runs on the cluster's
         # simulated clock; threaded mode advances it from a wall-clock ticker.
@@ -117,8 +130,10 @@ class LocalRuntime:
         # wait, per-key sync, requeue events; None = no overhead.
         self._opts = ControllerOptions(
             now_fn=lambda: self.cluster.now, resync_period=resync_period,
-            tracer=tracer,
+            tracer=tracer, queue_shards=queue_shards,
         )
+        if workers is not None:
+            self._opts.workers = workers
         self._wire()
         self._ticker: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -223,8 +238,11 @@ class LocalRuntime:
 
     # -- threaded drive ------------------------------------------------------
 
-    def start_threads(self, workers: int = 2, tick_interval: float = 0.05) -> None:
-        self.controller.run(workers)
+    def start_threads(
+        self, workers: Optional[int] = None, tick_interval: float = 0.05
+    ) -> None:
+        self.controller.run(workers if workers is not None
+                            else self._opts.workers)
         def ticker() -> None:
             while not self._stop.wait(tick_interval):
                 self.cluster.tick(tick_interval)

@@ -279,3 +279,271 @@ class TestEarliestDeadline:
         time.sleep(0.08)             # let the parked deadline pass
         assert q.get(timeout=0.05) is None
         assert q.empty_and_idle()
+
+
+# ---------------------------------------------------------------------------
+# Object index + fingerprint parity (native mirror vs pure-Python paths)
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+class TestObjectIndexParity:
+    """Property battery: a random create/update/delete/label-churn soup
+    applied to the C++ ObjectIndex and to a pure-Python reference of the
+    same contract (the ObjectStore label-index shape). Buckets, counts,
+    and fingerprint hit/miss decisions must agree at every step."""
+
+    KINDS = ("Pod", "Service")
+    LABELS = ("training.tpu.io/job-name", "serving.tpu.io/lmservice")
+
+    def _make(self):
+        from kubeflow_controller_tpu.native.objindex import make_object_index
+
+        ix = make_object_index()
+        assert ix is not None
+        return ix
+
+    def test_random_soup_buckets_match(self):
+        import random
+
+        rng = random.Random(0xC0FFEE)
+        ix = self._make()
+        # Python reference: kind -> {key: (uid, rv, labels)}, plus the
+        # label index kind -> lk -> value -> set(keys).
+        objs = {k: {} for k in self.KINDS}
+        index = {k: {lk: {} for lk in self.LABELS} for k in self.KINDS}
+        keys = [f"default/obj-{i}" for i in range(40)]
+        rv = 0
+
+        def ref_remove(kind, key):
+            old = objs[kind].pop(key, None)
+            if old is None:
+                return
+            for lk, v in old[2].items():
+                bucket = index[kind][lk].get(v)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del index[kind][lk][v]
+
+        for step in range(600):
+            kind = rng.choice(self.KINDS)
+            key = rng.choice(keys)
+            op = rng.random()
+            if op < 0.75:  # upsert (create or update, maybe label churn)
+                rv += 1
+                uid = objs[kind].get(key, (f"u{rv}",))[0]
+                labels = {}
+                for lk in self.LABELS:
+                    if rng.random() < 0.6:
+                        labels[lk] = f"owner-{rng.randrange(6)}"
+                ref_remove(kind, key)
+                objs[kind][key] = (uid, rv, labels)
+                for lk, v in labels.items():
+                    index[kind][lk].setdefault(v, set()).add(key)
+                ix.upsert(kind, key, uid, rv, 1, labels)
+            else:  # delete
+                ref_remove(kind, key)
+                ix.remove(kind, key)
+
+            if step % 50 == 49:  # full cross-check periodically
+                for k in self.KINDS:
+                    assert ix.count(k) == len(objs[k])
+                    for lk in self.LABELS:
+                        for v, members in index[k][lk].items():
+                            assert set(ix.bucket(k, lk, v)) == members, (
+                                step, k, lk, v)
+                        # and no phantom buckets on the native side
+                        for v in [f"owner-{i}" for i in range(6)]:
+                            if v not in index[k][lk]:
+                                assert ix.bucket(k, lk, v) == []
+
+    def test_fingerprint_decisions_match_python_tuples(self):
+        """Drive the probe/commit protocol through a churn sequence and
+        assert each hit/miss agrees with the Python tuple-compare spec."""
+        import random
+
+        rng = random.Random(7)
+        ix = self._make()
+        LK = self.LABELS[0]
+        last_fp = {}   # Python reference: job key -> fp tuple
+        rv = 0
+        jobs = [f"default/job-{i}" for i in range(4)]
+
+        def py_fp(job):
+            name = job.split("/", 1)[1]
+            pods = []
+            for key, (uid, krv, labels) in pod_objs.items():
+                if labels.get(LK) == name:
+                    pods.append((uid, krv))
+            return (job_meta[job], tuple(sorted(pods)))
+
+        pod_objs = {}
+        job_meta = {}
+        for step in range(300):
+            job = rng.choice(jobs)
+            name = job.split("/", 1)[1]
+            op = rng.random()
+            if op < 0.3:   # pod churn under the job
+                rv += 1
+                pkey = f"default/{name}-pod-{rng.randrange(3)}"
+                pod_objs[pkey] = (f"pu-{pkey}", rv, {LK: name})
+                ix.upsert("Pod", pkey, f"pu-{pkey}", rv, 1, {LK: name})
+            elif op < 0.4:  # pod delete
+                pkey = f"default/{name}-pod-{rng.randrange(3)}"
+                pod_objs.pop(pkey, None)
+                ix.remove("Pod", pkey)
+            elif op < 0.5:  # job rv bump (annotation churn)
+                rv += 1
+                job_meta[job] = f"ju-{job}|{rv}|1"
+            if job not in job_meta:
+                rv += 1
+                job_meta[job] = f"ju-{job}|{rv}|1"
+
+            # probe: native decision must equal the Python tuple compare
+            fp = py_fp(job)
+            expect_hit = last_fp.get(job) == fp
+            got_hit = ix.fp_probe(
+                job, job_meta[job], "default",
+                "Pod", LK, name, "", "", "", "-")
+            assert got_hit == expect_hit, (step, job)
+            if not got_hit and rng.random() < 0.8:
+                # commit the pending candidate (the steady sync completing)
+                ix.fp_commit(job)
+                last_fp[job] = fp
+            # (uncommitted misses model syncs that wrote status: the next
+            # probe must still compare against the OLD committed fp)
+
+        hits, misses = ix.fp_counts()
+        assert hits + misses == 300
+        assert hits > 0 and misses > 0
+
+    def test_forget_clears_committed_and_pending(self):
+        ix = self._make()
+        ix.upsert("Pod", "default/a-pod-0", "pu", 1, 1,
+                  {self.LABELS[0]: "a"})
+        assert not ix.fp_probe("default/a", "u|1|1", "default",
+                               "Pod", self.LABELS[0], "a", "", "", "", "-")
+        ix.fp_commit("default/a")
+        assert ix.fp_probe("default/a", "u|1|1", "default",
+                           "Pod", self.LABELS[0], "a", "", "", "", "-")
+        ix.fp_forget("default/a")
+        assert not ix.fp_probe("default/a", "u|1|1", "default",
+                               "Pod", self.LABELS[0], "a", "", "", "", "-")
+
+
+class TestRuntimeIndexParity:
+    """End-to-end: the SAME deterministic job/lmservice soup driven through
+    a native-index runtime and a forced-Python runtime must produce
+    identical sync decisions — skip counts, label-selected sets, watch
+    delta order, and final object state."""
+
+    def _soup(self, use_native):
+        import random
+
+        from kubeflow_controller_tpu.api.core import (
+            Container, ObjectMeta, PodSpec, PodTemplateSpec, thaw,
+        )
+        from kubeflow_controller_tpu.api.types import (
+            LMService, LMServiceSpec, ReplicaSpec, ReplicaType, TPUJob,
+            TPUJobSpec, TPUSliceSpec,
+        )
+        from kubeflow_controller_tpu.cluster.cluster import PodRunPolicy
+        from kubeflow_controller_tpu.runtime import LocalRuntime
+
+        rng = random.Random(42)
+        rt = LocalRuntime(
+            PodRunPolicy(start_delay=1, run_duration=10 ** 9),
+            use_native_index=use_native,
+        )
+        rt.cluster.slice_pool.add_pool("v5p-8", 64)
+        # runtime_id generation must be identical across the two runtimes
+        # (it lands in pod names, which land in the compared deltas)
+        rt._opts.rng = random.Random(99)
+        deltas = []
+
+        def listen(ev):
+            deltas.append((ev.type.value, ev.kind,
+                           ev.obj.metadata.namespace,
+                           ev.obj.metadata.name,
+                           ev.obj.metadata.resource_version))
+
+        rt.cluster.jobs.subscribe(listen)
+        rt.cluster.pods.subscribe(listen)
+
+        for i in range(6):
+            rt.submit(TPUJob(
+                metadata=ObjectMeta(name=f"par-{i}", namespace="default"),
+                spec=TPUJobSpec(replica_specs=[ReplicaSpec(
+                    replica_type=ReplicaType.WORKER,
+                    template=PodTemplateSpec(spec=PodSpec(containers=[
+                        Container(name="t", image="jax:latest")])),
+                    tpu=TPUSliceSpec(accelerator_type="v5p-8",
+                                     num_slices=1),
+                )]),
+            ))
+        for i in range(2):
+            rt.submit_lmservice(LMService(
+                metadata=ObjectMeta(name=f"srv-{i}", namespace="default"),
+                spec=LMServiceSpec(model="tiny", replicas=2),
+            ))
+        rt.step(dt=1.0, steps=5)
+
+        # resync waves + metadata churn, deterministically interleaved
+        for round_ in range(4):
+            for inf in (rt.job_informer, rt.pod_informer,
+                        rt.service_informer, rt.lmservice_informer):
+                inf.resync()
+            while rt.controller.drain(max_items=5000):
+                pass
+            i = rng.randrange(6)
+            j = thaw(rt.cluster.jobs.try_get("default", f"par-{i}"))
+            j.metadata.annotations["churn"] = f"r{round_}"
+            rt.cluster.jobs.update(j)
+            rt.step(dt=1.0, steps=2)
+        for inf in (rt.job_informer, rt.pod_informer,
+                    rt.service_informer, rt.lmservice_informer):
+            inf.resync()
+        while rt.controller.drain(max_items=5000):
+            pass
+        for store in (rt.cluster.jobs, rt.cluster.pods,
+                      rt.cluster.services, rt.cluster.lmservices):
+            store.flush()
+
+        from kubeflow_controller_tpu.tpu import naming
+
+        selected = {
+            name: sorted(
+                p.metadata.name for p in rt.cluster.pods.list(
+                    "default", {naming.LABEL_JOB: name}))
+            for name in (f"par-{i}" for i in range(6))
+        }
+        state = {
+            j.metadata.name: (j.status.phase.value,
+                              j.metadata.resource_version,
+                              j.status.observed_generation)
+            for j in rt.cluster.jobs.list("default")
+        }
+        stats = (rt.controller.syncs_skipped_noop, rt.controller.fp_misses,
+                 rt.controller.fp_stats())
+        rt.stop()
+        return deltas, selected, state, stats
+
+    @needs_native
+    def test_native_and_python_paths_agree(self):
+        d_py, sel_py, state_py, stats_py = self._soup(use_native=False)
+        d_nx, sel_nx, state_nx, stats_nx = self._soup(use_native=None)
+        assert d_py == d_nx          # watch delta order, event for event
+        assert sel_py == sel_nx      # label-selected sets
+        assert state_py == state_nx  # final object state
+        # identical skip/run decisions: Python counters agree, and the
+        # native hit/miss counters match the Python-path pair exactly
+        assert stats_py[:2] == stats_nx[:2]
+        assert stats_nx[2] == (stats_nx[0], stats_nx[1])
+
+    def test_python_fallback_runs_without_lib(self):
+        # Always runs (no native mark): the forced-Python path must be
+        # fully functional on its own.
+        deltas, selected, state, stats = self._soup(use_native=False)
+        assert state and all(s[0] == "Running" for s in state.values())
+        assert stats[0] > 0          # resync waves actually skipped

@@ -739,6 +739,92 @@ class TestWatchOverflowResync:
         assert lossy == truth
 
 
+
+
+class TestReplayOffLock:
+    """subscribe(replay=True) takes only the snapshot under the store lock;
+    the replay entries are enqueued OFF the write lock, and in frozen mode
+    replay is zero-copy (delivered objects ARE the stored snapshots).
+    Pins the PR-18 rewrite: before it, a large-store subscribe stalled
+    every writer for the whole synthesis loop and legacy mode deep-copied
+    each replayed object under that stall."""
+
+    def test_frozen_replay_is_zero_copy(self):
+        from kubeflow_controller_tpu.api.core import deepcopy_count
+
+        store = ObjectStore("Pod", copy_on_read=False, watch_shards=4)
+        for i in range(100):
+            store.create(make_pod(f"p{i:03d}"))
+
+        got = []
+        dc0 = deepcopy_count()
+        store.subscribe(lambda ev: got.append(ev.obj), replay=True)
+        store.flush()
+        assert deepcopy_count() == dc0          # zero copies end to end
+        assert len(got) == 100
+        by_name = {o.metadata.name: o for o in got}
+        for i in range(100):
+            # identity, not equality: the delivered object IS the snapshot
+            assert by_name[f"p{i:03d}"] is store.try_get(
+                "default", f"p{i:03d}")
+
+    def test_replay_races_writers_rv_monotonic(self):
+        """Writers running concurrently with subscribe(replay=True) are
+        never blocked behind the replay loop, and the subscriber still
+        observes per-key rv-monotonic order converging on final state."""
+        store = ObjectStore("Pod", copy_on_read=False, watch_shards=4)
+        names = [f"p{i:02d}" for i in range(40)]
+        for n in names:
+            store.create(make_pod(n))
+
+        seen = defaultdict(list)
+        seen_lock = threading.Lock()
+
+        def listener(ev):
+            with seen_lock:
+                seen[ev.obj.metadata.name].append(
+                    ev.obj.metadata.resource_version)
+
+        stop = threading.Event()
+
+        def writer(idx):
+            k = 0
+            while not stop.is_set():
+                n = names[(idx * 7 + k) % len(names)]
+                k += 1
+                try:
+                    cur = store.try_get("default", n)
+                    if cur is None:
+                        continue
+                    upd = cur.deepcopy()
+                    upd.metadata.labels["w"] = f"{idx}-{k}"
+                    store.update(upd)
+                except (Conflict, NotFound):
+                    continue
+
+        def subscriber():
+            store.subscribe(listener, replay=True)
+
+        def stopper():
+            # let the writers overlap the replay window, then stop them
+            threading.Event().wait(0.2)
+            stop.set()
+
+        run_threads([lambda i=i: writer(i) for i in range(4)]
+                    + [subscriber, stopper])
+        assert store.flush()
+
+        final = {n: store.try_get("default", n).metadata.resource_version
+                 for n in names}
+        for n in names:
+            rvs = seen[n]
+            assert rvs, f"{n} never replayed"
+            # replay ADDED first, then only newer rvs: strictly monotonic
+            assert rvs == sorted(rvs), f"{n} out of order: {rvs}"
+            assert len(set(rvs)) == len(rvs), f"{n} duplicated: {rvs}"
+            assert rvs[-1] == final[n]
+
+
 def test_chaos_soak_pointer():
     """The end-to-end concurrency storm (controller + informers + REST +
     scheduler threads) lives in tests/test_chaos.py; this file is the
