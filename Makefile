@@ -4,9 +4,9 @@
 PY ?= python
 
 .PHONY: all native test test-fast test-native test-tp test-obs \
-	test-sampling test-pallas bench \
+	test-sampling test-pallas test-faults bench \
 	bench-cp bench-cp-sweep bench-serve bench-overload bench-prefix \
-	bench-fleet \
+	bench-fleet bench-chaos \
 	bench-disagg bench-kv-tier \
 	bench-spec bench-paged bench-tp bench-prefill bench-obs bench-sampling \
 	clean stamp
@@ -38,6 +38,16 @@ test-native: native
 # no-op tracer bit-identity, flush-on-every-exit-path.
 test-obs:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs.py -q
+
+# Fault-injection guard: the deterministic chaos layer (plan/spec
+# scoping, seeded prob thinning, injector-off bit-identity) plus the
+# hardening it gates — watchdog hang ejection + re-dispatch, parked
+# deadline sheds, idempotent migration retries, tier-read degradation,
+# informer delivery loss healed by resync, and the seeded fault-soup
+# conservation property. Includes the slow sweep (17 extra soup seeds
+# + the full chaos bench matrix); drop `-m ''` for tier-1 only.
+test-faults:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_faults.py -q -m ''
 
 # Sharded-engine guard: the tensor-parallel serving tests on the forced
 # 8-virtual-device CPU mesh (tests/conftest.py sets the same flag for
@@ -124,6 +134,20 @@ bench-fleet:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/fleet_bench.py --smoke \
 		--trace /tmp/fleet_trace.json \
 		--json benchmarks/fleet_bench_summary.json
+
+# Chaos benchmark: the seeded fault matrix (crash / hang / slow /
+# refuse_admit / drop_migration / tier_io_error) over real-engine
+# fleets on a virtual clock. Hard gates per fault class: completions +
+# rejections + cancellations == arrivals, zero duplicate surfaced
+# completions, leak-free pools and tiers after drain — plus >=0.8
+# deadline-met goodput retention with one hung replica of four under
+# the progress watchdog, and the fault-free injector-on leg
+# bit-identical to injector-off. Exits nonzero if any gate fails. The
+# checked-in summary comes from the full (non --smoke) run — see
+# benchmarks/RESULTS.md and docs/chaos.md.
+bench-chaos:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/chaos_bench.py \
+		--json benchmarks/chaos_bench_summary.json
 
 # Prefill/decode disaggregation leg only (capacity probe + leg 5 of
 # fleet_bench.py): one prefill-role replica feeding decode-role

@@ -1,0 +1,420 @@
+"""Chaos benchmark: the seeded fault matrix over the serving fleet.
+
+Drives real ``ServingEngine`` replicas behind a ``FleetRouter`` on a
+VIRTUAL clock (every fault decision and arrival is a pure function of
+the seed, so any leg replays bit-for-bit) and injects every fault kind
+the :mod:`kubeflow_controller_tpu.dataplane.faults` taxonomy defines.
+Three legs, each with hard acceptance gates:
+
+* **identity** — the SAME workload through injector=None and through an
+  attached injector whose plan never fires: token streams must be
+  bit-identical and every fault counter zero. This is the contract that
+  makes an always-on injector safe to ship.
+* **matrix** — one leg per fault kind (``crash``, ``hang``, ``slow``,
+  ``refuse_admit``, ``drop_migration`` on a disaggregated fleet,
+  ``tier_io_error`` on a host-tier fleet). Gates, per kind:
+  completions + rejections + cancellations == arrivals (nothing
+  silently dropped), zero duplicate surfaced completions, and a
+  leak-free fleet after drain (device pool == resident trie nodes;
+  host tier drains to zero pages on clear). Each leg also asserts its
+  faults actually FIRED — a gate that passes because the plan never
+  bit is no gate at all.
+* **hung-goodput** — the same arrival schedule with and without ONE of
+  four replicas hanging mid-run (progress watchdog on in both legs):
+  deadline-met goodput retention must be >= 0.8. The watchdog strikes
+  on heartbeat staleness, ejects, re-dispatches in-flight rids; outcome
+  dedup absorbs the stale copies when the hang clears.
+
+Prints one JSON object; ``--json`` also writes it to a file. Run via
+``make bench-chaos`` (smoke config) — full numbers live in
+benchmarks/RESULTS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+class VClock:
+    """Deterministic virtual clock: the bench advances it explicitly."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def make_requests(cfg, n: int, seed: int, shared_len: int = 12,
+                  max_new: int = 5, deadline_s: Optional[float] = None,
+                  n_prompts: int = 3):
+    import numpy as np
+
+    from kubeflow_controller_tpu.dataplane.serving_engine import Request
+
+    rng = np.random.default_rng(seed)
+    systems = [rng.integers(0, cfg.vocab_size, shared_len)
+               for _ in range(n_prompts)]
+    out = []
+    for i in range(n):
+        sysp = systems[int(rng.integers(0, n_prompts))]
+        tail = rng.integers(0, cfg.vocab_size, 1 + int(rng.integers(0, 4)))
+        out.append(Request(
+            rid=i, prompt=np.concatenate([sysp, tail]).astype(np.int32),
+            max_new_tokens=max_new, deadline_s=deadline_s))
+    return out
+
+
+def poisson_arrivals(rate_rps: float, n: int, seed: int) -> List[float]:
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    out, t = [], 0.0
+    for _ in range(n):
+        t += float(rng.exponential(1.0 / rate_rps))
+        out.append(t)
+    return out
+
+
+def drive_virtual(router, reqs, arrivals, clock, dt: float = 0.05,
+                  max_steps: int = 40_000) -> float:
+    """Release arrivals on the virtual schedule and step until every
+    request reached an outcome. Returns the virtual drain time."""
+    i = 0
+    for _ in range(max_steps):
+        while i < len(arrivals) and arrivals[i] <= clock.t:
+            router.submit(reqs[i])
+            i += 1
+        if i >= len(reqs) and router.idle:
+            return clock.t
+        router.step()
+        clock.t += dt
+    raise RuntimeError(
+        f"fleet did not drain: {router.pending} pending, "
+        f"{router.outcome_counts}")
+
+
+def stream_map(router) -> Dict:
+    return {(c.rid, c.gen): (c.finish_reason, tuple(c.tokens))
+            for c in router.completions}
+
+
+def check_conserved(router, n: int, leg: str, problems: List[str]) -> bool:
+    counts = router.outcome_counts
+    total = counts["completed"] + counts["rejected"] + counts["cancelled"]
+    ok = True
+    if total != n or router.pending != 0:
+        problems.append(f"[{leg}] drop: {n} arrivals, {counts}, "
+                        f"{router.pending} pending")
+        ok = False
+    keys = [(c.rid, c.gen) for c in router.completions]
+    if len(keys) != len(set(keys)):
+        problems.append(f"[{leg}] duplicate surfaced completion")
+        ok = False
+    return ok
+
+
+def check_leakfree(router, leg: str, problems: List[str]) -> bool:
+    """Every LIVE replica: no occupied slots, device pool holds exactly
+    the resident trie nodes, and (tiered) clear drains the host tier."""
+    ok = True
+    for h in router.replicas:
+        eng = h.engine
+        if any(s is not None for s in eng.slots):
+            problems.append(f"[{leg}] {h.name}: occupied slot after drain")
+            ok = False
+        n_resident = 0
+        stack = list(eng._prefix_store.trie.root.children.values())
+        while stack:
+            nd = stack.pop()
+            if nd.block >= 0:
+                n_resident += 1
+            stack.extend(nd.children.values())
+        if eng.pool.used_blocks != n_resident:
+            problems.append(
+                f"[{leg}] {h.name}: {eng.pool.used_blocks} pool blocks "
+                f"vs {n_resident} resident trie nodes")
+            ok = False
+        if eng._host_tier is not None:
+            eng._prefix_store.clear()
+            if eng.pool.used_blocks != 0:
+                problems.append(f"[{leg}] {h.name}: device pool leaked")
+                ok = False
+            if eng._host_tier.resident_pages != 0:
+                problems.append(f"[{leg}] {h.name}: host tier leaked "
+                                f"{eng._host_tier.resident_pages} pages")
+                ok = False
+    return ok
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="tiny")
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--rate", type=float, default=12.0,
+                   help="virtual arrivals per virtual second")
+    p.add_argument("--deadline-s", type=float, default=2.0,
+                   help="virtual-time deadline for the goodput leg "
+                        "(tight enough that a full hang window misses)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--smoke", action="store_true",
+                   help="small fast config for CI")
+    p.add_argument("--json", default="", help="also write the summary here")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.requests = 14
+
+    import jax
+
+    from kubeflow_controller_tpu.dataplane.entrypoints.lm import CONFIGS
+    from kubeflow_controller_tpu.dataplane.faults import (
+        FaultInjector, FaultPlan, FaultSpec,
+    )
+    from kubeflow_controller_tpu.dataplane.router import FleetRouter
+    from kubeflow_controller_tpu.dataplane.serving_engine import ServingEngine
+    from kubeflow_controller_tpu.models import generate as gen
+    from kubeflow_controller_tpu.models import transformer as tfm
+
+    cfg = CONFIGS[args.config]()
+    params = gen.inference_params(
+        cfg, tfm.init_params(cfg, jax.random.key(0)))
+    N = args.requests
+
+    def mk_engine(clock, injector=None, **kw):
+        kw.setdefault("n_slots", 2)
+        kw.setdefault("max_seq", 64)
+        kw.setdefault("max_queue", 8)
+        return ServingEngine(
+            cfg, params, prefill_mode="bucketed", block_size=4,
+            prefix_cache=True, clock=clock, injector=injector, **kw)
+
+    # ONE virtual clock and ONE warm engine pool for the whole bench:
+    # a fresh ServingEngine pays trace time on first use and the matrix
+    # needs ~29 engine seats — reset() keeps the compiled functions, so
+    # each leg leases reset engines, rewinds the clock to 0, and
+    # rebinds the leg's injector. reset() is pinned bit-clean by the
+    # serving tests, so reuse cannot bleed state between legs.
+    clock = VClock()
+    _pool: List = []
+    _tier_pool: List = []
+
+    def lease(n, injector):
+        while len(_pool) < n:
+            _pool.append(mk_engine(clock))
+        out = _pool[:n]
+        for eng in out:
+            eng.reset()
+            eng._injector = injector
+        return out
+
+    def lease_tiered(n, injector):
+        while len(_tier_pool) < n:
+            _tier_pool.append(mk_engine(
+                clock, max_seq=32, kv_pool_blocks=12, host_kv_mb=64.0))
+        out = _tier_pool[:n]
+        for eng in out:
+            eng._injector = injector
+            eng._host_tier.injector = injector
+            eng.reset()                       # rebuilds tier w/ injector
+        return out
+
+    def colocated(n=4, injector=None, tiered=False, **router_kw):
+        clock.t = 0.0
+        router = FleetRouter(clock=clock, block_size=4, injector=injector,
+                             **router_kw)
+        engines = (lease_tiered(n, injector) if tiered
+                   else lease(n, injector))
+        for i, eng in enumerate(engines):
+            router.add_replica(f"r{i}", eng)
+        return router
+
+    def disagg(injector=None):
+        clock.t = 0.0
+        router = FleetRouter(clock=clock, block_size=4, injector=injector)
+        engines = lease(3, injector)
+        router.add_replica("prefill-0", engines[0], role="prefill")
+        for i in range(2):
+            router.add_replica(f"decode-{i}", engines[1 + i], role="decode")
+        return router
+
+    gates: Dict[str, bool] = {}
+    legs: Dict[str, Dict] = {}
+    problems: List[str] = []
+
+    # -- leg 1: identity ---------------------------------------------------
+
+    def run_identity(inj):
+        router = colocated(n=2, injector=inj)
+        reqs = make_requests(cfg, N, seed=args.seed)
+        arr = poisson_arrivals(args.rate, N, seed=args.seed + 1)
+        wall = drive_virtual(router, reqs, arr, clock)
+        return stream_map(router), router.fleet_summary(), wall
+
+    off_stream, off_sum, _ = run_identity(None)
+    on_stream, on_sum, _ = run_identity(
+        FaultInjector(FaultPlan(), clock=clock, seed=args.seed))
+    gates["identity_bit_identical"] = (
+        on_stream == off_stream
+        and on_sum["faults_injected"] == 0.0
+        and on_sum["completed"] == off_sum["completed"])
+    legs["identity"] = {
+        "requests": N,
+        "completed": off_sum["completed"],
+        "streams_match": on_stream == off_stream,
+    }
+
+    # -- leg 2: the fault matrix ------------------------------------------
+
+    def matrix_leg(kind, plan, fleet_fn, deadline_s=None,
+                   fired_check=None, **router_kw):
+        inj = FaultInjector(plan, clock=clock, seed=args.seed)
+        router = fleet_fn(inj, **router_kw)
+        reqs = make_requests(cfg, N, seed=args.seed + 7,
+                             deadline_s=deadline_s)
+        arr = poisson_arrivals(args.rate, N, seed=args.seed + 8)
+        wall = drive_virtual(router, reqs, arr, clock)
+        conserved = check_conserved(router, N, kind, problems)
+        leakfree = check_leakfree(router, kind, problems)
+        fired = inj.total_fires > 0
+        if not fired:
+            problems.append(f"[{kind}] plan never fired")
+        if fired_check is not None and not fired_check(router, inj):
+            problems.append(f"[{kind}] hardening path not exercised")
+            fired = False
+        gates[f"conserved_{kind}"] = conserved
+        gates[f"leakfree_{kind}"] = leakfree
+        gates[f"fired_{kind}"] = fired
+        legs[kind] = {
+            "fires": inj.total_fires,
+            "outcomes": dict(router.outcome_counts),
+            "drain_virtual_s": round(wall, 3),
+            "summary": {k: router.fleet_summary()[k] for k in (
+                "faults_injected", "migrate_dedups", "watchdog_strikes",
+                "dispatch_timeouts", "migration_timeouts",
+                "deadline_sheds")},
+        }
+
+    def colo(inj, **kw):
+        return colocated(n=4, injector=inj, **kw)
+
+    matrix_leg(
+        "crash",
+        FaultPlan([FaultSpec(kind="crash", site="router.replica_step",
+                             target="r1", after=0.4, max_fires=1)]),
+        colo,
+        fired_check=lambda r, i: len(r.replicas) == 3)
+
+    matrix_leg(
+        "hang",
+        FaultPlan([FaultSpec(kind="hang", site="engine.step", target="r1",
+                             after=0.4, until=1.6)]),
+        colo, watchdog_stale_s=0.3,
+        fired_check=lambda r, i: r.watchdog_strikes > 0)
+
+    matrix_leg(
+        "slow",
+        FaultPlan([FaultSpec(kind="slow", site="engine.step", target="r1",
+                             factor=4, after=0.0, until=2.5)]),
+        colo)
+
+    matrix_leg(
+        "refuse_admit",
+        FaultPlan([FaultSpec(kind="refuse_admit", site="engine.submit",
+                             prob=0.4)]),
+        colo,
+        fired_check=lambda r, i:
+            r.fleet_summary()["faults_injected"] > 0)
+
+    matrix_leg(
+        "drop_migration",
+        FaultPlan([
+            FaultSpec(kind="drop_migration", site="router.migrate",
+                      max_fires=1),
+            FaultSpec(kind="drop_migration", site="router.migrate_ack",
+                      max_fires=1),
+        ]),
+        lambda inj, **kw: disagg(injector=inj),
+        fired_check=lambda r, i:
+            r.fleet_summary()["migration_timeouts"] >= 2
+            and r.fleet_summary()["migrate_dedups"] >= 1)
+
+    matrix_leg(
+        "tier_io_error",
+        FaultPlan([FaultSpec(kind="tier_io_error", site="tier.read",
+                             prob=0.5)]),
+        lambda inj, **kw: colocated(n=2, injector=inj, tiered=True, **kw),
+        fired_check=lambda r, i: any(
+            h.engine._host_tier.io_errors > 0 for h in r.replicas))
+
+    # -- leg 3: hung-replica goodput retention ----------------------------
+    # Retention is measured on DEADLINE-MET TOKENS over the identical
+    # arrival schedule (not tokens/drain-time: the hang's own recovery
+    # tail inflates the makespan of an otherwise-perfect leg). The
+    # deadline is tight enough that work stranded on the hung replica
+    # for the full window would miss it — the watchdog's re-dispatch is
+    # what keeps those tokens inside the budget.
+
+    def goodput_leg(plan):
+        inj = (FaultInjector(plan, clock=clock, seed=args.seed)
+               if plan is not None else None)
+        router = colocated(n=4, injector=inj, watchdog_stale_s=0.3)
+        reqs = make_requests(cfg, N, seed=args.seed + 13,
+                             deadline_s=args.deadline_s)
+        arr = poisson_arrivals(args.rate, N, seed=args.seed + 14)
+        drive_virtual(router, reqs, arr, clock)
+        good = 0
+        for c in router.completions:
+            if (c.finish_reason in ("eos", "length")
+                    and c.done_t - c.submit_t <= args.deadline_s):
+                good += len(c.tokens)
+        conserved = check_conserved(router, N, "goodput", problems)
+        return good, conserved, router
+
+    base_good, base_ok, _ = goodput_leg(None)
+    hung_good, hung_ok, hung_router = goodput_leg(FaultPlan([
+        FaultSpec(kind="hang", site="engine.step", target="r2",
+                  after=0.4, until=2.0)]))
+    retention = hung_good / base_good if base_good > 0 else 0.0
+    hung_fired = (hung_router.watchdog_strikes > 0
+                  and hung_router.redispatched > 0)
+    if not hung_fired:
+        problems.append("[goodput] hang never struck the watchdog")
+    gates["conserved_goodput"] = base_ok and hung_ok
+    gates["fired_goodput_hang"] = hung_fired
+    gates["goodput_retention"] = retention >= 0.8
+    legs["hung_goodput"] = {
+        "baseline_good_tokens": base_good,
+        "hung_good_tokens": hung_good,
+        "retention": round(retention, 3),
+        "watchdog_strikes": hung_router.watchdog_strikes,
+        "redispatched": hung_router.redispatched,
+    }
+
+    out = {
+        "config": args.config,
+        "requests": N,
+        "seed": args.seed,
+        "smoke": bool(args.smoke),
+        "legs": legs,
+        "gates": gates,
+        "problems": problems,
+        "acceptance": all(gates.values()),
+    }
+    print(json.dumps(out, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    return 0 if out["acceptance"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,6 +109,11 @@ class ControllerOptions:
     # noop short-circuit included), and requeue/backoff events, all on
     # the "control" track keyed by workqueue key. None = zero overhead.
     tracer: Optional[object] = None
+    # Optional dataplane.faults.FaultInjector (docs/chaos.md): threaded
+    # onto every informer this controller wires handlers to, so a plan
+    # can stall watch delivery ("informer.deliver" hangs) and prove the
+    # resync sweep heals the loss. None = off, byte-identical.
+    injector: Optional[object] = None
 
 
 @dataclass
@@ -190,6 +195,12 @@ class Controller:
         self._enqueue_t: Dict[str, float] = {}
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+
+        if self.opts.injector is not None:
+            for inf in (job_informer, pod_informer, service_informer,
+                        lmservice_informer):
+                if inf is not None and hasattr(inf, "injector"):
+                    inf.injector = self.opts.injector
 
         job_informer.add_handler(self._on_job_event)
         pod_informer.add_handler(self._on_resource_event)

@@ -30,9 +30,19 @@ Handler = Callable[[WatchEvent], None]
 
 
 class Informer:
-    def __init__(self, store: ObjectStore, resync_period: float = 0.0):
+    def __init__(self, store: ObjectStore, resync_period: float = 0.0,
+                 injector=None):
         self._store = store
         self.kind = store.kind
+        # Fault injection (docs/chaos.md): an injected hang at
+        # "informer.deliver" models a stalled watch delivery — the cache
+        # still updates (the apiserver stream arrived) but handlers are
+        # not notified, exactly the edge-trigger loss a periodic
+        # resync() exists to heal. None = off, byte-identical path.
+        # Mutable attribute so the controller can thread one injector
+        # through informers it did not construct.
+        self.injector = injector
+        self.deliveries_suppressed = 0
         self._cache: Dict[str, Any] = {}
         self._lock = threading.RLock()
         self._handlers: List[Handler] = []
@@ -116,6 +126,17 @@ class Informer:
             else:
                 self._cache[key] = ev.obj
                 self._index_add(key, ev.obj)
+        inj = self.injector
+        if inj is not None and inj.fires(
+                "control", "informer.deliver", target=self.kind,
+                kinds=("hang",)) is not None:
+            # Delivery stalls AFTER the cache update: listers stay
+            # fresh, but no handler enqueues work for this event.
+            # resync() (the level-trigger sweep) re-delivers from the
+            # cache and heals the loss — which is why injection never
+            # touches the resync path.
+            self.deliveries_suppressed += 1
+            return
         for h in list(self._handlers):
             h(ev)
 

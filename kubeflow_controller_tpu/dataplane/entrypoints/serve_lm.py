@@ -168,6 +168,9 @@ def serve(
     mesh_devices: str = "",
     trace: str = "",
     disagg: bool = False,
+    fault_plan: str = "",
+    fault_seed: int = 0,
+    watchdog_stale_s: float = 0.0,
     stop=None,
 ) -> Dict[str, float]:
     """``stop`` is a ``threading.Event`` (e.g. from
@@ -192,6 +195,46 @@ def serve(
 
     ctx = ctx or ProcessContext.from_env()
     tracer = Tracer(path=trace) if trace else None
+    # Deterministic fault injection (docs/chaos.md): --fault-plan names
+    # a JSON FaultPlan; the ONE injector (on the serving wall clock, the
+    # same clock the router runs on) threads through every engine and
+    # the router so a plan's activation windows line up across planes.
+    # Off (the default) leaves every path byte-identical.
+    injector = None
+    if fault_plan:
+        from kubeflow_controller_tpu.dataplane import faults
+
+        class _RelClock:
+            """Rebased to the FIRST fault-site check, so plan windows
+            are seconds from when serving actually starts stepping —
+            perf_counter is CLOCK_MONOTONIC (seconds since boot) and
+            would put every relative window in the unreachable past,
+            and rebasing at construction would burn the window on the
+            first jit compile instead of on served traffic."""
+
+            t0 = None
+
+            def __call__(self):
+                now = time.perf_counter()
+                if self.t0 is None:
+                    self.t0 = now
+                return now - self.t0
+
+        injector = faults.FaultInjector(
+            faults.load_plan(fault_plan), clock=_RelClock(),
+            seed=fault_seed, tracer=tracer)
+    if watchdog_stale_s < 0:
+        raise ValueError(
+            f"--watchdog-stale-s must be >= 0 (got {watchdog_stale_s})")
+    if watchdog_stale_s > 0 and not disagg:
+        raise ValueError(
+            "--watchdog-stale-s is the fleet router's progress watchdog "
+            "and requires --disagg (the single-engine path has no "
+            "router to strike replicas out)")
+    if fault_plan and turns > 1:
+        raise ValueError(
+            "--fault-plan targets the continuous-batching engine "
+            "(turns == 1)")
     cfg = CONFIGS[config]()
     # Sampling flags are validated up front (main() routes the same
     # errors through argparse): a bad --temperature should fail before
@@ -285,7 +328,7 @@ def serve(
                 paged=paged, spec_decode=speculative, draft_k=draft_k,
                 proposer=proposer, tp=tp, mesh=mesh,
                 tp_compute=tp_compute, attn_impl=attn_impl,
-                tracer=tracer,
+                tracer=tracer, injector=injector,
             )
 
         # One shared per-request params object: sampling state is keyed
@@ -316,8 +359,11 @@ def serve(
                 "prefill-0": _mk_engine("bucketed", True),
                 "decode-0": _mk_engine("bucketed", True),
             }
-            router = FleetRouter(clock=time.perf_counter,
-                                 block_size=block_size, tracer=tracer)
+            router = FleetRouter(
+                clock=time.perf_counter, block_size=block_size,
+                tracer=tracer, injector=injector,
+                watchdog_stale_s=(watchdog_stale_s
+                                  if watchdog_stale_s > 0 else None))
             router.add_replica("prefill-0", engines["prefill-0"],
                                role="prefill")
             router.add_replica("decode-0", engines["decode-0"],
@@ -360,7 +406,10 @@ def serve(
                       "spilled_pages", "spill_bytes", "rehydrate_hits",
                       "rehydrate_tokens", "host_pages_resident",
                       "prefix_pulls", "prefix_pull_pages",
-                      "prefix_pull_bytes"):
+                      "prefix_pull_bytes",
+                      "faults_injected", "migrate_dedups",
+                      "watchdog_strikes", "dispatch_timeouts",
+                      "migration_timeouts", "deadline_sheds"):
                 serving[k] = fleet[k]
         else:
             engine = _mk_engine(
@@ -546,6 +595,11 @@ def serve(
         tracer.flush()
         out["spans_recorded"] = float(tracer.spans_recorded)
         out["spans_dropped"] = float(tracer.spans_dropped)
+    if injector is not None:
+        # Fault ledger into the same summary line: per-(site, kind)
+        # fire counts, so a chaos run's JSONL says exactly which faults
+        # the metrics were measured under.
+        out.update(injector.summary())
     ml = metrics_mod.from_context(ctx)
     if ml is not None:
         # One summary line into the job's log_dir sink — the same JSONL
@@ -714,6 +768,22 @@ def main(argv=None) -> int:
                         "lifecycle spans to this path (load it in "
                         "Perfetto / chrome://tracing); empty = tracing "
                         "off, zero overhead")
+    p.add_argument("--fault-plan", default="",
+                   help="JSON FaultPlan for deterministic fault "
+                        "injection (docs/chaos.md): scoped crash/hang/"
+                        "slow/drop_migration/tier_io_error/refuse_admit "
+                        "specs evaluated on the serving clock; empty = "
+                        "injection off, byte-identical serving")
+    p.add_argument("--fault-seed", type=int, default=0,
+                   help="seed for probabilistic fault specs (prob < 1); "
+                        "same plan + seed + clock replays the same "
+                        "fault schedule")
+    p.add_argument("--watchdog-stale-s", type=float, default=0.0,
+                   help="fleet progress watchdog (--disagg only): "
+                        "strike a replica whose quantum heartbeat "
+                        "stalls this many seconds while it holds work "
+                        "— catches HUNG replicas the TTFT hysteresis "
+                        "cannot see; 0 disables")
     args = p.parse_args(argv)
     if args.tp > 1:
         try:
@@ -747,6 +817,24 @@ def main(argv=None) -> int:
     if args.host_kv_mb > 0 and not args.prefix_cache:
         p.error("--host-kv-mb spills radix-cache pages to host RAM and "
                 "requires --prefix-cache (0 disables the tier)")
+    if args.watchdog_stale_s < 0:
+        p.error(f"--watchdog-stale-s must be >= 0 "
+                f"(got {args.watchdog_stale_s})")
+    if args.watchdog_stale_s > 0 and not args.disagg:
+        p.error("--watchdog-stale-s is the fleet router's progress "
+                "watchdog and requires --disagg")
+    if args.fault_plan:
+        if args.turns > 1:
+            p.error("--fault-plan targets the continuous-batching "
+                    "engine (use --turns 1)")
+        # Parse the plan up front: a typo'd fault kind or site should
+        # fail in milliseconds with the schema message, not after
+        # checkpoint restore.
+        from kubeflow_controller_tpu.dataplane import faults
+        try:
+            faults.load_plan(args.fault_plan)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            p.error(f"--fault-plan {args.fault_plan}: {e}")
     ctx = initialize_from_env()
     # Two-strike SIGTERM/SIGINT drain (util/signals.py, signals.go:26-40
     # parity): first signal sets the stop event — the engine drains and
@@ -795,6 +883,9 @@ def main(argv=None) -> int:
         mesh_devices=args.mesh,
         trace=args.trace,
         disagg=args.disagg,
+        fault_plan=args.fault_plan,
+        fault_seed=args.fault_seed,
+        watchdog_stale_s=args.watchdog_stale_s,
         stop=stop,
     )
     if metrics["interrupted"]:

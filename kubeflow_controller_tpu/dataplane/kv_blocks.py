@@ -229,7 +229,7 @@ class HostKVTier:
     lazily on the next walk through them.
     """
 
-    def __init__(self, budget_bytes: int):
+    def __init__(self, budget_bytes: int, injector=None, target: str = ""):
         if budget_bytes < 0:
             raise ValueError(
                 f"budget_bytes must be >= 0 (got {budget_bytes})")
@@ -241,10 +241,34 @@ class HostKVTier:
         #: entries dropped by LRU budget pressure (their trie nodes go
         #: stale and are pruned on the next tiered walk).
         self.evicted_pages = 0
+        # Fault injection (docs/chaos.md): an injected ``tier_io_error``
+        # at the ``tier.read`` site makes a read behave exactly like a
+        # page lost to LRU pressure — ``has()`` answers False and
+        # ``pop()`` drops the (presumed-corrupt) entry and returns None
+        # — so every caller degrades through the SAME discard path a
+        # dead handle already takes: the trie prunes the spilled
+        # subtree and admission re-prefills those tokens. ``injector``
+        # and ``target`` are plain mutable attributes (the owning
+        # engine learns its replica name after construction).
+        self.injector = injector
+        self.target = target
+        #: injected read failures absorbed (0 outside chaos runs).
+        self.io_errors = 0
 
     @property
     def resident_pages(self) -> int:
         return len(self._pages)
+
+    def _read_fault(self) -> bool:
+        inj = self.injector
+        if inj is None:
+            return False
+        spec = inj.fires("tier", "tier.read", target=self.target,
+                         kinds=("tier_io_error",))
+        if spec is None:
+            return False
+        self.io_errors += 1
+        return True
 
     @staticmethod
     def payload_nbytes(payload: tuple) -> int:
@@ -269,7 +293,9 @@ class HostKVTier:
         return h
 
     def has(self, handle: Optional[int]) -> bool:
-        return handle is not None and handle in self._pages
+        if handle is None or handle not in self._pages:
+            return False
+        return not self._read_fault()
 
     def touch(self, handle: int) -> None:
         self._pages.move_to_end(handle)
@@ -289,6 +315,11 @@ class HostKVTier:
             return None
         payload = self._pages.pop(handle)
         self.resident_bytes -= self._nbytes.pop(handle)
+        if self._read_fault():
+            # The bytes failed to read back: the entry is gone (no
+            # leak) and the caller sees a dead handle — it prunes the
+            # spilled subtree and re-prefills, never wedges.
+            return None
         return payload
 
     def discard(self, handle: Optional[int]) -> None:
@@ -834,5 +865,7 @@ class PrefixStore:
             elif self.tier is not None:
                 self.tier.discard(n.host_handle)
         if self.tier is not None:
-            self.tier = HostKVTier(self.tier.budget_bytes)
+            self.tier = HostKVTier(self.tier.budget_bytes,
+                                   injector=self.tier.injector,
+                                   target=self.tier.target)
         self.trie = RadixCache(self.pool, self.block_size, tier=self.tier)

@@ -201,6 +201,20 @@ class ServingStats:
     rehydrate_hits: int = 0
     rehydrate_tokens: int = 0
     host_pages_resident: int = 0
+    # Robustness (docs/chaos.md): ``heartbeat`` is the quantum-progress
+    # counter the router's watchdog reads — bumped every scheduling
+    # quantum that did real work (booked tokens, advanced a prefill
+    # chunk, admitted, retired). A replica with pending work whose
+    # heartbeat stops moving is WEDGED, a state queue depth and
+    # completion-based TTFT both miss. ``faults_injected`` counts
+    # injected faults THIS engine absorbed (fault-injection runs only;
+    # folded into the fleet aggregate so chaos kills can't lose it),
+    # and ``migrate_dedups`` counts idempotent re-sends of an
+    # already-installed migration payload this engine turned into
+    # no-ops (the exactly-once guard on the prefill->decode hop).
+    heartbeat: int = 0
+    faults_injected: int = 0
+    migrate_dedups: int = 0
 
     def record(self, completion) -> None:
         self.finished += 1
@@ -310,6 +324,9 @@ class ServingStats:
             "spans_recorded": float(self.spans_recorded),
             "spans_dropped": float(self.spans_dropped),
             "samples_dropped": float(self.samples_dropped),
+            "heartbeat": float(self.heartbeat),
+            "faults_injected": float(self.faults_injected),
+            "migrate_dedups": float(self.migrate_dedups),
         }
         # Flatten the committed-tokens histogram into stable scalar keys
         # (spec_step_tokens_1 .. spec_step_tokens_{K+1}) so the JSONL

@@ -101,6 +101,9 @@ class ReplicaHandle:
     strikes: int = 0        # consecutive bad health checks
     clears: int = 0         # consecutive good checks while ejected
     ttft_seen: int = 0      # stats.ttfts_s high-water (windowed checks)
+    hb_seen: int = -1       # stats.heartbeat high-water (watchdog)
+    hb_t: float = 0.0       # clock when heartbeat last advanced
+    watchdog_hit: bool = False  # last strike came from the watchdog
 
     @property
     def routable(self) -> bool:
@@ -144,6 +147,8 @@ class FleetRouter:
         ttft_window: int = 16,
         prefix_pull: Optional[bool] = None,
         tracer=None,
+        injector=None,
+        watchdog_stale_s: Optional[float] = None,
     ):
         self._clock = clock
         # Optional obs.Tracer: dispatch/failover/park/outcome spans on
@@ -172,6 +177,17 @@ class FleetRouter:
         # receiving replica runs a host tier (needs affinity's owner
         # map either way).
         self.prefix_pull = prefix_pull
+        # Fault injection (docs/chaos.md). None = off and byte-identical
+        # to today; share ONE injector (and clock) with the engines so
+        # a plan's activation windows line up across planes.
+        self._injector = injector
+        # Progress watchdog: a replica that is BUSY (active slots or a
+        # queue) but whose quantum heartbeat has not advanced for this
+        # many seconds strikes as unhealthy. None = off (default). This
+        # is the hang detector the TTFT hysteresis cannot be: TTFT
+        # samples only on COMPLETION, so a wedged replica that finishes
+        # nothing never trips the latency signal.
+        self.watchdog_stale_s = watchdog_stale_s
 
         self._replicas: "OrderedDict[str, ReplicaHandle]" = OrderedDict()
         # prefix bytes -> owning replica name, LRU-bounded. Entries may
@@ -183,6 +199,20 @@ class FleetRouter:
         self._assigned: Dict[int, str] = {}         # rid -> replica name
         self._outcomes: Dict[int, Tuple[str, object]] = {}
         self._parked: List[_Parked] = []
+        # Deadline budget, fleet-side: rid -> router submit time and
+        # absolute deadline on the router clock. The budget spans the
+        # request's WHOLE fleet lifetime — parked retries and the
+        # prefill->decode hop included — so a request cannot burn
+        # backoff past its own deadline.
+        self._submit_t: Dict[int, float] = {}
+        self._deadline_t: Dict[int, float] = {}
+        # Migration-hop retry state: rid -> attempt ordinal (stamped on
+        # the payload so installs are attributable) and rid -> receiver
+        # the un-ACKed install landed on (a re-send after a lost ACK
+        # MUST return to the same receiver, where the install ledger
+        # dedupes it — a different receiver would double-install).
+        self._migr_attempts: Dict[int, int] = {}
+        self._migr_sticky: Dict[int, str] = {}
         self.completions: List[Completion] = []
         # rid -> delivered generation ids, for n>1 requests: every gen's
         # Completion delivers (dedup key is (rid, gen)), and the rid's
@@ -204,6 +234,11 @@ class FleetRouter:
         self.prefix_pulls = 0
         self.prefix_pull_pages = 0
         self.prefix_pull_bytes = 0
+        # Hang/timeout hardening counters.
+        self.watchdog_strikes = 0
+        self.dispatch_timeouts = 0
+        self.migration_timeouts = 0
+        self.deadline_sheds = 0
         # Prefix + speculative-decoding + migration accounting folded in
         # from killed/replaced engines so fleet rates and counters
         # survive chaos AND rolling restarts (every engine passes
@@ -220,6 +255,8 @@ class FleetRouter:
         self._retired_spill_bytes = 0
         self._retired_rehydrate_hits = 0
         self._retired_rehydrate_tokens = 0
+        self._retired_faults_injected = 0
+        self._retired_migrate_dedups = 0
 
     # -- fleet membership --------------------------------------------------
 
@@ -245,6 +282,11 @@ class FleetRouter:
                 f"replica {name!r}: prefill role requires "
                 "prefill_mode='bucketed'")
         h = ReplicaHandle(name=name, engine=engine, role=role)
+        # Fault specs scope by replica name; stamp it so the engine's
+        # own injector checks (step/submit/tier) match this replica.
+        # Guarded: test fakes need not grow the attribute.
+        if hasattr(engine, "fault_target"):
+            engine.fault_target = name
         self._replicas[name] = h
         return h
 
@@ -313,6 +355,10 @@ class FleetRouter:
             h.cordoned = False
             h.healthy = True
             h.strikes = h.clears = h.ttft_seen = 0
+            h.hb_seen = -1
+            h.hb_t = 0.0
+            if hasattr(h.engine, "fault_target"):
+                h.engine.fault_target = name
 
     # -- request intake ----------------------------------------------------
 
@@ -324,6 +370,13 @@ class FleetRouter:
             raise ValueError(f"request {req.rid}: duplicate rid")
         self._requests[req.rid] = req
         self.submitted += 1
+        now = self._clock()
+        self._submit_t[req.rid] = now
+        if req.deadline_s is not None:
+            # Deadline budget pinned at FLEET intake: retries, parking,
+            # and the prefill->decode hop all spend from this one
+            # budget (engines additionally enforce their local share).
+            self._deadline_t[req.rid] = now + req.deadline_s
         self._dispatch(req.rid, attempt=0, exclude=frozenset())
 
     def cancel(self, rid: int) -> bool:
@@ -390,6 +443,14 @@ class FleetRouter:
         req = self._requests.get(rid)
         if req is None or rid in self._outcomes:
             return
+        dl = self._deadline_t.get(rid)
+        if dl is not None and self._clock() >= dl:
+            # Past deadline before reaching any replica (parked through
+            # it, or a failover storm ate the budget) — shed NOW as a
+            # typed deadline completion instead of burning a slot on
+            # work nobody is waiting for.
+            self._shed_deadline(rid)
+            return
         tried = set(exclude)
         tr = self._tracer
         t0 = self._clock() if tr is not None else 0.0
@@ -402,6 +463,19 @@ class FleetRouter:
             # restart shed) may land on a mixed replica, which serves
             # it end-to-end.
             req.prefill_only = self.two_stage and h.role == "prefill"
+            if self._injector is not None and self._injector.fires(
+                    "router", "router.dispatch", target=h.name,
+                    rid=rid, kinds=("hang",)) is not None:
+                # Submit RPC timed out (injected): deadline-aware
+                # failover — count it, skip this replica, try the rest
+                # of the fleet. The replica itself got nothing.
+                self.dispatch_timeouts += 1
+                registry().counter("dispatch_timeouts", "router").inc()
+                if tr is not None:
+                    tr.add_event("dispatch_timeout", track="router",
+                                 rid=str(rid), replica=h.name)
+                tried.add(h.name)
+                continue
             try:
                 h.engine.submit(req)
             except Rejected as e:
@@ -472,15 +546,46 @@ class FleetRouter:
         if attempt >= self.max_retries:
             self._finish(rid, "rejected", "fleet_saturated")
             return
-        self.retries += 1
         delay = backoff_delay(
             self.retry_base_s, self.retry_max_s, rid, attempt)
+        dl = self._deadline_t.get(rid)
+        if dl is not None and self._clock() + delay >= dl:
+            # The next retry slot lands past the request's deadline —
+            # retrying is pure waste (the engine would deadline-retire
+            # it on arrival). Shed at PARK time as a typed deadline
+            # completion; conservation stays exact. Without this check
+            # the backoff curve can keep a doomed request bouncing for
+            # the full max_retries ladder after its deadline passed.
+            self._shed_deadline(rid)
+            return
+        self.retries += 1
         if self._tracer is not None:
             self._tracer.add_event(
                 "park", track="router", rid=str(rid),
                 attempt=attempt, delay_s=delay)
         self._parked.append(_Parked(
             due_t=self._clock() + delay, rid=rid, attempt=attempt + 1))
+
+    def _shed_deadline(self, rid: int) -> None:
+        """Terminal deadline shed, router-side: the request never got
+        (or will never get) a slot in time. Surfaces as a Completion
+        with ``finish_reason="deadline"`` and no tokens — the same
+        shape an engine's deadline retirement produces — so callers see
+        ONE vocabulary for deadline misses wherever they happen."""
+        if rid in self._outcomes:
+            return
+        now = self._clock()
+        comp = Completion(
+            rid=rid, tokens=[], finish_reason="deadline",
+            submit_t=self._submit_t.get(rid, now),
+            first_token_t=None, done_t=now)
+        self.deadline_sheds += 1
+        registry().counter("deadline_sheds", "router").inc()
+        if self._tracer is not None:
+            self._tracer.add_event("deadline_shed", track="router",
+                                   rid=str(rid))
+        self._finish(rid, "completed", comp)
+        self.completions.append(comp)
 
     # -- outcomes ----------------------------------------------------------
 
@@ -492,6 +597,10 @@ class FleetRouter:
         self._requests.pop(rid, None)
         self._assigned.pop(rid, None)
         self._gens_done.pop(rid, None)
+        self._submit_t.pop(rid, None)
+        self._deadline_t.pop(rid, None)
+        self._migr_attempts.pop(rid, None)
+        self._migr_sticky.pop(rid, None)
         if self._tracer is not None:
             self._tracer.add_event("fleet_outcome", track="router",
                                    rid=str(rid), kind=kind)
@@ -559,6 +668,15 @@ class FleetRouter:
                                exclude=frozenset())
         out: List[Completion] = []
         for h in list(self._replicas.values()):
+            if self._injector is not None and self._injector.fires(
+                    "router", "router.replica_step", target=h.name,
+                    kinds=("crash",)) is not None:
+                # Injected SIGKILL/preemption: same path real chaos
+                # takes — fold stats, re-dispatch its in-flight rids.
+                # Plans should scope crash specs by target or max_fires;
+                # a bare wildcard kills the whole fleet, as asked.
+                self.kill(h.name)
+                continue
             for c in h.engine.step():
                 self._complete(c)
                 out.append(c)
@@ -590,13 +708,51 @@ class FleetRouter:
         COMPLETION, the same contract kill() keeps)."""
         req = self._requests.get(rid)
         if req is None or rid in self._outcomes:
+            # Terminal already — typically the receiver of a LOST-ACK
+            # install completed the rid before this re-send fired. The
+            # prefill replica still parks the exported slot waiting for
+            # its ACK; release that orphan tenancy here or the slot (and
+            # its pages) leak for the engine's lifetime.
+            try:
+                src.engine.finish_export(rid)
+            except KeyError:
+                pass
             return False
-        candidates = sorted(
-            (d for d in self._replicas.values()
-             if d.role != "prefill" and d.routable
-             and d.name != src.name and d.free_slots > 0),
-            key=lambda d: (-d.free_slots, -d.free_pages, d.name))
         tr = self._tracer
+        attempt = self._migr_attempts.get(rid, 0)
+        if self._injector is not None and self._injector.fires(
+                "router", "router.migrate", target=src.name, rid=rid,
+                kinds=("drop_migration",)) is not None:
+            # Payload lost in flight before any receiver saw it. The
+            # exporter still holds everything (export_request does not
+            # free), so the retry next quantum re-exports losslessly.
+            self.migration_timeouts += 1
+            registry().counter("migration_timeouts", "router").inc()
+            self._migr_attempts[rid] = attempt + 1
+            if tr is not None:
+                tr.add_event("migrate_timeout", track="router",
+                             rid=str(rid), src=src.name,
+                             attempt=attempt)
+            return False
+        sticky = self._migr_sticky.get(rid)
+        if sticky is not None:
+            # A previous install on this receiver may have landed (its
+            # ACK was lost) — the re-send MUST go back there so the
+            # install ledger can dedupe; any other receiver would
+            # double-install. If the receiver died, the un-ACKed
+            # install died with it and a fresh pick is safe.
+            d = self._replicas.get(sticky)
+            if d is None:
+                self._migr_sticky.pop(rid, None)
+                candidates = []
+            else:
+                candidates = [d]
+        if sticky is None or not candidates:
+            candidates = sorted(
+                (d for d in self._replicas.values()
+                 if d.role != "prefill" and d.routable
+                 and d.name != src.name and d.free_slots > 0),
+                key=lambda d: (-d.free_slots, -d.free_pages, d.name))
         for d in candidates:
             path, matched = d.engine.migration_probe(req.prompt)
             try:
@@ -607,6 +763,7 @@ class FleetRouter:
                 # raced the clock) — the probe pin must not leak.
                 d.engine.release_probe(path)
                 return False
+            payload.attempt = attempt
             try:
                 d.engine.admit_migrated(payload, path=path)
             except Rejected as e:
@@ -617,8 +774,29 @@ class FleetRouter:
                                  rid=str(rid), replica=d.name,
                                  reason=e.reason)
                 continue
+            if self._injector is not None and self._injector.fires(
+                    "router", "router.migrate_ack", target=d.name,
+                    rid=rid, kinds=("drop_migration",)) is not None:
+                # Install landed but its ACK was lost: the router acts
+                # as if the hop never happened — no finish_export, no
+                # assignment — and pins the receiver so the re-send
+                # next quantum returns HERE, where admit_migrated's
+                # ledger dedupes it into a success no-op. The src copy
+                # stays held until the acked retry releases it:
+                # at no point does the request exist zero times.
+                self.migration_timeouts += 1
+                registry().counter("migration_timeouts", "router").inc()
+                self._migr_attempts[rid] = attempt + 1
+                self._migr_sticky[rid] = d.name
+                if tr is not None:
+                    tr.add_event("migrate_ack_lost", track="router",
+                                 rid=str(rid), dst=d.name,
+                                 attempt=attempt)
+                return False
             src.engine.finish_export(rid)
             self._assigned[rid] = d.name
+            self._migr_attempts.pop(rid, None)
+            self._migr_sticky.pop(rid, None)
             self.migrations += 1
             if tr is not None:
                 tr.add_event(
@@ -640,12 +818,41 @@ class FleetRouter:
     # -- health ------------------------------------------------------------
 
     def _unhealthy_signal(self, h: ReplicaHandle) -> bool:
+        h.watchdog_hit = False
         depth = len(h.engine.queue)
         cap = self.eject_queue_depth
         if cap is None and h.engine.max_queue is not None:
             cap = h.engine.max_queue
         if cap is not None and depth >= cap:
             return True
+        if self.watchdog_stale_s is not None:
+            # Progress watchdog: strike a replica that is BUSY but whose
+            # quantum heartbeat has not advanced for watchdog_stale_s.
+            # This is the only signal that catches a HUNG replica: the
+            # queue-depth check needs saturation, and the TTFT reservoir
+            # below samples completions — a replica completing nothing
+            # never feeds it. Idle replicas are exempt (no work, no
+            # progress expected), and so are replicas whose quanta still
+            # run (export-parked prefills heartbeat without decoding).
+            now = self._clock()
+            hb = h.engine.stats.heartbeat
+            if hb != h.hb_seen:
+                h.hb_seen = hb
+                h.hb_t = now
+            else:
+                busy = (h.engine.n_active > 0
+                        or len(h.engine.queue) > 0)
+                if busy and now - h.hb_t >= self.watchdog_stale_s:
+                    h.watchdog_hit = True
+                    self.watchdog_strikes += 1
+                    registry().counter(
+                        "watchdog_strikes", "router").inc()
+                    if self._tracer is not None:
+                        self._tracer.add_event(
+                            "watchdog_strike", track="router",
+                            replica=h.name,
+                            stale_s=round(now - h.hb_t, 6))
+                    return True
         if self.ttft_slo_ms is not None:
             # Only TTFTs recorded since the last check: an ejected
             # replica must be judged on what it does now, not on the
@@ -671,6 +878,23 @@ class FleetRouter:
             if h.healthy and h.strikes >= self.eject_after:
                 h.healthy = False
                 self.ejections += 1
+                if h.watchdog_hit:
+                    # A hung replica's in-flight work will NEVER surface
+                    # on its own — unlike a slow replica's, which the
+                    # eject merely routes around. Re-dispatch its rids
+                    # to the live fleet now; if the hang later clears
+                    # and the stale copies complete, outcome dedup
+                    # swallows them (at-most-once on completion).
+                    victims = sorted(
+                        rid for rid, n in self._assigned.items()
+                        if n == h.name)
+                    for rid in victims:
+                        if rid in self._outcomes:
+                            continue
+                        del self._assigned[rid]
+                        self.redispatched += 1
+                        self._dispatch(rid, attempt=0,
+                                       exclude=frozenset((h.name,)))
             elif not h.healthy and h.clears >= self.readmit_after:
                 h.healthy = True
                 h.strikes = 0
@@ -697,6 +921,8 @@ class FleetRouter:
         self._retired_spill_bytes += engine.stats.spill_bytes
         self._retired_rehydrate_hits += engine.stats.rehydrate_hits
         self._retired_rehydrate_tokens += engine.stats.rehydrate_tokens
+        self._retired_faults_injected += engine.stats.faults_injected
+        self._retired_migrate_dedups += engine.stats.migrate_dedups
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -785,6 +1011,22 @@ class FleetRouter:
             "prefix_pulls": float(self.prefix_pulls),
             "prefix_pull_pages": float(self.prefix_pull_pages),
             "prefix_pull_bytes": float(self.prefix_pull_bytes),
+            # Fault injection + hang/timeout hardening (docs/chaos.md):
+            # injected-fault fires seen by engines (live + retired), the
+            # receivers' dedup saves, and the router's own watchdog /
+            # timeout / deadline-shed activity.
+            "faults_injected": float(
+                self._retired_faults_injected + sum(
+                    h.engine.stats.faults_injected
+                    for h in self._replicas.values())),
+            "migrate_dedups": float(
+                self._retired_migrate_dedups + sum(
+                    h.engine.stats.migrate_dedups
+                    for h in self._replicas.values())),
+            "watchdog_strikes": float(self.watchdog_strikes),
+            "dispatch_timeouts": float(self.dispatch_timeouts),
+            "migration_timeouts": float(self.migration_timeouts),
+            "deadline_sheds": float(self.deadline_sheds),
             # Observability counters ride in the fleet JSONL so a
             # postmortem knows whether the trace it is reading is
             # complete (spans_dropped > 0 means the ring wrapped).

@@ -422,6 +422,13 @@ class MigrationPayload:
     block_size: int
     kv_quant: str
     nbytes: int = 0                   # payload bytes (pages + scales)
+    # Migration-hop retry ordinal (docs/chaos.md): the router stamps
+    # the attempt number on each (re-)send so a payload re-exported
+    # after a timed-out install is distinguishable from a fresh one.
+    # The receiver dedupes installs by rid while the rid is live — a
+    # re-send of an already-installed request is a success no-op, so a
+    # lost ACK can never double-install (exactly-once preserved).
+    attempt: int = 0
 
 
 @dataclass
@@ -488,6 +495,7 @@ class ServingEngine:
         attn_impl: str = "xla",
         host_kv_mb: float = 0.0,
         tracer: Optional[Tracer] = None,
+        injector=None,
     ):
         self.cfg = cfg
         self.params = params
@@ -659,10 +667,26 @@ class ServingEngine:
                 "tier spills radix-cache pages; without the trie there "
                 "is nothing to spill)")
         self.host_kv_mb = float(host_kv_mb)
+        # Fault injection (docs/chaos.md): None is the default and
+        # leaves every instrumented path byte-identical to today — each
+        # site costs one pointer comparison, exactly the tracer's
+        # discipline. ``fault_target`` is the replica name fault specs
+        # match against; the router stamps it at add_replica time (its
+        # setter mirrors into the host tier, whose specs share it).
+        self._injector = injector
+        self._fault_target = ""
+        # Quantum-stretch phase for injected ``slow`` faults: only
+        # every ``factor``-th step() call does work while the fault is
+        # active.
+        self._slow_phase = 0
+        # (rid -> attempt) of migration installs this engine performed,
+        # LRU-capped: the idempotency ledger admit_migrated dedupes
+        # re-sent payloads against while the rid is live here.
+        self._install_log: "OrderedDict[int, int]" = OrderedDict()
         self._host_tier: Optional[kv_blocks.HostKVTier] = None
         if host_kv_mb > 0:
             self._host_tier = kv_blocks.HostKVTier(
-                int(host_kv_mb * (1 << 20)))
+                int(host_kv_mb * (1 << 20)), injector=injector)
         # Request id attributed to in-flight spills (set around the
         # admission that triggered the eviction pressure; None for
         # evictions with no requesting rid).
@@ -1031,6 +1055,18 @@ class ServingEngine:
         # compiled functions do too.
         self._prefill_compiles = 0
 
+    @property
+    def fault_target(self) -> str:
+        """Replica name fault specs match this engine under (set by the
+        router at ``add_replica`` time; "" when standalone)."""
+        return self._fault_target
+
+    @fault_target.setter
+    def fault_target(self, name: str) -> None:
+        self._fault_target = str(name)
+        if self._host_tier is not None:
+            self._host_tier.target = self._fault_target
+
     def reset(self) -> None:
         """Drop all queued/in-flight state and zero the pool, KEEPING the
         compiled step/admission functions — benchmark harnesses reuse one
@@ -1044,8 +1080,12 @@ class ServingEngine:
             # Fresh tier: spilled pages belong to the pool state being
             # dropped, so they drop with it.
             self._host_tier = kv_blocks.HostKVTier(
-                self._host_tier.budget_bytes)
+                self._host_tier.budget_bytes,
+                injector=self._host_tier.injector,
+                target=self._host_tier.target)
         self._spill_rid = None
+        self._slow_phase = 0
+        self._install_log.clear()
         if self._prefix_store is not None:
             self._prefix_store.pool = self.pool
             self._prefix_store.tier = self._host_tier
@@ -1175,6 +1215,18 @@ class ServingEngine:
             # on rid (two streams, one key) — refuse loudly.
             raise ValueError(f"request {req.rid}: duplicate rid "
                              "among queued/in-flight requests")
+        if self._injector is not None:
+            # refuse_admit models admission-control flakes (an engine
+            # briefly refusing intake). Typed Rejected, AFTER the
+            # ValueError validation above: a fault never masks a caller
+            # bug, and the router's failover/park ladder absorbs it
+            # exactly like a real overload rejection.
+            if self._injector.fires(
+                    "engine", "engine.submit", target=self._fault_target,
+                    rid=req.rid, kinds=("refuse_admit",)) is not None:
+                self.stats.faults_injected += 1
+                self.stats.rejected += 1
+                raise Rejected(req.rid, "fault_injected")
         if self._draining:
             self.stats.rejected += 1
             raise Rejected(req.rid, "draining")
@@ -2276,8 +2328,36 @@ class ServingEngine:
         Raises :class:`Rejected` when this replica cannot take the
         request right now (no slot / no pages / draining — the router
         tries another receiver or retries later) and ``ValueError`` on
-        wire-format mismatches (caller bug)."""
+        wire-format mismatches (caller bug).
+
+        Installation is IDEMPOTENT by rid while the request is live
+        here: if the sender's ACK was lost and it re-sends, the
+        duplicate is a success no-op (probe pin released, nothing
+        double-installed) — the re-send/dedup pair is what makes the
+        migration hop exactly-once under timeouts. A ledger entry whose
+        rid is no longer live is stale (that incarnation finished here;
+        the router's outcome dedup owns at-most-once) and a fresh
+        migration of the same rid installs normally."""
         try:
+            if payload.rid in self._install_log:
+                if payload.rid in self._rids:
+                    self.stats.migrate_dedups += 1
+                    self.release_probe(path)
+                    if self._tracer is not None:
+                        self._tracer.add_event(
+                            "migrate_dedup", self._clock(),
+                            rid=str(payload.rid),
+                            attempt=int(payload.attempt))
+                    return
+                self._install_log.pop(payload.rid, None)
+            if self._injector is not None:
+                if self._injector.fires(
+                        "engine", "engine.admit_migrated",
+                        target=self._fault_target, rid=payload.rid,
+                        kinds=("refuse_admit",)) is not None:
+                    self.stats.faults_injected += 1
+                    self.stats.rejected += 1
+                    raise Rejected(payload.rid, "fault_injected")
             bs = self.block_size
             if payload.block_size != bs:
                 raise ValueError(
@@ -2408,6 +2488,12 @@ class ServingEngine:
         self.stats.migrated_in += 1
         self.stats.pages_migrated += len(dst_ids)
         self.stats.migrated_zero_copy_tokens += payload.skip_tokens
+        # Ledger the install for the dedup check above (LRU-capped: an
+        # entry only matters while a late re-send is still possible).
+        self._install_log[payload.rid] = int(payload.attempt)
+        self._install_log.move_to_end(payload.rid)
+        while len(self._install_log) > 4096:
+            self._install_log.popitem(last=False)
         now = self._clock()
         if self._tracer is not None:
             self._tracer.add_span(
@@ -2540,6 +2626,26 @@ class ServingEngine:
                 and self._pending is None and not self._done_buf
                 and not self._fork_sources)
 
+    def _fault_step_skip(self) -> bool:
+        """Injected hang / slow: True when THIS quantum must make no
+        progress. The early return in :meth:`step` lands before
+        ``_sync_stats``, so ``stats.heartbeat`` freezes — exactly the
+        signal the router's progress watchdog strikes on. ``hang``
+        skips every quantum in the window; ``slow`` passes one quantum
+        in ``factor`` through (a ×factor stretch of all service)."""
+        if self._injector is None:
+            return False
+        spec = self._injector.fires(
+            "engine", "engine.step", target=self._fault_target,
+            kinds=("hang", "slow"))
+        if spec is None:
+            return False
+        self.stats.faults_injected += 1
+        if spec.kind == "hang":
+            return True
+        self._slow_phase += 1
+        return self._slow_phase % max(1, int(spec.factor)) != 0
+
     def step(self) -> List[Completion]:
         """One scheduling quantum, pipelined one dispatch deep:
 
@@ -2570,6 +2676,8 @@ class ServingEngine:
         traffic — dispatch the SAME pipelined plain chunk as here, so
         hostile traffic keeps plain-decode TPOT.
         """
+        if self._fault_step_skip():
+            return []
         if self._masked_decoding():
             return self._step_constrained()
         if self.spec_decode:
@@ -3066,6 +3174,13 @@ class ServingEngine:
         counters: compile-cache sizes and block-pool occupancy. The pool
         is the only KV storage, so the gauges report in every mode —
         resident pages are slot reservations plus trie tenancy."""
+        # Progress heartbeat: bumped once per COMPLETED quantum (every
+        # step() variant ends here; an injected hang returns before it).
+        # Deliberately not per-token: a prefill replica whose slots are
+        # all export-ready makes no token progress while healthy, but
+        # its quanta still run — heartbeat staleness is the one signal
+        # that separates "hung" from "busy elsewhere".
+        self.stats.heartbeat += 1
         self.stats.prefill_compiles = self._prefill_compiles
         self.stats.admit_cache_size = len(self._admits)
         self.stats.pool_blocks_total = self.pool.n_blocks

@@ -112,6 +112,7 @@ class LocalRuntime:
         queue_shards: int = 1,
         use_native_index: Optional[bool] = None,
         watch_shards: int = 8,
+        injector=None,
     ):
         # ``use_native_index``: None = auto (C++ object index when the lib
         # loads), False = force the pure-Python fingerprint/label paths,
@@ -131,6 +132,9 @@ class LocalRuntime:
         self._opts = ControllerOptions(
             now_fn=lambda: self.cluster.now, resync_period=resync_period,
             tracer=tracer, queue_shards=queue_shards,
+            # Optional dataplane.faults.FaultInjector, threaded onto the
+            # informers by the controller (docs/chaos.md).
+            injector=injector,
         )
         if workers is not None:
             self._opts.workers = workers

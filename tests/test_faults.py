@@ -1,0 +1,717 @@
+"""Deterministic fault injection + the hardening each fault gates.
+
+The contract under test (docs/chaos.md):
+
+1. **Determinism** — fault decisions are pure functions of (plan, seed,
+   clock, per-site check counter); ``injector=None`` and an EMPTY-plan
+   injector are byte-identical to the un-instrumented paths.
+2. **Hang hardening** — the router's progress watchdog strikes a busy
+   replica whose quantum heartbeat stalls, ejects it, and re-dispatches
+   its in-flight rids; the pre-watchdog blind spot (TTFT hysteresis
+   samples completions, so a replica completing NOTHING never trips it)
+   is pinned here as documentation.
+3. **Timeout hardening** — deadline budgets propagate through parking
+   (a retry slot past the deadline sheds as ``finish_reason="deadline"``
+   instead of burning the backoff ladder) and through dispatch
+   (injected submit-RPC timeouts fail over, deadline-aware).
+4. **Exactly-once migration** — ``admit_migrated`` dedupes re-sent
+   payloads by rid while live, so a lost-ACK retry can never
+   double-install; the src copy is only released by an ACKed hop.
+5. **Tier degradation** — an injected host-tier read error behaves like
+   a page lost to LRU pressure: the spilled subtree prunes, admission
+   re-prefills, nothing leaks and nothing wedges.
+6. **Conservation under fault soup** — under randomized seeded plans
+   over every fault kind, completions + rejections + cancellations
+   still equal submissions with zero surfaced duplicates.
+
+Layer 1 (unit + FakeEngine fleets, no jax) runs in milliseconds; the
+real-engine section shares one tiny-config param set. The full seeded
+chaos matrix is ``benchmarks/chaos_bench.py`` (slow-marked smoke here).
+"""
+
+import json
+import os
+import random
+import sys
+from typing import List
+
+import numpy as np
+import pytest
+
+from kubeflow_controller_tpu.dataplane.faults import (
+    KINDS, SITES, FaultInjector, FaultPlan, FaultSpec, load_plan,
+)
+from kubeflow_controller_tpu.dataplane.kv_blocks import HostKVTier
+from kubeflow_controller_tpu.dataplane.router import FleetRouter
+from kubeflow_controller_tpu.dataplane.serving_engine import (
+    Rejected, Request,
+)
+
+from test_fleet import FakeEngine, _Clock, _req
+
+
+# -- unit: plan / spec / injector determinism ------------------------------
+
+
+class TestFaultPlan:
+    def test_bad_kind_rejected(self):
+        with pytest.raises(ValueError, match="fault kind"):
+            FaultSpec(kind="explode")
+
+    def test_bad_site_rejected(self):
+        with pytest.raises(ValueError, match="fault site"):
+            FaultSpec(kind="hang", site="engine.stepp")
+
+    def test_bad_window_rejected(self):
+        with pytest.raises(ValueError, match="window"):
+            FaultSpec(kind="hang", after=2.0, until=1.0)
+
+    def test_json_roundtrip(self, tmp_path):
+        plan = FaultPlan([
+            FaultSpec(kind="hang", site="engine.step", target="r0",
+                      after=1.0, until=2.0),
+            FaultSpec(kind="refuse_admit", site="engine.submit",
+                      prob=0.5, max_fires=3),
+        ])
+        p = tmp_path / "plan.json"
+        p.write_text(json.dumps(plan.to_dict()))
+        back = load_plan(str(p))
+        assert back.to_dict() == plan.to_dict()
+
+    def test_window_target_rid_scoping(self):
+        clk = _Clock()
+        inj = FaultInjector(FaultPlan([FaultSpec(
+            kind="hang", site="engine.step", target="r1",
+            rid=7, after=1.0, until=2.0)]), clock=clk)
+        clk.t = 1.5
+        assert inj.fires("engine", "engine.step", target="r0", rid=7) is None
+        assert inj.fires("engine", "engine.step", target="r1", rid=8) is None
+        assert inj.fires("engine", "engine.step", target="r1",
+                         rid=7) is not None
+        clk.t = 2.0                                  # window is [after, until)
+        assert inj.fires("engine", "engine.step", target="r1", rid=7) is None
+
+    def test_kinds_restriction_skips_not_misfires(self):
+        # A crash spec at a site that only interprets hang/slow must be
+        # skipped entirely — not fired as the wrong kind.
+        inj = FaultInjector(FaultPlan([
+            FaultSpec(kind="crash"),
+            FaultSpec(kind="hang"),
+        ]))
+        spec = inj.fires("engine", "engine.step", kinds=("hang", "slow"))
+        assert spec is not None and spec.kind == "hang"
+        assert inj.total_fires == 1
+
+    def test_prob_thinning_deterministic_per_seed(self):
+        plan = FaultPlan([FaultSpec(kind="refuse_admit",
+                                    site="engine.submit", prob=0.5)])
+
+        def pattern(seed):
+            inj = FaultInjector(plan, seed=seed)
+            return [inj.fires("engine", "engine.submit", rid=i) is not None
+                    for i in range(200)]
+
+        a, b = pattern(1), pattern(1)
+        assert a == b                                # replayable
+        assert 0 < sum(a) < 200                      # actually thinned
+        assert pattern(2) != a                       # seed-sensitive
+
+    def test_max_fires_cap(self):
+        inj = FaultInjector(FaultPlan([FaultSpec(
+            kind="crash", site="router.replica_step", max_fires=2)]))
+        fires = [inj.fires("router", "router.replica_step") is not None
+                 for _ in range(5)]
+        assert fires == [True, True, False, False, False]
+        assert inj.summary()["faults_total"] == 2.0
+
+
+# -- router hardening over FakeEngines -------------------------------------
+
+
+def make_fleet(n=3, clock=None, engine_kw=None, **router_kw):
+    clock = clock or _Clock()
+    router = FleetRouter(clock=clock, block_size=4, **router_kw)
+    for i in range(n):
+        router.add_replica(f"r{i}", FakeEngine(clock, **(engine_kw or {})))
+    return router, clock
+
+
+def _drive(router, clock, steps, dt=0.1):
+    for _ in range(steps):
+        clock.t += dt
+        router.step()
+
+
+DISTINCT = [[1000 * (i + 1) + j for j in range(8)] for i in range(16)]
+
+
+class TestWatchdog:
+    def _wedge_with_work(self, **router_kw):
+        """3 replicas; 3 same-prefix rids land on one, which then hangs
+        with 2 in-flight + 1 queued. Queue depth (1) stays far below the
+        eject cap, and nothing completes — the exact gray-failure shape
+        the TTFT reservoir is blind to."""
+        router, clock = make_fleet(
+            n=3, engine_kw=dict(service_steps=3, max_queue=4), **router_kw)
+        shared = list(range(100, 108))
+        for i in range(3):
+            router.submit(_req(i, shared + [i]))
+        victim = router._assigned[0]
+        h = router.get_replica(victim)
+        _drive(router, clock, 1)                 # admit into slots
+        h.engine.wedged = True
+        return router, clock, h
+
+    def test_hysteresis_blind_to_hang_without_watchdog(self):
+        # PINS THE OLD FAILURE: completions-based TTFT hysteresis never
+        # samples a replica that completes nothing, and the queue-depth
+        # strike needs saturation — a hung replica below queue cap is
+        # never ejected and its requests never reach an outcome.
+        router, clock, h = self._wedge_with_work(ttft_slo_ms=50.0)
+        _drive(router, clock, 100)
+        assert h.healthy                          # never ejected
+        assert router.ejections == 0
+        assert router.pending == 3                # work stuck forever
+
+    def test_watchdog_ejects_hung_replica_and_redispatches(self):
+        router, clock, h = self._wedge_with_work(watchdog_stale_s=0.5)
+        _drive(router, clock, 100)
+        assert not h.healthy
+        assert router.watchdog_strikes >= 2
+        assert router.ejections == 1
+        assert router.redispatched == 3           # in-flight rids moved
+        assert router.outcome_counts["completed"] == 3
+        assert router.pending == 0
+        # The hang clears: the stale copies complete inside the ejected
+        # replica and outcome dedup swallows them — never re-surfaced.
+        h.engine.wedged = False
+        _drive(router, clock, 20)
+        rids = [c.rid for c in router.completions]
+        assert sorted(rids) == [0, 1, 2]          # exactly once each
+        assert router.duplicate_completions >= 1  # stale copies absorbed
+        assert router.fleet_summary()["watchdog_strikes"] >= 2
+
+    def test_idle_replica_never_struck(self):
+        # No work -> no progress expected -> no watchdog strike, no
+        # matter how long the heartbeat sits still.
+        router, clock = make_fleet(n=2, watchdog_stale_s=0.2)
+        _drive(router, clock, 50)
+        assert router.watchdog_strikes == 0
+        assert all(h.healthy for h in router.replicas)
+
+    def test_readmission_after_hang_clears(self):
+        router, clock, h = self._wedge_with_work(
+            watchdog_stale_s=0.5, readmit_after=3)
+        _drive(router, clock, 100)
+        assert not h.healthy
+        h.engine.wedged = False
+        _drive(router, clock, 50)
+        assert h.healthy                          # heartbeat resumed
+        assert router.readmissions == 1
+
+
+class TestDeadlineShed:
+    def _saturated_router(self, **kw):
+        # One replica that rejects EVERYTHING (queue cap 0): requests
+        # can only park and retry.
+        kw.setdefault("max_retries", 50)
+        router, clock = make_fleet(
+            n=1, engine_kw=dict(max_queue=0, n_slots=1), **kw)
+        return router, clock
+
+    def test_parked_retry_sheds_at_deadline(self):
+        # PINS THE OLD FAILURE MODE: without the park-time deadline
+        # check the backoff ladder retries long past deadline_s and the
+        # request's fate is decided by max_retries, not its deadline.
+        router, clock = self._saturated_router()
+        router.submit(Request(rid=0, prompt=np.arange(8, dtype=np.int32),
+                              max_new_tokens=4, deadline_s=0.4))
+        _drive(router, clock, 60, dt=0.05)
+        assert router.pending == 0
+        kind, comp = router.outcome(0)
+        assert kind == "completed"
+        assert comp.finish_reason == "deadline"
+        assert router.deadline_sheds == 1
+        # Shed AT the deadline horizon, not after the full retry ladder.
+        assert comp.done_t <= 0.4 + 0.1
+        assert router.fleet_summary()["deadline_sheds"] == 1.0
+
+    def test_no_deadline_keeps_retry_ladder(self):
+        router, clock = self._saturated_router(max_retries=4)
+        router.submit(_req(0, list(range(8))))
+        _drive(router, clock, 60, dt=0.05)
+        assert router.outcome(0) == ("rejected", "fleet_saturated")
+        assert router.deadline_sheds == 0
+
+    def test_dispatch_entry_sheds_past_deadline(self):
+        # A parked rid whose deadline passed while waiting sheds at the
+        # next dispatch attempt without touching any replica.
+        router, clock = self._saturated_router(retry_max_s=5.0,
+                                               retry_base_s=2.0)
+        router.submit(Request(rid=0, prompt=np.arange(8, dtype=np.int32),
+                              max_new_tokens=4, deadline_s=1.0))
+        _drive(router, clock, 40, dt=0.25)
+        kind, comp = router.outcome(0)
+        assert (kind, comp.finish_reason) == ("completed", "deadline")
+
+
+class TestInjectedRouterFaults:
+    def test_dispatch_timeout_fails_over(self):
+        clock = _Clock()
+        inj = FaultInjector(FaultPlan([FaultSpec(
+            kind="hang", site="router.dispatch", target="r0")]),
+            clock=clock)
+        router, clock = make_fleet(n=2, clock=clock, injector=inj)
+        for i in range(4):
+            router.submit(_req(i, DISTINCT[i]))
+        assert all(v != "r0" for v in router._assigned.values())
+        assert router.dispatch_timeouts >= 1
+        _drive(router, clock, 30)
+        assert router.outcome_counts["completed"] == 4
+        assert router.fleet_summary()["dispatch_timeouts"] >= 1
+
+    def test_refuse_admit_fails_over(self):
+        clock = _Clock()
+        inj = FaultInjector(FaultPlan([FaultSpec(
+            kind="refuse_admit", site="engine.submit", target="r0")]),
+            clock=clock)
+        router, clock = make_fleet(
+            n=2, clock=clock, engine_kw=dict(injector=inj))
+        for i in range(4):
+            router.submit(_req(i, DISTINCT[i]))
+        _drive(router, clock, 30)
+        assert router.outcome_counts["completed"] == 4
+        assert router.get_replica("r0").engine.stats.faults_injected >= 1
+        assert router.fleet_summary()["faults_injected"] >= 1
+
+    def test_crash_fault_kills_and_redispatches(self):
+        clock = _Clock()
+        inj = FaultInjector(FaultPlan([FaultSpec(
+            kind="crash", site="router.replica_step", target="r1",
+            after=0.05, max_fires=1)]), clock=clock)
+        router, clock = make_fleet(
+            n=3, clock=clock, injector=inj,
+            engine_kw=dict(service_steps=4))
+        for i in range(9):
+            router.submit(_req(i, DISTINCT[i]))
+        _drive(router, clock, 60)
+        assert len(router.replicas) == 2          # r1 died
+        assert router.outcome_counts["completed"] == 9
+        assert router.pending == 0
+        rids = [c.rid for c in router.completions]
+        assert sorted(rids) == list(range(9))
+
+    def test_empty_plan_injector_matches_none(self):
+        # The identity tripwire at the router layer: an injector with an
+        # empty plan must leave every counter and outcome identical to
+        # injector=None (the real-engine stream identity is
+        # test_injector_off_stream_identity below).
+        def run(injector):
+            router, clock = make_fleet(n=2, injector=injector)
+            for i in range(6):
+                router.submit(_req(i, DISTINCT[i]))
+            _drive(router, clock, 30)
+            s = router.fleet_summary()
+            return (s["completed"], s["retries"], s["faults_injected"],
+                    [(c.rid, len(c.tokens)) for c in router.completions])
+
+        assert run(None) == run(FaultInjector(FaultPlan()))
+
+
+# -- seeded fault soup: conservation + at-most-once ------------------------
+
+
+def _soup_plan(seed: int) -> FaultPlan:
+    """Random plan over every FakeEngine-reachable fault kind, windows
+    bounded so every fault CLEARS before the drive ends."""
+    rng = random.Random(seed)
+    specs = []
+    # r0 is never crashed: at least one replica survives.
+    for _ in range(rng.randint(1, 2)):
+        specs.append(FaultSpec(
+            kind="crash", site="router.replica_step",
+            target=f"r{rng.randint(1, 3)}",
+            after=rng.uniform(0.0, 2.0), max_fires=1))
+    for _ in range(rng.randint(1, 3)):
+        a = rng.uniform(0.0, 3.0)
+        specs.append(FaultSpec(
+            kind=rng.choice(("hang", "slow")), site="engine.step",
+            target=f"r{rng.randint(0, 3)}", after=a,
+            until=a + rng.uniform(0.5, 1.5),
+            factor=rng.randint(2, 4)))
+    a = rng.uniform(0.0, 2.0)
+    specs.append(FaultSpec(
+        kind="refuse_admit", site="engine.submit", prob=0.4,
+        after=a, until=a + rng.uniform(0.5, 2.0)))
+    a = rng.uniform(0.0, 3.0)
+    specs.append(FaultSpec(
+        kind="hang", site="router.dispatch",
+        target=f"r{rng.randint(0, 3)}", after=a, until=a + 1.0))
+    return FaultPlan(specs)
+
+
+def _run_soup(seed: int):
+    clock = _Clock()
+    inj = FaultInjector(_soup_plan(seed), clock=clock, seed=seed)
+    router, clock = make_fleet(
+        n=4, clock=clock, injector=inj, watchdog_stale_s=0.6,
+        max_retries=6, engine_kw=dict(injector=inj, service_steps=3))
+    rng = random.Random(seed + 1)
+    n = 24
+    submitted = 0
+    for step in range(240):
+        while submitted < n and submitted <= step // 2:
+            router.submit(_req(submitted, DISTINCT[submitted % 16]
+                               + [submitted]))
+            submitted += 1
+        clock.t += 0.1
+        router.step()
+        if rng.random() < 0.05 and submitted:
+            router.cancel(rng.randrange(submitted))
+    return router, inj, n
+
+
+def _check_fault_soup(seed):
+    router, inj, n = _run_soup(seed)
+    counts = router.outcome_counts
+    assert sum(counts.values()) == n, (counts, inj.summary())
+    assert router.pending == 0
+    # At-most-once SURFACED: the dedup counter may tick (stale copies
+    # from unwedged replicas), but the completion stream never re-emits.
+    keys = [(c.rid, c.gen) for c in router.completions]
+    assert len(keys) == len(set(keys))
+    assert inj.total_fires > 0                    # the soup actually bit
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fault_soup_conservation(seed):
+    _check_fault_soup(seed)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", list(range(3, 20)))
+def test_fault_soup_conservation_sweep(seed):
+    _check_fault_soup(seed)
+
+
+# -- tier read faults degrade, never wedge ---------------------------------
+
+
+def _page(fill, nbytes=8):
+    arr = np.full((1, 1, nbytes // 2, 1), fill, np.int8)
+    return (arr, arr.copy(), None, None)
+
+
+class TestTierReadFault:
+    def _tier(self, injector=None):
+        return HostKVTier(1 << 20, injector=injector, target="r0")
+
+    def test_has_answers_false_under_fault(self):
+        clk = _Clock()
+        inj = FaultInjector(FaultPlan([FaultSpec(
+            kind="tier_io_error", site="tier.read", target="r0",
+            after=1.0)]), clock=clk)
+        tier = self._tier(inj)
+        h = tier.put(_page(1))
+        assert tier.has(h)
+        clk.t = 2.0
+        assert not tier.has(h)
+        assert tier.io_errors == 1
+
+    def test_pop_drops_entry_no_leak(self):
+        # The fault models the page's BYTES being gone (corruption), so
+        # pop must drop the entry — returning None while keeping the
+        # bytes resident would leak host budget forever.
+        inj = FaultInjector(FaultPlan([FaultSpec(
+            kind="tier_io_error", site="tier.read", target="r0")]))
+        tier = self._tier(inj)
+        h = tier.put(_page(2))
+        assert tier.resident_bytes == 8
+        assert tier.pop(h) is None
+        assert tier.resident_bytes == 0
+        assert tier.resident_pages == 0
+        assert tier.pop(h) is None                # dead handle stays dead
+
+    def test_unscoped_target_misses(self):
+        inj = FaultInjector(FaultPlan([FaultSpec(
+            kind="tier_io_error", site="tier.read", target="r9")]))
+        tier = self._tier(inj)
+        h = tier.put(_page(3))
+        assert tier.has(h)
+        got = tier.pop(h)
+        assert got is not None and np.array_equal(got[0], _page(3)[0])
+
+
+# -- real engine: identity, migration idempotency, degradation -------------
+
+
+import jax  # noqa: E402
+
+from kubeflow_controller_tpu.dataplane.serving_engine import (  # noqa: E402
+    ServingEngine,
+)
+from kubeflow_controller_tpu.models import generate as gen  # noqa: E402
+from kubeflow_controller_tpu.models import transformer as tfm  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tfm.tiny_config()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return gen.inference_params(cfg, tfm.init_params(cfg, jax.random.key(0)))
+
+
+def mk_engine(cfg, params, clock=None, injector=None, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_seq", 64)
+    if clock is not None:
+        kw["clock"] = clock
+    return ServingEngine(
+        cfg, params, prefill_mode="bucketed", block_size=4,
+        prefix_cache=True, injector=injector, **kw)
+
+
+def engine_leak_check(eng):
+    assert all(s is None for s in eng.slots)
+    assert eng.pool.used_blocks == eng._prefix_store.trie.n_nodes()
+
+
+def _greedy_reqs(cfg, n=4, max_new=5, seed=11):
+    rng = np.random.default_rng(seed)
+    sysp = rng.integers(0, cfg.vocab_size, 12)
+    return [Request(rid=i,
+                    prompt=np.concatenate(
+                        [sysp, rng.integers(0, cfg.vocab_size, 1 + i % 3)]
+                    ).astype(np.int32),
+                    max_new_tokens=max_new) for i in range(n)]
+
+
+def test_injector_off_stream_identity(cfg, params):
+    """THE determinism tripwire: an attached injector whose plan never
+    fires must be byte-identical to injector=None — same greedy token
+    streams, zero fault counters. This is what makes an always-on
+    injector safe to ship in production builds."""
+    def run(injector):
+        eng = mk_engine(cfg, params, injector=injector)
+        comps = eng.run(_greedy_reqs(cfg))
+        return {(c.rid, c.gen): list(c.tokens) for c in comps}
+
+    off = run(None)
+    on = run(FaultInjector(FaultPlan()))
+    assert on == off
+    # A plan whose window never opens is equally inert.
+    never = FaultInjector(FaultPlan([FaultSpec(
+        kind="hang", site="engine.step", after=1e9)]),
+        clock=lambda: 0.0)
+    assert run(never) == off
+    assert never.total_fires == 0
+
+
+def test_admit_migrated_resend_dedupes(cfg, params):
+    """A re-sent migration payload (the sender never saw the ACK) is a
+    no-op on a receiver that already installed the rid: the dedup
+    releases the probe pin, bumps migrate_dedups, and the stream
+    surfaces exactly once."""
+    clock = _Clock()
+    p = mk_engine(cfg, params, clock=clock)
+    d = mk_engine(cfg, params, clock=clock)
+    req = _greedy_reqs(cfg, n=1, max_new=4)[0]
+    req.prefill_only = True
+    p.submit(req)
+    for _ in range(40):
+        p.step()
+        if 0 in p.export_ready_rids():
+            break
+    else:
+        raise AssertionError("prefill never parked")
+    path, matched = d.migration_probe(req.prompt)
+    payload = p.export_request(0, skip_tokens=matched)
+    assert payload.attempt == 0
+    d.admit_migrated(payload, path=path)
+    # Lost ACK -> identical re-send while rid 0 is live on d.
+    path2, matched2 = d.migration_probe(req.prompt)
+    used = d.pool.used_blocks
+    d.admit_migrated(payload, path=path2)          # must not raise
+    assert d.stats.migrate_dedups == 1
+    assert d.pool.used_blocks == used              # probe pin released
+    p.finish_export(0)
+    comps = []
+    for _ in range(60):
+        comps.extend(d.step())
+        if d.n_active == 0 and not d.queue:
+            break
+    assert [c.rid for c in comps] == [0]           # exactly once
+    p.drain(0.0), d.drain(0.0)
+    engine_leak_check(p), engine_leak_check(d)
+
+
+def test_ack_drop_retry_is_idempotent(cfg, params):
+    """Router-level: an injected lost ACK on the migration hop makes
+    the router re-send; the sticky receiver dedupes the re-install and
+    the stream is bit-identical to the fault-free run."""
+    def run(plan_specs):
+        clock = _Clock()
+        inj = (FaultInjector(FaultPlan(plan_specs), clock=clock)
+               if plan_specs else None)
+        router = FleetRouter(clock=clock, block_size=4, injector=inj)
+        router.add_replica("prefill-0", mk_engine(cfg, params, clock=clock),
+                           role="prefill")
+        router.add_replica("decode-0", mk_engine(cfg, params, clock=clock),
+                           role="decode")
+        for r in _greedy_reqs(cfg, n=3, max_new=4):
+            router.submit(r)
+        for _ in range(400):
+            if router.idle:
+                break
+            clock.t += 0.05
+            router.step()
+        assert router.idle
+        s = router.fleet_summary()
+        return {(c.rid, c.gen): list(c.tokens)
+                for c in router.completions}, s
+
+    baseline, _ = run(None)
+    faulted, s = run([FaultSpec(kind="drop_migration",
+                                site="router.migrate_ack", max_fires=1)])
+    assert faulted == baseline
+    assert s["migration_timeouts"] == 1
+    assert s["migrate_dedups"] == 1                # re-send hit the ledger
+
+
+def test_drop_before_send_retries_clean(cfg, params):
+    """The simpler drop (payload lost BEFORE install) needs no dedup —
+    just a retry; streams still match fault-free."""
+    clock = _Clock()
+    inj = FaultInjector(FaultPlan([FaultSpec(
+        kind="drop_migration", site="router.migrate", max_fires=2)]),
+        clock=clock)
+    router = FleetRouter(clock=clock, block_size=4, injector=inj)
+    router.add_replica("prefill-0", mk_engine(cfg, params, clock=clock),
+                       role="prefill")
+    router.add_replica("decode-0", mk_engine(cfg, params, clock=clock),
+                       role="decode")
+    for r in _greedy_reqs(cfg, n=2, max_new=4):
+        router.submit(r)
+    for _ in range(400):
+        if router.idle:
+            break
+        clock.t += 0.05
+        router.step()
+    assert router.idle
+    s = router.fleet_summary()
+    assert s["migration_timeouts"] == 2
+    assert s["migrate_dedups"] == 0
+    assert router.outcome_counts["completed"] == 2
+
+
+def test_tier_read_fault_degrades_to_recompute(cfg, params):
+    """An injected host-tier read error behaves exactly like the page
+    being LRU-evicted: the spilled subtree prunes, admission re-prefills,
+    greedy tokens stay bit-identical, nothing leaks."""
+    def cycling(rid0=0):
+        rng = np.random.default_rng(3)
+        fams = [rng.integers(0, cfg.vocab_size, 16) for _ in range(4)]
+        r2, out, rid = np.random.default_rng(7), [], rid0
+        for _ in range(3):
+            for f in fams:
+                tail = r2.integers(0, cfg.vocab_size, 1 + rid % 4)
+                out.append(Request(
+                    rid=rid,
+                    prompt=np.concatenate([f, tail]).astype(np.int32),
+                    max_new_tokens=4))
+                rid += 1
+        return out
+
+    tier_kw = dict(n_slots=2, max_seq=32, kv_pool_blocks=12,
+                   host_kv_mb=64.0)
+    base = mk_engine(cfg, params, **tier_kw)
+    baseline = {(c.rid, c.gen): list(c.tokens)
+                for c in base.run(cycling())}
+    assert base.stats.spilled_pages > 0            # workload spills
+
+    inj = FaultInjector(FaultPlan([FaultSpec(
+        kind="tier_io_error", site="tier.read", prob=0.5)]), seed=5)
+    eng = mk_engine(cfg, params, injector=inj, **tier_kw)
+    got = {(c.rid, c.gen): list(c.tokens) for c in eng.run(cycling())}
+    assert got == baseline                         # degrade, never corrupt
+    assert eng._host_tier.io_errors > 0            # faults actually bit
+    assert all(s is None for s in eng.slots)
+    # Tier-aware leak check: every pool block is a RESIDENT trie node
+    # (spilled nodes hold host pages, not pool blocks) ...
+    n_resident = 0
+    stack = list(eng._prefix_store.trie.root.children.values())
+    while stack:
+        nd = stack.pop()
+        if nd.block >= 0:
+            n_resident += 1
+        stack.extend(nd.children.values())
+    assert eng.pool.used_blocks == n_resident
+    # ... and faulted pages were DROPPED, not leaked: freeing the cache
+    # empties both the device pool and the host tier.
+    eng._prefix_store.clear()
+    assert eng.pool.used_blocks == 0
+    assert eng._host_tier.resident_pages == 0
+
+
+# -- control plane: informer delivery hang + resync heal -------------------
+
+
+def test_informer_delivery_hang_resync_heals():
+    from test_cow_store import frozen_store, make_pod
+
+    from kubeflow_controller_tpu.controller.informer import Informer
+
+    store = frozen_store()
+    inf_injector = FaultInjector(FaultPlan([FaultSpec(
+        kind="hang", site="informer.deliver", target="Pod",
+        max_fires=1)]), clock=lambda: 0.0)
+    inf = Informer(store, injector=inf_injector)
+    seen = []
+    inf.add_handler(seen.append)
+    inf.start()
+    try:
+        store.create(make_pod("p0"))
+        assert seen == []                          # delivery suppressed
+        assert inf.deliveries_suppressed == 1
+        assert inf.get("default", "p0") is not None  # cache still fresh
+        inf.resync()                               # level-trigger sweep
+        assert len(seen) == 1 and seen[0].obj.metadata.name == "p0"
+        store.create(make_pod("p1"))               # max_fires spent
+        assert any(e.obj.metadata.name == "p1" for e in seen)
+    finally:
+        inf.stop()
+
+
+# -- chaos bench smoke contract --------------------------------------------
+
+
+def _bench_main():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks"))
+    import chaos_bench
+    return chaos_bench.main
+
+
+def test_chaos_bench_smoke(tmp_path):
+    """Smoke contract: the seeded fault matrix holds its hard gates —
+    conservation + zero surfaced duplicates under EVERY fault class,
+    leak-free drain, goodput retention under a hung replica, and the
+    fault-free injector-on leg bit-identical to injector-off."""
+    out = tmp_path / "chaos.json"
+    rc = _bench_main()(["--smoke", "--json", str(out)])
+    assert rc == 0
+    data = json.loads(out.read_text())
+    assert data["acceptance"] and all(data["gates"].values()), data["gates"]
+
+
+@pytest.mark.slow
+def test_chaos_bench_full(tmp_path):
+    out = tmp_path / "chaos_full.json"
+    rc = _bench_main()(["--json", str(out)])
+    assert rc == 0
+    data = json.loads(out.read_text())
+    assert data["acceptance"] and all(data["gates"].values()), data["gates"]

@@ -53,7 +53,7 @@ class FakeEngine:
     measure hit rates without jax."""
 
     def __init__(self, clock, n_slots=2, max_queue=4, service_steps=2,
-                 block_size=4):
+                 block_size=4, injector=None):
         self._clock = clock
         self.n_slots = n_slots
         self.max_queue = max_queue
@@ -66,8 +66,22 @@ class FakeEngine:
         self._cancelled = set()
         self._done: List[Completion] = []
         self._blocks = set()               # block-prefix bytes "cached" here
+        # Hang/fault support, mirroring the real engine's semantics:
+        # a wedged (or injected-hang) step makes NO progress and does
+        # not bump stats.heartbeat — the exact signal the router's
+        # progress watchdog strikes on.
+        self.wedged = False
+        self.injector = injector
+        self.fault_target = ""
+        self._slow_phase = 0
 
     def submit(self, req: Request) -> None:
+        if self.injector is not None and self.injector.fires(
+                "engine", "engine.submit", target=self.fault_target,
+                rid=req.rid, kinds=("refuse_admit",)) is not None:
+            self.stats.faults_injected += 1
+            self.stats.rejected += 1
+            raise Rejected(req.rid, "fault_injected")
         if self._draining:
             self.stats.rejected += 1
             raise Rejected(req.rid, "draining")
@@ -108,6 +122,20 @@ class FakeEngine:
             self.stats.admitted += 1
 
     def step(self) -> List[Completion]:
+        if self.wedged:
+            return []
+        if self.injector is not None:
+            spec = self.injector.fires(
+                "engine", "engine.step", target=self.fault_target,
+                kinds=("hang", "slow"))
+            if spec is not None:
+                self.stats.faults_injected += 1
+                if spec.kind == "hang":
+                    return []
+                self._slow_phase += 1
+                if self._slow_phase % max(1, int(spec.factor)) != 0:
+                    return []
+        self.stats.heartbeat += 1
         out, self._done = self._done, []
         now = self._clock()
         for rid in list(self.active):
