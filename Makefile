@@ -3,13 +3,13 @@
 
 PY ?= python
 
-.PHONY: all native test test-fast test-native test-tp test-obs \
+.PHONY: all native test test-fast test-native test-tp test-moe test-obs \
 	test-sampling test-pallas test-faults bench \
 	bench-cp bench-cp-sweep bench-serve bench-overload bench-prefix \
 	bench-fleet bench-chaos \
 	bench-disagg bench-kv-tier \
-	bench-spec bench-paged bench-tp bench-prefill bench-obs bench-sampling \
-	clean stamp
+	bench-spec bench-paged bench-tp bench-moe bench-prefill bench-obs \
+	bench-sampling clean stamp
 
 # Build-stamp analog of the reference's ldflags version injection
 # (/root/reference/Makefile:23-26): export the sha for build_version().
@@ -56,6 +56,17 @@ test-faults:
 test-tp:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tp_serving.py -q
+
+# Expert-parallel MoE guard: greedy/sampled/spec-decode/int8 streams at
+# tp in {2,4} against the single-chip oracle in both tp_compute modes,
+# the moe_ep_tolerance logits contract, E/tp expert-bank placement on
+# the real sharded tree, leak-free drain/cancel, and the structured
+# moe_experts%tp refusal at the engine AND both serve_lm entry points
+# (docs/serving.md "Expert-parallel MoE"). Tier-1 too; this target is
+# the cheap CI gate for MoE-touching changes.
+test-moe:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_moe_tp.py -q
 
 # Pallas kernel guard: the fused paged-attention kernels (single-row
 # decode, width-W flash prefill, K+1-wide speculative verify) in
@@ -209,6 +220,19 @@ bench-paged:
 bench-tp:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/tp_bench.py \
 		--json benchmarks/tp_bench_summary.json
+
+# Expert-parallel MoE benchmark: every sharded leg's churn streams
+# asserted token-identical to the tp=1 single-chip MoE oracle BEFORE
+# timing, completions+rejections==arrivals on every leg, per-shard
+# expert-bank bytes exactly E/tp of the replicated bank on the real
+# param tree, then the capacity gate — admissible slots at fixed
+# per-device HBM >= 1.5x the hypothetical replicated-bank layout at
+# tp=4 — see benchmarks/RESULTS.md and docs/serving.md
+# "Expert-parallel MoE". The script forces the 8-virtual-device split
+# itself.
+bench-moe:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/moe_bench.py \
+		--json benchmarks/moe_bench_summary.json
 
 # Long-prompt prefill benchmark: pallas flash-prefill leg vs the XLA
 # gather, greedy streams asserted equal BEFORE timing; gates on the

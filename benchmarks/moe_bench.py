@@ -1,0 +1,276 @@
+"""Expert-parallel MoE serving benchmark: correctness first, then the
+capacity claim.
+
+Gates for the ISSUE 20 expert-parallel engine (docs/serving.md
+"Expert-parallel MoE"), in deliberate order — streams are asserted
+BEFORE any timing is recorded:
+
+* **stream equality**: for every leg in the sweep (tp in {2, 4}, both
+  ``tp_compute`` modes, the Pallas attention twin), the open-loop churn
+  workload's greedy streams are asserted token-identical to the tp=1
+  single-chip MoE oracle. Routing is exact by construction (top_k of a
+  replicated fp32 softmax); only the expert matmuls and the combine
+  reassociate, under the declared ``gen.moe_ep_tolerance`` logits
+  contract pinned by tests/test_moe_tp.py — a flipped token would fail
+  HERE, and timing a divergent engine is meaningless.
+* **conservation**: completions + rejections == arrivals on every leg
+  (open-loop submission; nothing silently dropped by dispatch buffers).
+* **capacity at fixed per-device HBM**: the point of the layout.
+  Expert banks dominate MoE weight HBM; sharding them E/tp frees
+  per-device bytes for KV pages. The gate compares ADMISSIBLE SLOTS at
+  a fixed per-device budget under the real sharded layout vs the
+  hypothetical replicated-bank layout (same dense handling, same KV
+  math — ONLY the expert-bank residency differs, both measured from
+  the actual param tree's bytes): >= 1.5x at tp=4.
+
+The sweep then records aggregate tokens/sec, TTFT, the per-shard
+traffic gauges, and the MoE gauges (``moe_experts_per_shard``,
+``moe_tokens_dispatched``) per leg. Deterministic side-gates: per-shard
+expert-bank bytes must be exactly E/tp of the replicated bank, and the
+parallel legs' modeled per-shard FLOPs must sit strictly below their
+gathered twins at the same tp. Measured tokens/sec is reported honestly
+per leg: on the forced-host CPU "mesh" the shards are threads of one
+chip, so collective-heavy legs regress wall-clock — the HBM capacity
+column, not CPU throughput, is the acceptance metric.
+
+Prints one JSON object; with ``--json`` also writes it to a file. Run
+via ``make bench-moe`` (sets the 8-virtual-device XLA flag).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# Must precede the first jax import anywhere in the process.
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+
+from benchmarks.prefix_bench import run_engine
+from benchmarks.tp_bench import churn_workload
+
+CAPACITY_GATE_TP4 = 1.5
+EXPERT_KEYS = ("w_gate", "w_up", "w_down")
+
+
+def split_weight_bytes(params) -> tuple:
+    """(dense_bytes, expert_bank_bytes) measured from the actual param
+    tree — int8 ``(q, scale)`` tuples count both halves."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda x: isinstance(x, tuple))[0]
+    dense = expert = 0
+    for path, leaf in flat:
+        pname = "".join(str(p) for p in path)
+        leaves = leaf if isinstance(leaf, tuple) else (leaf,)
+        nb = sum(int(a.nbytes) for a in leaves)
+        if any(k in pname for k in EXPERT_KEYS):
+            expert += nb
+        else:
+            dense += nb
+    return dense, expert
+
+
+def admissible_slots(cfg, block_size: int, max_seq: int,
+                     budget_bytes: int, tp: int, dense_bytes: int,
+                     expert_bytes: int, expert_layout: str) -> int:
+    """Slots admissible at a fixed PER-DEVICE HBM budget once resident
+    weights are charged. Dense weights shard 1/tp under the serving
+    layout in both scenarios; only the expert-bank residency differs:
+    ``sharded`` charges E/tp of the bank, ``replicated`` all of it."""
+    from kubeflow_controller_tpu.dataplane import kv_blocks
+
+    w = dense_bytes // tp + (
+        expert_bytes // tp if expert_layout == "sharded" else expert_bytes)
+    kv_budget = max(0, budget_bytes - w)
+    max_blocks = -(-max_seq // block_size)
+    if kv_budget <= 0:
+        return 0
+    return kv_blocks.blocks_for_budget(
+        cfg, block_size, kv_budget, "", tp=tp) // max_blocks
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--requests", type=int, default=24)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--block-size", type=int, default=8)
+    p.add_argument("--budget-mb", type=float, default=0.75,
+                   help="fixed PER-DEVICE HBM budget for the capacity "
+                        "column (MiB); sized so the tiny_moe expert "
+                        "banks (60%% of its weights) matter, the way "
+                        "Mixtral-scale banks (~27 of 47 GB) do at real "
+                        "HBM sizes")
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json", default="", help="also write the summary here")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from kubeflow_controller_tpu.models import generate as gen
+    from kubeflow_controller_tpu.models import transformer as tfm
+    from kubeflow_controller_tpu.parallel.mesh import serving_mesh
+    from kubeflow_controller_tpu.parallel.sharding import (
+        shard_serving_params,
+    )
+
+    n_dev = jax.device_count()
+    if n_dev < 4:
+        print(f"moe_bench needs >= 4 devices (got {n_dev}); set "
+              f"XLA_FLAGS=--xla_force_host_platform_device_count=8",
+              file=sys.stderr)
+        return 1
+
+    # n_kv_heads=4 so tp in {1, 2, 4} divide the KV heads; moe_experts=4
+    # (tiny_moe default) divides the same sweep.
+    cfg = tfm.tiny_moe_config(n_kv_heads=4)
+    params = gen.inference_params(
+        cfg, tfm.init_params(cfg, jax.random.key(0)))
+    reqs = churn_workload(cfg, args.requests, args.seed)
+    max_seq = int(max(r.prompt.size + r.max_new_tokens for r in reqs)) + 1
+    base_kw = dict(n_slots=args.slots, max_seq=max_seq,
+                   prefill_mode="bucketed", block_size=args.block_size,
+                   prefix_cache=True)
+
+    legs = [(1, "gathered", "xla"),
+            (2, "gathered", "xla"), (4, "gathered", "xla"),
+            (2, "parallel", "xla"), (4, "parallel", "xla"),
+            (4, "parallel", "pallas")]
+
+    # ---- gate 1: stream equality + conservation BEFORE timing -----------
+    def streams(tp, tp_compute, attn_impl):
+        from kubeflow_controller_tpu.dataplane.serving_engine import (
+            Request, ServingEngine,
+        )
+        eng = ServingEngine(cfg, params, tp=tp, tp_compute=tp_compute,
+                            attn_impl=attn_impl, **base_kw)
+        out = eng.run([Request(rid=r.rid, prompt=r.prompt.copy(),
+                               max_new_tokens=r.max_new_tokens)
+                       for r in reqs])
+        done = sum(1 for c in out if c.finish_reason in ("eos", "length"))
+        rejected = len(out) - done
+        return {c.rid: list(c.tokens) for c in out}, done, rejected
+
+    base_streams, done, rejected = streams(1, "gathered", "xla")
+    if done + rejected != len(reqs):
+        print(f"CONSERVATION FAILURE at tp=1: {done}+{rejected} != "
+              f"{len(reqs)} arrivals")
+        return 1
+    divergent = []
+    for tp, mode, attn in legs[1:]:
+        got, done, rejected = streams(tp, mode, attn)
+        if done + rejected != len(reqs):
+            print(f"CONSERVATION FAILURE at tp={tp}/{mode}/{attn}: "
+                  f"{done}+{rejected} != {len(reqs)} arrivals")
+            return 1
+        if got != base_streams:
+            divergent.append(f"tp={tp}/{mode}/{attn}")
+    if divergent:
+        print(f"STREAM-EQUALITY FAILURE at {divergent}; refusing to "
+              f"time a divergent engine")
+        return 1
+
+    # ---- deterministic layout gate: per-shard bank bytes == E/tp --------
+    dense_bytes, expert_bytes = split_weight_bytes(params)
+    mesh4 = serving_mesh(4)
+    sharded = shard_serving_params(cfg, params, mesh4)
+    flat = jax.tree_util.tree_flatten_with_path(
+        sharded, is_leaf=lambda x: isinstance(x, tuple))[0]
+    shard_bank = 0
+    for path, leaf in flat:
+        pname = "".join(str(p) for p in path)
+        if any(k in pname for k in EXPERT_KEYS):
+            leaves = leaf if isinstance(leaf, tuple) else (leaf,)
+            shard_bank += sum(
+                int(a.addressable_shards[0].data.nbytes) for a in leaves)
+    if shard_bank * 4 != expert_bytes:
+        print(f"LAYOUT GATE FAILURE: per-shard expert bank bytes "
+              f"{shard_bank} x 4 != replicated {expert_bytes}")
+        return 1
+
+    # ---- Pareto sweep: tokens/sec + traffic + MoE gauges per leg --------
+    budget = int(args.budget_mb * (1 << 20))
+    pareto = []
+    for tp, mode, attn in legs:
+        _, summ, eng = run_engine(cfg, params, reqs, args.repeats,
+                                  tp=tp, tp_compute=mode, attn_impl=attn,
+                                  **base_kw)
+        pareto.append({
+            "tp": tp,
+            "tp_compute": mode,
+            "attn_impl": attn,
+            "tokens_per_sec": round(summ["tokens_per_sec"], 1),
+            "ttft_p50_ms": summ["ttft_p50_ms"],
+            "admissible_slots_at_fixed_per_device_hbm": admissible_slots(
+                cfg, args.block_size, max_seq, budget, tp,
+                dense_bytes, expert_bytes, "sharded"),
+            "admissible_slots_replicated_banks": admissible_slots(
+                cfg, args.block_size, max_seq, budget, tp,
+                dense_bytes, expert_bytes, "replicated"),
+            "moe_experts_per_shard": eng.stats.moe_experts_per_shard,
+            "moe_tokens_dispatched": int(eng.stats.moe_tokens_dispatched),
+            "hbm_bytes_per_step": int(eng.stats.hbm_bytes_per_step),
+            "flops_per_token_per_shard": int(
+                eng.stats.flops_per_token_per_shard),
+        })
+    by_leg = {(r["tp"], r["tp_compute"], r["attn_impl"]): r
+              for r in pareto}
+
+    # ---- gates: capacity ratio at tp=4 + parallel FLOPs below gathered --
+    g4 = by_leg[(4, "gathered", "xla")]
+    cap_ratio = (g4["admissible_slots_at_fixed_per_device_hbm"]
+                 / max(1, g4["admissible_slots_replicated_banks"]))
+    traffic_failures = []
+    for tp in (2, 4):
+        g = by_leg.get((tp, "gathered", "xla"))
+        par = by_leg.get((tp, "parallel", "xla"))
+        if g and par and not (par["flops_per_token_per_shard"]
+                              < g["flops_per_token_per_shard"]):
+            traffic_failures.append(
+                f"tp={tp}: parallel FLOPs not below gathered")
+
+    out = {
+        "metric": "admissible_slots_sharded_vs_replicated_banks_tp4",
+        "value": round(cap_ratio, 2),
+        "unit": "x admissible slots per device at fixed HBM, E/tp "
+                "banks vs replicated banks, tp=4",
+        "stream_equal": {f"tp={t}/{m}/{a}": True for t, m, a in legs[1:]},
+        "conservation": "completions+rejections==arrivals on every leg",
+        "expert_bank_bytes_replicated": expert_bytes,
+        "expert_bank_bytes_per_shard_tp4": shard_bank,
+        "dense_weight_bytes": dense_bytes,
+        "budget_mb_per_device": args.budget_mb,
+        "pareto": pareto,
+        "devices": n_dev,
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(line + "\n")
+    if cap_ratio < CAPACITY_GATE_TP4:
+        print(f"CAPACITY BELOW TARGET: {cap_ratio:.2f}x < "
+              f"{CAPACITY_GATE_TP4}x at tp=4")
+        return 1
+    if traffic_failures:
+        print("TRAFFIC-MODEL GATE FAILURE: " + "; ".join(traffic_failures))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

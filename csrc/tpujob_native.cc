@@ -456,6 +456,57 @@ class ObjectIndex {
     *misses = fp_misses_;
   }
 
+  // -- slice-health mirror ---------------------------------------------------
+  // Write-through mirror of the slice pool's holdings, keyed by holder (job
+  // uid): holder -> {slice name -> healthy}. cluster/slices.py writes through
+  // on every holder/health mutation under the pool lock, so FpProbeMirrored
+  // can compose the slice-health fingerprint term natively — the steady
+  // probe touches zero Python slice traversals.
+
+  void SliceSet(const std::string& holder, const std::string& name,
+                bool healthy) {
+    std::lock_guard<std::mutex> g(slice_mu_);
+    slices_[holder][name] = healthy;
+  }
+
+  void SliceClear(const std::string& holder, const std::string& name) {
+    std::lock_guard<std::mutex> g(slice_mu_);
+    auto it = slices_.find(holder);
+    if (it == slices_.end()) return;
+    it->second.erase(name);
+    if (it->second.empty()) slices_.erase(it);
+  }
+
+  // FpProbe with the health term composed from the mirror. want_health == 0
+  // encodes "planner will not read health" as "-" (the Python path's
+  // health_key=None); want_health != 0 with no held slices encodes as the
+  // empty string — distinct from "-", mirroring None vs empty tuple.
+  // Entries are name-sorted (std::map iteration; names are unique per
+  // holder, so this matches Python's sorted((name, healthy)) order).
+  int FpProbeMirrored(const std::string& job_key, const std::string& ident,
+                      const std::string& ns, const std::string& kind_a,
+                      const std::string& lk_a, const std::string& lv_a,
+                      const std::string& kind_b, const std::string& lk_b,
+                      const std::string& lv_b, const std::string& health_uid,
+                      int want_health) {
+    std::string health = "-";
+    if (want_health) {
+      health.clear();
+      std::lock_guard<std::mutex> g(slice_mu_);
+      auto it = slices_.find(health_uid);
+      if (it != slices_.end()) {
+        for (const auto& nv : it->second) {
+          health += nv.first;
+          health += '\x04';
+          health += nv.second ? '1' : '0';
+          health += '\x05';
+        }
+      }
+    }
+    return FpProbe(job_key, ident, ns, kind_a, lk_a, lv_a, kind_b, lk_b,
+                   lv_b, health);
+  }
+
  private:
   struct Rec {
     std::string uid;
@@ -530,6 +581,8 @@ class ObjectIndex {
   std::unordered_map<std::string, std::string> fp_pending_;
   long long fp_hits_ = 0;
   long long fp_misses_ = 0;
+  std::mutex slice_mu_;
+  std::map<std::string, std::map<std::string, bool>> slices_;
 };
 
 }  // namespace
@@ -671,6 +724,27 @@ void oix_fp_forget(void* h, const char* job_key) {
 }
 void oix_fp_counts(void* h, long long* hits, long long* misses) {
   static_cast<ObjectIndex*>(h)->FpCounts(hits, misses);
+}
+// Slice-health mirror: write-through from the slice pool so oix_fp_probe2
+// composes the health term natively (no Python traversal per probe).
+void oix_slice_set(void* h, const char* holder, const char* name,
+                   int healthy) {
+  static_cast<ObjectIndex*>(h)->SliceSet(holder, name, healthy != 0);
+}
+void oix_slice_clear(void* h, const char* holder, const char* name) {
+  static_cast<ObjectIndex*>(h)->SliceClear(holder, name);
+}
+// oix_fp_probe with the health term read from the mirror keyed by
+// health_uid; want_health == 0 means the planner ignores health for this
+// job ("-" sentinel, matching the Python health_key=None case).
+int oix_fp_probe2(void* h, const char* job_key, const char* ident,
+                  const char* ns, const char* kind_a, const char* lk_a,
+                  const char* lv_a, const char* kind_b, const char* lk_b,
+                  const char* lv_b, const char* health_uid,
+                  int want_health) {
+  return static_cast<ObjectIndex*>(h)->FpProbeMirrored(
+      job_key, ident, ns, kind_a, lk_a, lv_a, kind_b, lk_b, lv_b,
+      health_uid, want_health);
 }
 
 }  // extern "C"

@@ -152,7 +152,10 @@ class FakeCluster:
         self._pending_keys: set = set()
         self._active_keys: set = set()
         self.pods.subscribe(self._track_pod, replay=False)
-        self.slice_pool = SlicePool()
+        # The pool shares the native index: holder/health mutations write
+        # through so the fingerprint's slice-health term is composed
+        # natively (no holdings() traversal per steady probe).
+        self.slice_pool = SlicePool(mirror=self.native_index)
         self.faults = FaultInjector()
         self.default_policy = default_policy or PodRunPolicy(
             start_delay=1.0, run_duration=5.0

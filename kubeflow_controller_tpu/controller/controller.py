@@ -611,24 +611,19 @@ class Controller:
     ) -> bool:
         """Fingerprint probe through the native object index: same
         observable world as _sync_fingerprint (job identity, owned pod and
-        service rvs by label bucket, slice health), but the pod/service
-        traversal happens inside the C++ core against the write-through
-        mirror — zero Python object walks. Returns True on a steady hit;
-        on a miss the candidate parks native-side for fp_commit."""
-        health = "-"
-        if self._wants_health(job):
-            health = repr(sorted(
-                (s.name, s.healthy)
-                for s in self.client.job_slices(
-                    job.metadata.uid, job.metadata.name)
-            ))
+        service rvs by label bucket, slice health), but BOTH the pod/service
+        traversal AND the slice-health term are composed inside the C++
+        core against write-through mirrors (stores for objects, the slice
+        pool for health) — the steady probe is fully traversal-free.
+        Returns True on a steady hit; on a miss the candidate parks
+        native-side for fp_commit."""
         meta = job.metadata
         ident = f"{meta.uid}|{meta.resource_version}|{meta.generation}"
-        return self._nix.fp_probe(
+        return self._nix.fp_probe_mirrored(
             key, ident, namespace,
             b"Pod", self._b_job_label, name,
             b"Service", self._b_job_label, name,
-            health,
+            meta.uid, self._wants_health(job),
         )
 
     def _sync_fingerprint(self, namespace: str, name: str, job: TPUJob) -> Tuple:

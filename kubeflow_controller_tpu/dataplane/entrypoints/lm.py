@@ -33,8 +33,10 @@ logger = logging.getLogger("tpujob.lm")
 
 CONFIGS = {
     "tiny": tfm.tiny_config,
+    "tiny_moe": tfm.tiny_moe_config,
     "llama3_8b": tfm.llama3_8b_config,
     "llama3_70b": tfm.llama3_70b_config,
+    "mixtral_8x7b": tfm.mixtral_8x7b_config,
 }
 
 

@@ -139,6 +139,17 @@ class ServingStats:
     hbm_bytes_per_step_decode: float = 0.0
     hbm_bytes_per_step_verify: float = 0.0
     flops_per_token_per_shard: float = 0.0
+    # Expert-parallel MoE (docs/serving.md "Expert-parallel MoE"):
+    # ``moe_experts_per_shard`` is the resident bank size per device —
+    # E/tp under the serving mesh, E on a single chip, 0 for dense
+    # configs (a gauge; also the MoE-panel key for dashboards).
+    # ``moe_tokens_dispatched`` counts cumulative token-x-expert
+    # routings across dispatched quanta: every real token a quantum
+    # forwards adds top_k (counted once per forward pass, not per
+    # layer) — the traffic twin of ``flops_per_token_per_shard``'s
+    # top_k-active-experts model.
+    moe_experts_per_shard: int = 0
+    moe_tokens_dispatched: int = 0
     # Speculative decoding (docs/serving.md "Speculative decoding"):
     # ``draft_proposed`` counts draft tokens sent to the verifier,
     # ``draft_accepted`` those that committed (acceptance_rate is their
@@ -316,6 +327,8 @@ class ServingStats:
                 self.hbm_bytes_per_step_verify),
             "flops_per_token_per_shard": float(
                 self.flops_per_token_per_shard),
+            "moe_experts_per_shard": float(self.moe_experts_per_shard),
+            "moe_tokens_dispatched": float(self.moe_tokens_dispatched),
             "draft_proposed": float(self.draft_proposed),
             "draft_accepted": float(self.draft_accepted),
             "acceptance_rate": self.acceptance_rate,

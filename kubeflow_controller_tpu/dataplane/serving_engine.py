@@ -1422,11 +1422,23 @@ class ServingEngine:
         self._last_vw = nb * self.block_size
         return self._last_vw
 
+    def _note_moe_dispatch(self, n_tokens: int) -> None:
+        """Count expert routings: every token a quantum forwards
+        through the model routes to ``top_k`` experts per MoE layer's
+        router (the gauge counts token-x-expert routings per forward
+        pass, NOT per layer — it tracks dispatched traffic, and layers
+        share one routing decision cost model). No-op for dense
+        configs, so the dispatch hot path stays untouched."""
+        if self.cfg.moe_experts:
+            self.stats.moe_tokens_dispatched += (
+                int(n_tokens) * self.cfg.moe_top_k)
+
     def _step_fn(self, params, logits, cache, eos, budget, emitted, key):
         """Dispatch the fused decode chunk compiled for the current
         view width (compile-on-first-use per width)."""
         vw = self._view_width()
         self._phase_impl["decode"] = self.attn_impl
+        self._note_moe_dispatch(self.n_active * self.decode_chunk)
         fn = self._step_fns.get(vw)
         if fn is None:
             fn = self._step_fns[vw] = self._make_step(vw)
@@ -1441,6 +1453,7 @@ class ServingEngine:
             self._push_sampling()
             vw = self._view_width()
             self._phase_impl["decode"] = self.attn_impl
+            self._note_moe_dispatch(self.n_active * self.decode_chunk)
             fn = self._step_fns_sampled.get(vw)
             if fn is None:
                 fn = self._step_fns_sampled[vw] = \
@@ -1458,6 +1471,7 @@ class ServingEngine:
         self._push_sampling()
         vw = self._view_width()
         self._phase_impl["decode"] = self.attn_impl
+        self._note_moe_dispatch(self.n_active)
         fn = self._step_fns_masked.get(vw)
         if fn is None:
             fn = self._step_fns_masked[vw] = self._make_step_masked(vw)
@@ -1473,6 +1487,7 @@ class ServingEngine:
         contract — see _make_spec)."""
         vw = self._view_width()
         self._phase_impl["verify"] = self.attn_impl
+        self._note_moe_dispatch(self.n_active * (self.draft_k + 1))
         fn = self._spec_steps.get(vw)
         if fn is None:
             fn = self._spec_steps[vw] = self._make_spec(vw)
@@ -1483,6 +1498,7 @@ class ServingEngine:
         """Sampled twin of :meth:`_spec_fn` (same per-width memo)."""
         vw = self._view_width()
         self._phase_impl["verify"] = self.attn_impl
+        self._note_moe_dispatch(self.n_active * (self.draft_k + 1))
         fn = self._spec_steps_sampled.get(vw)
         if fn is None:
             fn = self._spec_steps_sampled[vw] = self._make_spec_sampled(vw)
@@ -1897,6 +1913,7 @@ class ServingEngine:
             self._tables_dirty = True
             if self.prefill_mode == "exact":
                 self._push_tables()
+                self._note_moe_dispatch(req.prompt.size)
                 admit = self._admit_fn(req.prompt.size)
                 t_p0 = self._clock() if self._tracer is not None else 0.0
                 (self.cache, self.logits, self.eos, self.budget,
@@ -1988,6 +2005,7 @@ class ServingEngine:
             buf[0, :w_real] = tokens[off:off + w_real]
             fn = self._chunk_fn(w)
             self._phase_impl["prefill"] = self.attn_impl
+            self._note_moe_dispatch(w_real)
             self._push_tables()
             t0 = self._clock() if self._tracer is not None else 0.0
             (self.cache, self.logits, self.eos, self.budget,
@@ -3141,6 +3159,16 @@ class ServingEngine:
         *models*, not counters — they exist so the bench's Pareto sweep
         can show parallel-vs-gathered and pallas-vs-xla moving the
         bytes the docs claim they move.
+
+        MoE configs replace the dense-FFN terms with routed-expert
+        terms — the dense model would overstate both streams by up to
+        E/top_k x. Weights: each shard's RESIDENT bank is E/tp experts
+        under the expert-parallel mesh (the per-shard vmap'd expert
+        dots read the whole local bank every step), while the 1-chip
+        engine's gather path streams only the routed experts (at most
+        n_slots * top_k distinct per step); the fp32 router is
+        replicated and never int8. FLOPs: a token computes exactly its
+        top_k experts plus the router matmul, regardless of E.
         """
         cfg = self.cfg
         tp = max(self.tp, 1)
@@ -3148,15 +3176,35 @@ class ServingEngine:
         L = cfg.n_layers
         parallel = self.tp_compute == "parallel" and tp > 1
         div = tp if parallel else 1
-        # Per-layer projection param counts, split by parallel class.
-        col = (cfg.d_model * (cfg.n_heads + 2 * cfg.n_kv_heads) * hd
-               + 2 * cfg.d_model * cfg.d_ff)
-        row = (cfg.n_heads * hd * cfg.d_model
-               + cfg.d_ff * cfg.d_model)
-        local_params = L * (col + row) / div + cfg.d_model * cfg.vocab_size
         per_elem = (1 if self._w_quant == "int8"
                     else jnp.dtype(cfg.dtype).itemsize)
-        weight_bytes = local_params * per_elem
+        if cfg.moe_experts:
+            # Attention projections keep the dense column/row split;
+            # there is no dense MLP to count.
+            col = cfg.d_model * (cfg.n_heads + 2 * cfg.n_kv_heads) * hd
+            row = cfg.n_heads * hd * cfg.d_model
+            expert_each = 3 * cfg.d_model * cfg.d_ff   # gate + up + down
+            e_resident = (cfg.moe_experts // tp if tp > 1
+                          else min(cfg.moe_experts,
+                                   self.n_slots * cfg.moe_top_k))
+            local_params = (L * (col + row) / div
+                            + cfg.d_model * cfg.vocab_size)
+            weight_bytes = (
+                (local_params + L * e_resident * expert_each) * per_elem
+                + L * cfg.d_model * cfg.moe_experts * 4)
+            moe_flops = L * (2.0 * cfg.moe_top_k * expert_each
+                             + 2.0 * cfg.d_model * cfg.moe_experts)
+        else:
+            # Per-layer projection param counts, split by parallel
+            # class.
+            col = (cfg.d_model * (cfg.n_heads + 2 * cfg.n_kv_heads) * hd
+                   + 2 * cfg.d_model * cfg.d_ff)
+            row = (cfg.n_heads * hd * cfg.d_model
+                   + cfg.d_ff * cfg.d_model)
+            local_params = (L * (col + row) / div
+                            + cfg.d_model * cfg.vocab_size)
+            weight_bytes = local_params * per_elem
+            moe_flops = 0.0
         vw = self._last_vw or self._view_width()
         impl = self._phase_impl.get(phase, self.attn_impl)
         kv_factor = 1 if impl == "pallas" else 3
@@ -3166,7 +3214,8 @@ class ServingEngine:
         # Attention runs on the shard's head slice in BOTH tp modes
         # (gathered slices heads, parallel projects them locally).
         local_heads = cfg.n_heads // tp if tp > 1 else cfg.n_heads
-        flops = 2.0 * local_params + 4.0 * vw * local_heads * hd * L
+        flops = (2.0 * local_params + moe_flops
+                 + 4.0 * vw * local_heads * hd * L)
         return weight_bytes + kv_bytes, flops
 
     def _sync_stats(self) -> None:
@@ -3260,6 +3309,17 @@ class ServingEngine:
         for phase, val in phase_bytes.items():
             reg.gauge(f"hbm_bytes_per_step.{phase}", "dataplane").set(val)
         reg.gauge("flops_per_token_per_shard", "dataplane").set(flops)
+        # Expert-parallel MoE gauges: the per-shard resident bank size
+        # (E/tp — the layout the traffic model charges for) and the
+        # cumulative token-x-expert routings dispatched. Zero for dense
+        # configs, so dashboards can key MoE panels on the first gauge.
+        self.stats.moe_experts_per_shard = (
+            self.cfg.moe_experts // max(self.tp, 1)
+            if self.cfg.moe_experts else 0)
+        reg.gauge("moe_experts_per_shard", "serving").set(
+            self.stats.moe_experts_per_shard)
+        reg.gauge("moe_tokens_dispatched", "serving").set(
+            self.stats.moe_tokens_dispatched)
 
     def _book_token(self, i: int, slot: _Slot, tok: int,
                     now: float) -> Optional[Completion]:

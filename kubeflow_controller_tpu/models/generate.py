@@ -691,11 +691,14 @@ def check_tp_heads(cfg: TransformerConfig, tp: int,
 
     - ``n_kv_heads % tp`` must be 0 — KV heads split across the tp axis
       (which also divides ``n_heads``: GQA requires n_kv_heads | n_heads).
-    - ``d_ff % tp`` must be 0 under ``tp_compute="parallel"`` — the MLP
-      hidden axis is column-split across shards there (the gathered
-      path never splits d_ff compute, so it only needs the head rule).
-    - MoE configs are refused outright under tp > 1 (expert dispatch is
-      mesh-size-dependent at trace time).
+    - ``d_ff % tp`` must be 0 under ``tp_compute="parallel"`` for DENSE
+      configs — the MLP hidden axis is column-split across shards there
+      (the gathered path never splits d_ff compute, so it only needs
+      the head rule; MoE configs have no dense MLP — their per-expert
+      d_ff is never column-split, so the rule doesn't apply).
+    - ``moe_experts % tp`` must be 0 — expert banks shard E/tp experts
+      per device (tokens travel to their experts via all_to_all), so
+      the expert axis must divide evenly.
 
     The same refusal fires at arg-parse (``serve_lm``), at engine
     construction, and inside every paged kernel's mesh wrapper."""
@@ -709,18 +712,19 @@ def check_tp_heads(cfg: TransformerConfig, tp: int,
             f"pick tp from the divisors of n_kv_heads, or reshape the "
             f"model"
         )
-    if tp_compute == "parallel" and cfg.d_ff % tp:
+    if tp_compute == "parallel" and not cfg.moe_experts and cfg.d_ff % tp:
         problems.append(
             f"d_ff must be divisible by tp under tp_compute='parallel' "
             f"— the MLP hidden axis is column-split across shards "
             f"(d_ff={cfg.d_ff}, tp={tp}); use tp_compute='gathered' or "
             f"pick tp from the divisors of d_ff"
         )
-    if cfg.moe_experts:
+    if cfg.moe_experts and cfg.moe_experts % tp:
         problems.append(
-            "MoE configs are not supported under tensor-parallel "
-            "serving yet (expert dispatch is mesh-size-dependent at "
-            "trace time)"
+            f"moe_experts must be divisible by tp — expert banks shard "
+            f"E/tp experts per device and tokens reach them via "
+            f"all_to_all (moe_experts={cfg.moe_experts}, tp={tp}); "
+            f"pick tp from the divisors of moe_experts"
         )
     if problems:
         raise ValueError(
@@ -749,6 +753,121 @@ def tp_parallel_tolerance(cfg: TransformerConfig, tp: int) -> Dict[str, float]:
     blocks = 2 * cfg.n_layers + 1
     bound = 16.0 * max(tp, 1) * (blocks ** 0.5) * eps
     return {"rtol": bound, "atol": bound}
+
+
+def moe_ep_tolerance(cfg: TransformerConfig, tp: int) -> Dict[str, float]:
+    """The declared per-tp logits tolerance for expert-parallel MoE
+    dispatch vs the single-chip dense-replicated oracle.
+
+    Routing is exact — the fp32 router matmul, softmax, and top_k run on
+    replicated inputs, so every shard (and the 1-chip oracle) picks the
+    same experts with the same gate weights. What reassociates is the
+    expert *math*: the per-shard vmap'd 2D expert matmuls group the same
+    token-x-weight products differently than the oracle's per-token
+    gathered einsums, and the gate-weighted combine sums the k expert
+    outputs in expert-id order instead of routing-rank order. Per MoE
+    layer that is up to three reassociated reductions (gate/up, down,
+    combine) on top of the attention blocks — modeled like
+    :func:`tp_parallel_tolerance` as a random walk over depth with a
+    32x safety factor (the contract must also absorb composition with
+    the parallel-mode attention psums). tests/test_moe_tp.py pins both
+    sides: measured drift stays under this bound, and greedy argmax
+    streams on the gated workloads equal the 1-chip oracle outright."""
+    eps = float(jnp.finfo(jnp.promote_types(cfg.dtype, jnp.float32)).eps)
+    blocks = 3 * cfg.n_layers + 1
+    bound = 32.0 * max(tp, 1) * (blocks ** 0.5) * eps
+    return {"rtol": bound, "atol": bound}
+
+
+def _moe_ep_ffn(
+    cfg: TransformerConfig, lp: Params, h: jax.Array, tp_shards: int,
+) -> jax.Array:
+    """Expert-parallel routed FFN inside a shard_map'd serving kernel:
+    each shard holds ``E/tp`` experts (``parallel.sharding`` splits the
+    stacked banks — int8 ``(q, scale)`` included — on the expert axis)
+    and tokens travel to their experts instead of expert weights
+    replicating (GShard-style, two all_to_alls per MoE layer).
+
+    Steps, for ``h`` of shape [B, S, D] flattened to n = B*S tokens:
+
+    1. Route on REPLICATED fp32 router logits — softmax + top_k are
+       shard-invariant, so every shard computes identical expert
+       choices and gate weights (and they equal the 1-chip oracle's:
+       training's iterative argmax-of-remaining and ``lax.top_k`` pick
+       the same experts with the same first-max tie-break).
+    2. Slice this shard's n/tp-token stripe and build the dispatch
+       buffer [tp, E/tp, n/tp, D] via the routing one-hot: destination
+       shard d's slab carries, per local expert, each stripe token (or
+       zeros where not routed). Capacity per (source, expert) is the
+       full stripe, so serving NEVER drops tokens — the HBM win is the
+       E/tp weight storage, not a token cap.
+    3. ``all_to_all`` the buffers; per local expert, run the 2D dot
+       idiom from ``transformer._moe_ffn`` — vmap over the local bank
+       so each expert's matmul is a plain [n, D] x [D, F] MXU dot
+       (int8 banks dequantize expert-locally: q * scale on exactly the
+       shard's experts).
+    4. ``all_to_all`` the outputs back and combine by gate weight
+       (zeros from non-routed slots vanish in the combine), then
+       ``all_gather`` the token stripes — output replicated across
+       shards, so downstream layers and logits stay replicated.
+
+    Exactness contract: decisions-identical routing, logits within
+    :func:`moe_ep_tolerance` of the single-chip oracle (the expert
+    matmuls and the combine reassociate; see there)."""
+    dt = cfg.dtype
+    b, s, d = h.shape
+    n = b * s
+    tp = tp_shards
+    el = cfg.moe_experts // tp                   # local experts
+    hf = h.reshape(n, d)
+    probs = jax.nn.softmax(
+        hf.astype(jnp.float32) @ lp["w_router"].astype(jnp.float32), -1
+    )
+    gates, idx = jax.lax.top_k(probs, cfg.moe_top_k)     # [n, k]
+
+    n_loc = -(-n // tp)                          # stripe = ceil(n / tp)
+    pad = n_loc * tp - n
+    shard = lax.axis_index("tp")
+    hp = jnp.pad(hf, ((0, pad), (0, 0)))
+    # Padded rows route nowhere: index -1 one-hots to all-zeros, so
+    # their dispatch slabs and combine weights are exact zeros.
+    ip = jnp.pad(idx, ((0, pad), (0, 0)), constant_values=-1)
+    gp_ = jnp.pad(gates, ((0, pad), (0, 0)))
+    xs = lax.dynamic_slice_in_dim(hp, shard * n_loc, n_loc, 0)
+    is_ = lax.dynamic_slice_in_dim(ip, shard * n_loc, n_loc, 0)
+    gs = lax.dynamic_slice_in_dim(gp_, shard * n_loc, n_loc, 0)
+
+    one = jax.nn.one_hot(is_, cfg.moe_experts, dtype=jnp.float32)
+    sel = one.sum(1)                             # [n_loc, E] in {0, 1}
+    # [dest shard, local expert, stripe slot, D]
+    send = (
+        sel.reshape(n_loc, tp, el).transpose(1, 2, 0)[..., None].astype(dt)
+        * xs.astype(dt)[None, None]
+    )
+    recv = lax.all_to_all(send, "tp", 0, 0)      # [src, el, n_loc, D]
+    xe = recv.transpose(1, 0, 2, 3).reshape(el, tp * n_loc, d)
+
+    def bank(name):
+        w = lp[name]
+        if isinstance(w, tuple):
+            q, scale = w
+            return q.astype(dt) * scale.astype(dt)
+        return w.astype(dt)
+
+    def edot(x_e, w_e):                          # 2D per-expert MXU dot
+        return x_e @ w_e
+
+    a = jax.nn.silu(jax.vmap(edot)(xe, bank("w_gate")))
+    a = a * jax.vmap(edot)(xe, bank("w_up"))
+    out_e = jax.vmap(edot)(a, bank("w_down"))    # [el, tp*n_loc, D]
+
+    back = out_e.reshape(el, tp, n_loc, d).transpose(1, 0, 2, 3)
+    ret = lax.all_to_all(back, "tp", 0, 0)       # [dest, el, n_loc, D]
+    comb = (one * gs[..., None]).sum(1)          # [n_loc, E] gate or 0
+    comb = comb.reshape(n_loc, tp, el).astype(dt)
+    out_loc = jnp.einsum("cte,tecd->cd", comb, ret)
+    out = lax.all_gather(out_loc, "tp", axis=0, tiled=True)[:n]
+    return out.reshape(b, s, d)
 
 
 def paged_cache_specs(cache: PagedKVCache) -> PagedKVCache:
@@ -790,13 +909,31 @@ def _tp_param_specs(params: Params, parallel: bool) -> object:
     ``tp_compute="gathered"`` (XLA all-gathers the stored shards at
     dispatch), column/row-split under ``"parallel"`` (the kernels
     consume the stored shards in place — see
-    ``parallel.sharding.tp_compute_param_specs``)."""
-    if not parallel:
-        return _replicated_specs(params)
+    ``parallel.sharding.tp_compute_param_specs``).
+
+    MoE expert banks (stacked ndim-4 ``[L, E, D, F]``, int8 scales
+    included) stay EXPERT-SPLIT in both modes: the expert-parallel
+    dispatch (:func:`_moe_ep_ffn`) consumes the shard-local E/tp bank
+    directly — gathering the banks would undo the entire HBM win."""
     from kubeflow_controller_tpu.parallel.sharding import (
-        tp_compute_param_specs,
+        _EXPERT_SPEC, _TP_EXPERT_KEYS, tp_compute_param_specs,
     )
-    return tp_compute_param_specs(params)
+    if parallel:
+        return tp_compute_param_specs(params)
+
+    def spec(path, x):
+        key = next(
+            (getattr(p, "key", None) for p in reversed(path)
+             if getattr(p, "key", None)), None,
+        )
+        pair = isinstance(x, tuple)
+        arr = x[0] if pair else x
+        if key in _TP_EXPERT_KEYS and arr.ndim >= 4:
+            return (_EXPERT_SPEC, _EXPERT_SPEC) if pair else _EXPERT_SPEC
+        return (P(), P()) if pair else P()
+
+    return jax.tree_util.tree_map_with_path(
+        spec, params, is_leaf=lambda x: isinstance(x, tuple))
 
 
 def _occupancy_cap(width: int, view_width: Optional[int]) -> int:
@@ -941,7 +1078,11 @@ def _decode_layer_paged(
 
     h = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
     if cfg.moe_experts:
-        x = x + _moe_decode_ffn(cfg, lp, h)
+        # Single-chip: gather-the-top-k dense path (the oracle, byte
+        # for byte). Under tp: expert-parallel dispatch over the
+        # shard-local E/tp bank in BOTH compute modes.
+        x = x + (_moe_ep_ffn(cfg, lp, h, tp_shards) if tp_shards > 1
+                 else _moe_decode_ffn(cfg, lp, h))
     else:
         gate = jax.nn.silu(h @ _w(lp, "w_gate", dt))
         up = h @ _w(lp, "w_up", dt)
@@ -1035,6 +1176,7 @@ def _tp_prefill_forward(
     params: Params,
     prompt: jax.Array,          # [1, S] int32
     tp_shards: int,
+    parallel: bool = True,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Column/row-parallel full-prompt forward for admission prefill
     under ``tp_compute="parallel"``: the fused :func:`prefill` assumes
@@ -1043,12 +1185,20 @@ def _tp_prefill_forward(
     slice; one psum per block, mirroring ``_decode_layer_paged``).
     Returns ``(last-position logits [1, V], row_k, row_v)`` with k/v
     already LOCAL ``[L, S, KVH/tp, D]`` — they scatter into the pool
-    shard directly, no `_tp_slice_heads` needed."""
+    shard directly, no `_tp_slice_heads` needed.
+
+    ``parallel=False`` is the gathered-mode MOE admission path: the
+    fused :func:`prefill` would run the training FFN on what is now a
+    shard-local expert bank, so MoE prefill always comes here instead —
+    full replicated attention projections (gathered semantics), the
+    expert-parallel FFN (:func:`_moe_ep_ffn`), and a KV-head slice on
+    the way out. Dense gathered prefill never calls this function."""
     b, s = prompt.shape
     dt = cfg.dtype
     hd = cfg.head_dim
     rep = cfg.n_heads // cfg.n_kv_heads
-    g = cfg.n_kv_heads // tp_shards
+    g_local = cfg.n_kv_heads // tp_shards
+    g = g_local if parallel else cfg.n_kv_heads
     x = params["embed"].astype(dt)[prompt]              # [1, S, D]
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     causal = (
@@ -1071,12 +1221,24 @@ def _tp_prefill_forward(
         sc = jnp.where(causal[None, None, None], sc, -1e30)
         p = jax.nn.softmax(sc, axis=-1).astype(dt)
         attn = jnp.einsum("bgrqk,bkgd->bqgrd", p, v).reshape(b, s, -1)
-        x = x + lax.psum(attn @ _w(lp, "wo", dt), "tp")
+        wo_out = attn @ _w(lp, "wo", dt)
+        x = x + (lax.psum(wo_out, "tp") if parallel else wo_out)
         h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(h2 @ _w(lp, "w_gate", dt))
-        up = h2 @ _w(lp, "w_up", dt)
-        x = x + lax.psum((gate * up) @ _w(lp, "w_down", dt), "tp")
-        return x, (k[0], v[0])                   # [S, KVH/tp, D]
+        if cfg.moe_experts:
+            x = x + _moe_ep_ffn(cfg, lp, h2, tp_shards)
+        else:
+            gate = jax.nn.silu(h2 @ _w(lp, "w_gate", dt))
+            up = h2 @ _w(lp, "w_up", dt)
+            down = (gate * up) @ _w(lp, "w_down", dt)
+            x = x + (lax.psum(down, "tp") if parallel else down)
+        row_k, row_v = k[0], v[0]                # [S, g, D]
+        if not parallel:
+            # Replicated full-head projections: keep only this shard's
+            # KV-head group for the pool scatter (axis 1 here — no
+            # batch axis on the carried row).
+            row_k = _tp_slice_heads(row_k, g_local, axis=1)
+            row_v = _tp_slice_heads(row_v, g_local, axis=1)
+        return x, (row_k, row_v)                 # [S, KVH/tp, D]
 
     x, (row_k, row_v) = lax.scan(body, x, params["layers"])
     logits = _head_logits(
@@ -1096,9 +1258,13 @@ def _prefill_into_paged_impl(
     n_blocks, bs = cache.k.shape[1], cache.k.shape[2]
     mb = cache.tables.shape[1]
     s = prompt.shape[1]
-    if tp_shards > 1 and tp_parallel:
+    if tp_shards > 1 and (tp_parallel or cfg.moe_experts):
+        # Parallel mode always, and MoE in EITHER mode: the fused
+        # prefill below assumes replicated full weights, but expert
+        # banks enter the shard_map expert-split in both modes.
         logits, row_k, row_v = _tp_prefill_forward(
-            cfg, params, prompt, tp_shards)      # k/v already local
+            cfg, params, prompt, tp_shards,
+            parallel=tp_parallel)                # k/v already local
     else:
         logits, mini = prefill(
             cfg, params, prompt, init_kv_cache(cfg, 1, s))
@@ -1486,8 +1652,11 @@ def _prefill_chunk_paged_impl(
             x = x + attn @ _w(lp, "wo", dt)
         h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         if cfg.moe_experts:
-            down, _aux = tfm._moe_ffn(moe_cfg, _dense_lp(lp, dt), h2)
-            x = x + down
+            if tp_shards > 1:
+                x = x + _moe_ep_ffn(cfg, lp, h2, tp_shards)
+            else:
+                down, _aux = tfm._moe_ffn(moe_cfg, _dense_lp(lp, dt), h2)
+                x = x + down
         else:
             gate = jax.nn.silu(h2 @ _w(lp, "w_gate", dt))
             up = h2 @ _w(lp, "w_up", dt)
@@ -1869,8 +2038,11 @@ def _verify_step_paged_impl(
             x = x + attn @ _w(lp, "wo", dt)
         h2 = rmsnorm(x, lp["mlp_norm"], cfg.norm_eps)
         if cfg.moe_experts:
-            down, _aux = tfm._moe_ffn(moe_cfg, _dense_lp(lp, dt), h2)
-            x = x + down
+            if tp_shards > 1:
+                x = x + _moe_ep_ffn(cfg, lp, h2, tp_shards)
+            else:
+                down, _aux = tfm._moe_ffn(moe_cfg, _dense_lp(lp, dt), h2)
+                x = x + down
         else:
             gate = jax.nn.silu(h2 @ _w(lp, "w_gate", dt))
             up = h2 @ _w(lp, "w_up", dt)

@@ -95,6 +95,13 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.oix_fp_counts.argtypes = [
         c_void_p, ctypes.POINTER(c_longlong), ctypes.POINTER(c_longlong),
     ]
+    lib.oix_slice_set.argtypes = [c_void_p, c_char_p, c_char_p, c_int]
+    lib.oix_slice_clear.argtypes = [c_void_p, c_char_p, c_char_p]
+    lib.oix_fp_probe2.restype = c_int
+    lib.oix_fp_probe2.argtypes = [
+        c_void_p, c_char_p, c_char_p, c_char_p, c_char_p, c_char_p,
+        c_char_p, c_char_p, c_char_p, c_char_p, c_char_p, c_int,
+    ]
     return lib
 
 

@@ -106,6 +106,41 @@ class NativeObjectIndex:
             )
         )
 
+    # -- slice-health mirror (written through by cluster/slices.SlicePool) --
+
+    def slice_set(self, holder: str, name: str, healthy: bool) -> None:
+        self._lib.oix_slice_set(self._h, _b(holder), _b(name),
+                                1 if healthy else 0)
+
+    def slice_clear(self, holder: str, name: str) -> None:
+        self._lib.oix_slice_clear(self._h, _b(holder), _b(name))
+
+    def fp_probe_mirrored(
+        self,
+        job_key: str,
+        ident: str,
+        namespace: str,
+        kind_a: str,
+        label_key_a: str,
+        label_val_a: str,
+        kind_b: str,
+        label_key_b: str,
+        label_val_b: str,
+        health_uid: str,
+        want_health: bool,
+    ) -> bool:
+        """fp_probe with the slice-health term composed natively from the
+        mirror (keyed by the job uid) — the steady probe runs without any
+        Python traversal of the slice pool."""
+        return bool(
+            self._lib.oix_fp_probe2(
+                self._h, _b(job_key), _b(ident), _b(namespace), _b(kind_a),
+                _b(label_key_a), _b(label_val_a), _b(kind_b),
+                _b(label_key_b), _b(label_val_b), _b(health_uid),
+                1 if want_health else 0,
+            )
+        )
+
     def fp_commit(self, job_key: str) -> None:
         self._lib.oix_fp_commit(self._h, _b(job_key))
 

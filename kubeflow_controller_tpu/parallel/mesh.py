@@ -158,7 +158,13 @@ def serving_mesh(
     weight shards at dispatch (tp as a capacity knob), ``"parallel"`` runs
     Megatron column/row-parallel matmuls on the shards in place, with one
     psum per block on this axis's ICI links (tp as a speed knob —
-    docs/serving.md "Tensor-parallel serving"). Returns ``None`` for
+    docs/serving.md "Tensor-parallel serving"). For MoE configs the same
+    axis doubles as the EXPERT-parallel axis: stacked expert banks shard
+    E/tp experts per device and tokens travel to them via two
+    all_to_alls per MoE layer (docs/serving.md "Expert-parallel MoE") —
+    a separate ep axis would fragment the serving fleet for no benefit,
+    since expert dispatch and the tp collectives want the same fast ICI
+    neighborhood. Returns ``None`` for
     ``tp <= 1``: the single-chip engine runs the exact unsharded code path,
     not a degenerate 1-device mesh — bit-exactness baselines compare
     against real single-chip traces.

@@ -85,8 +85,19 @@ def shard_params(params: Any, shardings: Any) -> Any:
 # after wo and after w_down — completes the sum). Everything else
 # (embed, norms, lm_head, the int8 scale of a row-parallel weight, whose
 # contraction axis is size 1) stays replicated.
+#
+# MoE expert banks are neither column nor row parallel: they shard the
+# EXPERT axis (``[L, E, D, F]`` -> E/tp experts per shard) and tokens
+# move to their experts via all_to_all instead of weights moving to
+# tokens (GShard-style expert parallelism, reusing the tp mesh axis as
+# the expert axis). The int8 scale ``[L, E, 1, F]`` rides the same
+# expert axis, so each shard dequantizes exactly its own experts.
 _TP_COLUMN_KEYS = frozenset(("wq", "wk", "wv", "w_gate", "w_up"))
 _TP_ROW_KEYS = frozenset(("wo", "w_down"))
+_TP_EXPERT_KEYS = frozenset(("w_gate", "w_up", "w_down"))
+# Stacked expert banks are [L, E, D, F] / [L, E, F, D]; dense weights
+# are [L, D, F]. ndim tells them apart for both q and (q, scale).
+_EXPERT_SPEC = P(None, "tp", None, None)
 
 
 def tp_compute_param_specs(params: Any) -> Any:
@@ -101,8 +112,14 @@ def tp_compute_param_specs(params: Any) -> Any:
     output axis onto ``tp`` (each shard dequantizes its own columns
     exactly); a row-parallel weight's scale is size-1 on the sharded
     contraction axis, so it replicates and every shard's dequant is
-    bitwise the full-weight dequant of its rows. MoE trees never get
-    here — ``generate.check_tp_heads`` refuses them up front."""
+    bitwise the full-weight dequant of its rows.
+
+    MoE expert banks (stacked ``[L, E, D, F]``, ndim 4 vs a dense
+    weight's 3) shard the EXPERT axis in BOTH compute modes: the
+    expert-parallel dispatch inside the kernels moves tokens to expert
+    shards via all_to_all, so the banks are never gathered. Their int8
+    scale ``[L, E, 1, F]`` rides the same axis. ``w_router`` stays
+    replicated fp32 so routing decisions are shard-invariant."""
     def spec(path, x):
         key = next(
             (getattr(p, "key", None) for p in reversed(path)
@@ -111,7 +128,9 @@ def tp_compute_param_specs(params: Any) -> Any:
         pair = isinstance(x, tuple)
         arr = x[0] if pair else x
         nd = arr.ndim
-        if key in _TP_COLUMN_KEYS:
+        if key in _TP_EXPERT_KEYS and nd >= 4:
+            w = s = _EXPERT_SPEC
+        elif key in _TP_COLUMN_KEYS:
             w = P(*((None,) * (nd - 1)), "tp")
             s = w
         elif key in _TP_ROW_KEYS:
@@ -143,12 +162,22 @@ def serving_param_shardings(
     consume the stored column/row shards in place
     (:func:`tp_compute_param_specs`) and each shard runs 1/tp of every
     projection. Either way the storage sharding halves per-device weight
-    HBM per tp doubling."""
+    HBM per tp doubling.
+
+    MoE expert banks (ndim-4 ``[L, E, D, F]`` stacks) override their
+    training spec (``P(lead, "ep", "fsdp", "tp")``, which after the
+    size-1 ep/fsdp axes drop would column-split d_ff) with the
+    expert-axis split ``P(None, "tp", None, None)`` — the serving mesh's
+    tp axis doubles as the expert-parallel axis, each device stores
+    E/tp experts, and the kernels consume the local bank in place in
+    both compute modes (tokens travel, weights don't)."""
     from kubeflow_controller_tpu.models import generate as gen
 
     specs = gen.inference_param_specs(cfg, quant)
 
     def fit(spec: P, shape: Tuple[int, ...]) -> NamedSharding:
+        if len(shape) >= 4:          # stacked expert bank (q or scale)
+            spec = _EXPERT_SPEC
         parts = list(spec) + [None] * (len(shape) - len(spec))
         out = []
         for dim, part in zip(shape, parts[:len(shape)]):

@@ -1,0 +1,306 @@
+"""Expert-parallel MoE serving equivalence (ISSUE 20 tentpole tripwires).
+
+The serving mesh's tp axis doubles as the expert-parallel axis: stacked
+expert banks shard E/tp experts per device (``parallel/sharding.py``,
+``P(None, "tp", None, None)`` on the ``[L, E, D, F]`` stacks, int8
+``(q, scale)`` tuples split on the same axis) and tokens travel to them
+inside every shard_map'd paged kernel — replicated fp32 router logits,
+an all_to_all of the dispatched token buffers to the expert shards,
+per-shard vmap'd expert matmuls, an all_to_all back, and a gate-weighted
+combine (``generate._moe_ep_ffn``).
+
+Routing is EXACT across every path (top_k of a replicated fp32 softmax,
+first-max tie-break — the same expert set and order as the single-chip
+``_moe_decode_ffn`` and the training ``_moe_ffn``); only the expert
+matmuls and the combine reassociate, so logits carry the declared
+``gen.moe_ep_tolerance`` contract in BOTH compute modes while greedy
+token streams stay bitwise the single-chip engine's — under churn, with
+spec decode on, with int8 expert banks, with seeded sampling.
+
+These tests pin all of that on the 8-virtual-device CPU mesh
+(conftest.py forces ``--xla_force_host_platform_device_count=8``), plus
+the E/tp per-shard weight layout, the MoE traffic-model gauges, and the
+divisibility refusal at both entrypoint layers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_controller_tpu.dataplane.serving_engine import (
+    Request, SamplingParams, ServingEngine,
+)
+from kubeflow_controller_tpu.models import generate as gen
+from kubeflow_controller_tpu.models import transformer as tfm
+from kubeflow_controller_tpu.obs.telemetry import registry
+from kubeflow_controller_tpu.parallel.mesh import serving_mesh
+from kubeflow_controller_tpu.parallel.sharding import shard_serving_params
+
+MAX_SEQ = 64
+BS = 8
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 4,
+    reason="MoE tp serving tests need >= 4 devices "
+           "(XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _drop_compiled_kernels():
+    """Same discipline as test_tp_serving.py: nothing after this module
+    reuses these per-(tp, mode, kernel) executables; free them so the
+    tier-1 run's footprint stays at baseline."""
+    yield
+    jax.clear_caches()
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # n_kv_heads=4 so tp in {1, 2, 4} divide the KV heads; moe_experts=4
+    # (tiny_moe default) so the same tp values divide the expert count.
+    return tfm.tiny_moe_config(n_kv_heads=4)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return gen.inference_params(cfg, tfm.init_params(cfg, jax.random.key(0)))
+
+
+def _churn_requests(cfg, n=10, seed=3, sampling=None):
+    rng = np.random.default_rng(seed)
+    shapes = [(5, 12), (9, 7), (14, 20), (3, 9), (21, 15),
+              (7, 5), (11, 11), (6, 18), (17, 6), (4, 13)][:n]
+    return [
+        Request(rid=i, prompt=rng.integers(0, cfg.vocab_size, s).astype(
+            np.int32), max_new_tokens=m, params=sampling)
+        for i, (s, m) in enumerate(shapes)
+    ]
+
+
+def _run(cfg, params, tp, sampling=None, **kw):
+    eng = ServingEngine(cfg, params, n_slots=4, max_seq=MAX_SEQ,
+                        prefill_mode="bucketed", block_size=BS,
+                        prefix_cache=True, tp=tp, **kw)
+    out = eng.run(_churn_requests(cfg, sampling=sampling))
+    return {c.rid: (list(c.tokens), c.finish_reason) for c in out}, eng
+
+
+# Engine compiles dominate runtime; the tp=1 oracle streams are computed
+# once and shared across tests (read in file order).
+_CACHE = {}
+
+
+def test_moe_streams_match_single_chip(cfg, params):
+    """MoE greedy streams at tp in {2, 4} (gathered mode) under churn ==
+    the single-chip oracle's, token for token. The oracle path
+    (``_moe_decode_ffn`` / training ``_moe_ffn`` reuse) is byte-for-byte
+    the pre-EP code; divergence here means expert dispatch changed a
+    routing DECISION, not just a logit."""
+    base, eng1 = _run(cfg, params, tp=1)
+    _CACHE["base"] = base
+    _CACHE["eng_base"] = eng1
+    for tp in (2, 4):
+        got, eng = _run(cfg, params, tp=tp)
+        assert got == base, f"tp={tp} diverged from single-chip MoE"
+        assert eng.tp == tp
+        if tp == 2:
+            _CACHE["eng_tp2"] = eng
+
+
+def test_moe_parallel_streams_match_single_chip(cfg, params):
+    """tp_compute='parallel' composes Megatron attention shards with the
+    SAME expert-parallel FFN: greedy streams still equal the oracle in
+    both attention impls, and at the bench-gated tp=4 width."""
+    base = _CACHE.get("base") or _run(cfg, params, tp=1)[0]
+    for tp, attn in ((2, "xla"), (2, "pallas"), (4, "xla")):
+        got, eng = _run(cfg, params, tp=tp, tp_compute="parallel",
+                        attn_impl=attn)
+        assert got == base, f"tp={tp}/{attn} parallel MoE diverged"
+        assert eng.tp_compute == "parallel"
+
+
+def test_moe_sampled_streams_match_single_chip(cfg, params):
+    """Seeded sampling: identical logits-within-tolerance is not enough
+    — the sampled STREAM must match, which additionally pins that the
+    per-slot RNG consumption pattern is unchanged under dispatch."""
+    sp = SamplingParams(temperature=0.8, top_k=20, seed=11)
+    base, _ = _run(cfg, params, tp=1, sampling=sp)
+    for tp, kw in ((2, {}), (4, {}), (2, {"tp_compute": "parallel"})):
+        got, _ = _run(cfg, params, tp=tp, sampling=sp, **kw)
+        assert got == base, f"sampled tp={tp}/{kw} diverged"
+
+
+def test_moe_spec_decode_bitwise(cfg, params):
+    """Spec decode's verify leg runs the K+1 verify kernel through the
+    same expert-parallel FFN; greedy spec streams == the plain oracle
+    (the PR 7 lossless contract composed with EP dispatch)."""
+    base = _CACHE.get("base") or _run(cfg, params, tp=1)[0]
+    got, eng = _run(cfg, params, tp=2,
+                    spec_decode=True, draft_k=4, decode_chunk=1)
+    assert got == base
+    assert eng.stats.spec_steps > 0 or eng.stats.spec_probe_steps >= 0
+
+
+def test_moe_int8_expert_banks_match_single_chip_int8(cfg):
+    """int8 expert banks: quantization is per-expert-row (expert-local),
+    so the sharded banks hold the identical bytes and the int8 EP stream
+    equals the int8 single-chip stream exactly."""
+    p8 = gen.inference_params(cfg, tfm.init_params(cfg, jax.random.key(0)),
+                              quant="int8")
+    base, _ = _run(cfg, p8, tp=1)
+    got, _ = _run(cfg, p8, tp=2)
+    assert got == base
+
+
+def test_moe_drain_cancel_no_leaks(cfg, params):
+    """Cancel + mid-flight drain on the EP engine: every page refcount
+    unwinds to the trie's own holds — dispatch buffers hold no pages."""
+    eng = ServingEngine(cfg, params, n_slots=4, max_seq=MAX_SEQ,
+                        prefill_mode="bucketed", block_size=BS,
+                        prefix_cache=True, tp=2)
+    for r in _churn_requests(cfg, n=6):
+        eng.submit(r)
+    for _ in range(4):
+        eng.step()
+    assert eng.cancel(2) or True
+    eng.step()
+    out = eng.drain()
+    assert {c.finish_reason for c in out} <= {
+        "eos", "length", "cancelled", "deadline", "shed"}
+    assert eng.pool.used_blocks == eng._prefix_store.trie.n_nodes()
+    assert all(b == 0 for b in eng._slot_blocks)
+
+
+def test_moe_ep_tolerance_contract(cfg, params):
+    """The declared reduction-order contract, kernel-level: prefill +
+    decode tail at tp=4 in BOTH compute modes vs single-chip, logits
+    within gen.moe_ep_tolerance(cfg, 4) at every step and argmax equal.
+    Unlike the dense-parallel contract, gathered mode ALSO carries the
+    tolerance — expert dispatch reassociates the combine regardless of
+    how attention is computed."""
+    mesh = serving_mesh(4)
+    tol = gen.moe_ep_tolerance(cfg, 4)
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(0, cfg.vocab_size, s).astype(np.int32)
+               for s in (11, 11)]
+    mb = MAX_SEQ // BS
+    modes = {"base": {}, "gath": dict(mesh=mesh, tp_compute="gathered"),
+             "par": dict(mesh=mesh, tp_compute="parallel")}
+    caches, logits = {}, {}
+    for mode, kw in modes.items():
+        cache = gen.init_paged_cache(cfg, 2, mb, 2 * mb, BS, "")
+        tables = np.arange(2 * mb, dtype=np.int32).reshape(2, mb)
+        cache = cache._replace(tables=jnp.asarray(tables))
+        rows = []
+        for i, pr in enumerate(prompts):
+            lg, cache = gen.prefill_into_paged(
+                cfg, params, jnp.asarray(pr[None]), cache,
+                jnp.asarray(i, jnp.int32), **kw)
+            rows.append(np.asarray(lg))
+        caches[mode], logits[mode] = cache, jnp.asarray(
+            np.concatenate(rows, axis=0))
+    scale = float(jnp.max(jnp.abs(logits["base"]))) + 1e-30
+    for _ in range(6):
+        toks = logits["base"].argmax(-1).astype(jnp.int32)
+        for mode, kw in modes.items():
+            if mode == "base":
+                continue
+            assert np.array_equal(
+                np.asarray(toks),
+                np.asarray(logits[mode].argmax(-1).astype(jnp.int32))), mode
+            err = float(jnp.max(jnp.abs(logits["base"] - logits[mode])))
+            assert err <= tol["atol"] + tol["rtol"] * scale, (
+                f"{mode}: EP drift {err:.2e} exceeds the declared "
+                f"contract {tol}")
+        for mode, kw in modes.items():
+            logits[mode], caches[mode] = gen.decode_step_paged(
+                cfg, params, toks[:, None], caches[mode], **kw)
+
+
+def test_moe_expert_banks_shard_e_over_tp(cfg, params):
+    """The HBM claim itself: every stacked expert bank (and its int8
+    scale) stores exactly E/tp experts — and 1/tp of its bytes — per
+    shard; the fp32 router stays replicated (routing parity depends on
+    every shard seeing identical router logits)."""
+    tp = 4
+    mesh = serving_mesh(tp)
+    p8 = gen.inference_params(cfg, tfm.init_params(cfg, jax.random.key(0)),
+                              quant="int8")
+    for tree in (shard_serving_params(cfg, params, mesh),
+                 shard_serving_params(cfg, p8, mesh, quant="int8")):
+        flat = jax.tree_util.tree_flatten_with_path(
+            tree, is_leaf=lambda x: isinstance(x, tuple))[0]
+        seen = set()
+        for path, leaf in flat:
+            leaves = leaf if isinstance(leaf, tuple) else (leaf,)
+            pname = "".join(str(p) for p in path)
+            for arr in leaves:
+                if "w_router" in pname:
+                    assert (arr.addressable_shards[0].data.shape
+                            == arr.shape), "router must replicate"
+                    seen.add("w_router")
+                elif any(k in pname for k in ("w_gate", "w_up", "w_down")):
+                    sh = arr.addressable_shards[0]
+                    assert sh.data.shape[1] == cfg.moe_experts // tp, pname
+                    assert sh.data.nbytes * tp == arr.nbytes, pname
+                    seen.add("bank")
+        assert {"w_router", "bank"} <= seen
+
+
+def test_moe_traffic_model_and_gauges(cfg, params):
+    """Satellite: the engine's traffic model counts only top_k active
+    experts per token and divides expert weight bytes by tp; the MoE
+    gauges land in ServingStats, the registry, and (via summary) the
+    metrics JSONL."""
+    eng = _CACHE.get("eng_tp2") or _run(cfg, params, tp=2)[1]
+    s = eng.stats.summary()
+    assert s["moe_experts_per_shard"] == float(cfg.moe_experts // 2)
+    assert s["moe_tokens_dispatched"] > 0
+    # Dispatch counts tokens x top_k per forward pass.
+    assert eng.stats.moe_tokens_dispatched % cfg.moe_top_k == 0
+    reg = registry()
+    assert (reg.gauge("moe_experts_per_shard", "serving").value
+            == float(cfg.moe_experts // 2))
+    assert reg.gauge("moe_tokens_dispatched", "serving").value > 0
+    # The capacity model charges the E/tp resident bank: per-shard
+    # decode-step bytes at tp=2 are strictly below the tp=1 engine's
+    # (expert weights AND KV both divide).
+    eng1 = _CACHE.get("eng_base") or _run(cfg, params, tp=1)[1]
+    assert eng._traffic_model("decode")[0] < eng1._traffic_model("decode")[0]
+
+
+def test_moe_refusal_engine_and_entrypoints(cfg, tmp_path):
+    """moe_experts % tp != 0 refuses with ONE structured message naming
+    every violated constraint, at all three layers: engine construction,
+    serve_lm.serve(), and serve_lm arg-parse (the PR 12 pattern)."""
+    from kubeflow_controller_tpu.dataplane.entrypoints import serve_lm
+
+    moe6 = tfm.tiny_moe_config(n_kv_heads=4, moe_experts=6)
+    p6 = gen.inference_params(moe6, tfm.init_params(moe6, jax.random.key(1)))
+    with pytest.raises(ValueError, match="moe_experts"):
+        ServingEngine(moe6, p6, n_slots=2, max_seq=MAX_SEQ,
+                      prefill_mode="bucketed", block_size=BS, tp=4)
+    # serve() validates before loading weights — fails in milliseconds.
+    with pytest.raises(ValueError, match="moe_experts"):
+        serve_lm.serve(config="tiny_moe", tp=3, prefix_cache=True,
+                       batch=1, prompt_len=4, max_new_tokens=2)
+    # Arg-parse surfaces the same structured message via parser.error
+    # (exit code 2), with every violation in one shot: tiny_moe at tp=3
+    # breaks BOTH n_kv_heads (2 % 3) and moe_experts (4 % 3).
+    with pytest.raises(SystemExit) as ei:
+        serve_lm.main(["--config", "tiny_moe", "--tp", "3",
+                       "--tp-compute", "parallel"])
+    assert ei.value.code == 2
+
+
+def test_moe_argparse_message_lists_all_violations(cfg, capsys):
+    """The one-shot message body at arg-parse: both problems named."""
+    from kubeflow_controller_tpu.dataplane.entrypoints import serve_lm
+
+    with pytest.raises(SystemExit):
+        serve_lm.main(["--config", "tiny_moe", "--tp", "3",
+                       "--tp-compute", "parallel"])
+    err = capsys.readouterr().err
+    assert "n_kv_heads" in err and "moe_experts" in err

@@ -418,6 +418,83 @@ class TestObjectIndexParity:
         assert hits + misses == 300
         assert hits > 0 and misses > 0
 
+    def test_slice_mirror_fp_decisions_match_python(self):
+        """Slice-health mirror parity: fp_probe_mirrored composes the
+        health term natively from SlicePool's write-through mirror; its
+        hit/miss decisions must equal the Python _sync_fingerprint spec
+        (``tuple(sorted((name, healthy) for s in holdings(uid)))``, or
+        None when the planner won't read health) through allocation,
+        degradation, preemption, restore, and release churn."""
+        import random
+
+        from kubeflow_controller_tpu.cluster.slices import (
+            InsufficientCapacity, SlicePool,
+        )
+
+        rng = random.Random(21)
+        ix = self._make()
+        pool = SlicePool(mirror=ix)
+        names = pool.add_pool("v5e-16", 6)
+        uids = [f"uid-{i}" for i in range(3)]
+        last = {}  # uid -> committed Python reference fingerprint
+
+        def py_ref(uid, want):
+            health = None
+            if want:
+                health = tuple(sorted(
+                    (s.name, s.healthy) for s in pool.holdings(uid)))
+            return ("ident", health)
+
+        hits = misses = 0
+        for step in range(300):
+            op = rng.random()
+            if op < 0.35:
+                try:
+                    pool.allocate_gang(rng.choice(uids), "v5e-16",
+                                       rng.randrange(1, 4))
+                except InsufficientCapacity:
+                    pass
+            elif op < 0.5:
+                pool.mark_unhealthy(rng.choice(names))
+            elif op < 0.6:
+                pool.preempt(rng.choice(names))
+            elif op < 0.75:
+                pool.restore(rng.choice(names))
+            elif op < 0.85:
+                pool.release(rng.choice(uids))
+
+            uid = rng.choice(uids)
+            want = rng.random() < 0.8
+            ref = py_ref(uid, want)
+            expect_hit = last.get(uid) == ref
+            got = ix.fp_probe_mirrored(
+                f"default/{uid}", "ident", "default",
+                "Pod", self.LABELS[0], "x", "", "", "", uid, want)
+            assert got == expect_hit, (step, uid, want)
+            if got:
+                hits += 1
+            else:
+                misses += 1
+                ix.fp_commit(f"default/{uid}")
+                last[uid] = ref
+        assert hits > 0 and misses > 0
+
+    def test_slice_mirror_none_vs_empty_health(self):
+        """want_health=False (planner ignores health; Python health_key
+        None) and want_health=True with zero held slices (empty tuple)
+        are DISTINCT fingerprints — toggling must miss."""
+        ix = self._make()
+        assert not ix.fp_probe_mirrored(
+            "default/j", "i", "default",
+            "Pod", self.LABELS[0], "j", "", "", "", "u", False)
+        ix.fp_commit("default/j")
+        assert ix.fp_probe_mirrored(
+            "default/j", "i", "default",
+            "Pod", self.LABELS[0], "j", "", "", "", "u", False)
+        assert not ix.fp_probe_mirrored(
+            "default/j", "i", "default",
+            "Pod", self.LABELS[0], "j", "", "", "", "u", True)
+
     def test_forget_clears_committed_and_pending(self):
         ix = self._make()
         ix.upsert("Pod", "default/a-pod-0", "pu", 1, 1,
@@ -501,6 +578,24 @@ class TestRuntimeIndexParity:
             j.metadata.annotations["churn"] = f"r{round_}"
             rt.cluster.jobs.update(j)
             rt.step(dt=1.0, steps=2)
+            if round_ == 2:
+                # Eventless slice-health flip on a held slice: the
+                # fingerprint's health term must shift (and re-steady
+                # after restore) identically on both paths — native reads
+                # it from the pool's write-through mirror, Python
+                # recomputes it from holdings() per probe.
+                held = [s for s in rt.cluster.slice_pool.list("v5p-8")
+                        if s.holder]
+                if held:
+                    name = held[rng.randrange(len(held))].name
+                    rt.cluster.slice_pool.mark_unhealthy(name)
+                    for inf in (rt.job_informer, rt.pod_informer,
+                                rt.service_informer, rt.lmservice_informer):
+                        inf.resync()
+                    while rt.controller.drain(max_items=5000):
+                        pass
+                    rt.cluster.slice_pool.restore(name)
+                    rt.step(dt=1.0, steps=2)
         for inf in (rt.job_informer, rt.pod_informer,
                     rt.service_informer, rt.lmservice_informer):
             inf.resync()

@@ -250,28 +250,36 @@ def test_tp_rejects_indivisible_heads(cfg, params):
 def test_tp_structured_refusal(cfg):
     """check_tp_heads emits ONE structured refusal listing every
     violated constraint — n_kv_heads divisibility, d_ff divisibility
-    (parallel mode only), and MoE — instead of failing on the first."""
+    (parallel mode, dense configs only), and moe_experts divisibility
+    — instead of failing on the first."""
     # d_ff=90 breaks d_ff % 4 while n_kv_heads=4 still divides.
     odd_ff = tfm.tiny_config(n_kv_heads=4, d_ff=90)
     with pytest.raises(ValueError, match="d_ff"):
         gen.check_tp_heads(odd_ff, 4, "parallel")
     # Gathered mode never touches d_ff: same config passes.
     gen.check_tp_heads(odd_ff, 4, "gathered")
-    # MoE refuses in EVERY mode (expert tensors have no serving-shard
-    # layout), and the refusal names MoE.
+    # MoE with moe_experts % tp == 0 passes BOTH modes: expert banks
+    # shard E/tp experts per device and d_ff never splits, so the dense
+    # d_ff rule does not apply (tests/test_moe_tp.py pins the streams).
     moe = tfm.tiny_moe_config(n_kv_heads=4)
     for mode in ("gathered", "parallel"):
-        with pytest.raises(ValueError, match="[Mm]o[Ee]"):
-            gen.check_tp_heads(moe, 2, mode)
+        gen.check_tp_heads(moe, 2, mode)
+        gen.check_tp_heads(moe, 4, mode)
+    # moe_experts % tp != 0 refuses in every mode with the genuine
+    # divisibility constraint, naming the knob and the fix.
+    moe6 = tfm.tiny_moe_config(n_kv_heads=4, moe_experts=6)
+    for mode in ("gathered", "parallel"):
+        with pytest.raises(ValueError, match="moe_experts"):
+            gen.check_tp_heads(moe6, 4, mode)
     # All violations at once -> one message carrying each of them.
-    bad = tfm.tiny_moe_config(n_kv_heads=2, d_ff=90)
+    bad = tfm.tiny_moe_config(n_kv_heads=2, moe_experts=6)
     with pytest.raises(ValueError) as ei:
         gen.check_tp_heads(bad, 4, "parallel")
     msg = str(ei.value)
-    assert "n_kv_heads" in msg and "d_ff" in msg and "moe" in msg.lower()
-    assert msg.count("\n") >= 2       # one bullet per violation
+    assert "n_kv_heads" in msg and "moe_experts" in msg
+    assert msg.count("\n") >= 1       # one bullet per violation
     # tp=1 is always a no-op refusal-wise.
-    gen.check_tp_heads(moe, 1, "parallel")
+    gen.check_tp_heads(moe6, 1, "parallel")
 
 
 def test_tp_stats_record_mesh_shape(cfg, params):
